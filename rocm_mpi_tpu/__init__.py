@@ -1,0 +1,21 @@
+"""rocm_mpi_tpu — a TPU-native distributed stencil framework.
+
+A brand-new framework (JAX / XLA / Pallas / shard_map) with the capabilities
+of the reference ROCm-aware-MPI diffusion suite (williamfgc/ROCm-MPI):
+cartesian domain decomposition over a device mesh, halo exchange via XLA
+collectives riding the ICI, Pallas stencil kernels, and a
+communication/computation-overlap step — demonstrated on 2D/3D transient heat
+diffusion at four escalating performance levels.
+
+Layer map (TPU-native analog of reference SURVEY.md §1):
+  L1 launch/env     -> scripts/run.sh + jax.distributed      (ref: runme.sh/setenv.sh)
+  L2 device compute -> jax.numpy + Pallas kernels            (ref: AMDGPU.jl @roc)
+  L3 communication  -> XLA collectives (ppermute) over ICI   (ref: ROCm-aware MPI)
+  L4 global grid    -> rocm_mpi_tpu.parallel.mesh/halo       (ref: ImplicitGlobalGrid.jl)
+  L5 visualization  -> rocm_mpi_tpu.utils.viz (matplotlib)   (ref: Plots.jl/GR)
+  L6 apps           -> apps/diffusion_2d_*.py                (ref: scripts/diffusion_2D_*.jl)
+"""
+
+__version__ = "0.1.0"
+
+from rocm_mpi_tpu import parallel, ops, models, utils  # noqa: F401

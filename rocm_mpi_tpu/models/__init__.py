@@ -1,0 +1,1 @@
+"""Physics models: the diffusion workloads at each performance level."""

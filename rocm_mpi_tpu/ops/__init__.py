@@ -1,0 +1,1 @@
+"""Compute ops: pure-jnp stencil helpers and Pallas TPU kernels."""

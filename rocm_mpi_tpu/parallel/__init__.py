@@ -1,0 +1,8 @@
+"""Parallelism layer: device-mesh global grid, halo exchange, gather, overlap."""
+
+from rocm_mpi_tpu.parallel.mesh import (  # noqa: F401
+    GlobalGrid,
+    init_global_grid,
+    suggest_dims,
+)
+from rocm_mpi_tpu.parallel.ring import ring_exchange, ring_exchange_demo  # noqa: F401

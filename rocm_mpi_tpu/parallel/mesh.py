@@ -1,0 +1,254 @@
+"""Global-grid runtime: cartesian device-mesh decomposition (D1/D3/D9).
+
+TPU-native re-design of the capabilities the reference obtains from
+ImplicitGlobalGrid.jl (`init_global_grid`, `nx_g`/`ny_g`, `x_g`/`y_g`,
+`finalize_global_grid`; call sites at
+/root/reference/scripts/diffusion_2D_ap.jl:17-28) and from the MPI process
+model (`srun --mpi=pmix`, one rank per GPU, cartesian communicator;
+/root/reference/README.md:18, scripts/rocmaware_test_selectdevice.jl:7-9).
+
+Design differences from the reference (deliberate, TPU-first):
+
+* **Non-overlapping shards.** ImplicitGlobalGrid gives each rank a local
+  array that *overlaps* its neighbors by 2 cells and refreshes the overlap
+  with `update_halo!`. On TPU the idiomatic layout is a single global array
+  sharded over a `jax.sharding.Mesh` with *no* persistent ghost storage;
+  ghost cells are materialized transiently each step by `halo.exchange_halo`
+  (a `lax.ppermute` over ICI) or automatically by GSPMD when the step is
+  written as global-array ops. Global size is therefore simply
+  ``local_size * dims`` per axis.
+* **One process, many devices.** The reference binds one MPI rank per GPU;
+  JAX binds all local devices to one process and `jax.distributed` handles
+  multi-host. `me`/`nprocs` map to `jax.process_index()`/device count.
+* **Cell-centered coordinates.** Cell ``i`` along an axis of global size
+  ``n`` and physical length ``l`` has center ``(i + 0.5) * l/n`` — the same
+  coordinates the reference computes as ``x_g(ix,dx,T) + dx/2``
+  (diffusion_2D_ap.jl:28).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+AXIS_NAMES = ("gx", "gy", "gz")
+
+
+def suggest_dims(nprocs: int, ndim: int) -> tuple[int, ...]:
+    """Factor `nprocs` into `ndim` near-equal factors, largest first.
+
+    Analog of MPI_Dims_create, which ImplicitGlobalGrid uses to pick the
+    process-grid shape when the caller passes dims=0 (the reference's
+    `init_global_grid(nx, ny, 1)` call relies on this).
+    """
+    if nprocs < 1:
+        raise ValueError(f"nprocs must be >= 1, got {nprocs}")
+    if ndim < 1:
+        raise ValueError(f"ndim must be >= 1, got {ndim}")
+    dims = [1] * ndim
+    remaining = nprocs
+    # Greedily peel off the largest factor <= the ideal balanced factor.
+    for i in range(ndim - 1):
+        ideal = round(remaining ** (1.0 / (ndim - i)))
+        f = 1
+        for cand in range(min(remaining, max(ideal, 1)), 0, -1):
+            if remaining % cand == 0:
+                f = cand
+                break
+        dims[i] = f
+        remaining //= f
+    dims[ndim - 1] = remaining
+    dims.sort(reverse=True)
+    return tuple(dims)
+
+
+@dataclasses.dataclass(frozen=True)
+class GlobalGrid:
+    """A global cartesian grid of cells sharded over a device mesh.
+
+    Holds everything the reference's apps get back from
+    `init_global_grid(nx, ny, nz)` — `me, dims, nprocs, coords, comm_cart`
+    (diffusion_2D_ap.jl:17) — expressed TPU-natively: the `Mesh` *is* the
+    cartesian communicator, `dims` is its shape, and per-shard coordinates
+    are derived from `lax.axis_index` inside `shard_map`.
+    """
+
+    mesh: Mesh
+    global_shape: tuple[int, ...]  # cells per axis (nx_g, ny_g[, nz_g])
+    lengths: tuple[float, ...]  # physical domain lengths (lx, ly[, lz])
+
+    def __post_init__(self):
+        if len(self.global_shape) != len(self.mesh.axis_names):
+            raise ValueError(
+                f"global_shape {self.global_shape} rank != mesh axes "
+                f"{self.mesh.axis_names}"
+            )
+        if len(self.lengths) != len(self.global_shape):
+            raise ValueError("lengths rank must match global_shape rank")
+        for n, d, name in zip(self.global_shape, self.dims, self.axis_names):
+            if n % d != 0:
+                raise ValueError(
+                    f"global size {n} along '{name}' not divisible by mesh dim {d}"
+                )
+
+    # ---- topology (reference: me/dims/nprocs/coords) --------------------
+
+    @property
+    def ndim(self) -> int:
+        return len(self.global_shape)
+
+    @property
+    def axis_names(self) -> tuple[str, ...]:
+        return tuple(self.mesh.axis_names)
+
+    @property
+    def dims(self) -> tuple[int, ...]:
+        """Process-grid shape (reference `dims`)."""
+        return tuple(self.mesh.devices.shape)
+
+    @property
+    def nprocs(self) -> int:
+        """Total devices in the grid (reference `nprocs`; rank-per-GPU model)."""
+        return int(np.prod(self.dims))
+
+    @property
+    def me(self) -> int:
+        """Host process index (rank-0-gated logging analog of reference `me`)."""
+        return jax.process_index()
+
+    def device_coords(self, device) -> tuple[int, ...]:
+        """Cartesian coords of `device` in the mesh (reference `coords`)."""
+        pos = np.argwhere(self.mesh.devices == device)
+        if len(pos) != 1:
+            raise ValueError(f"device {device} not in mesh")
+        return tuple(int(c) for c in pos[0])
+
+    # ---- sharding -------------------------------------------------------
+
+    @property
+    def spec(self) -> PartitionSpec:
+        return PartitionSpec(*self.axis_names)
+
+    @property
+    def sharding(self) -> NamedSharding:
+        """NamedSharding partitioning every grid axis over its mesh axis."""
+        return NamedSharding(self.mesh, self.spec)
+
+    @property
+    def replicated_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    @property
+    def local_shape(self) -> tuple[int, ...]:
+        """Per-device shard shape (the reference's local `nx, ny`)."""
+        return tuple(n // d for n, d in zip(self.global_shape, self.dims))
+
+    # ---- global geometry (reference nx_g/ny_g, x_g/y_g, dx/dy) ----------
+
+    @property
+    def spacing(self) -> tuple[float, ...]:
+        """Cell size per axis: dx = lx / nx_g (diffusion_2D_ap.jl:19)."""
+        return tuple(l / n for l, n in zip(self.lengths, self.global_shape))
+
+    def cell_centers(self, axis: int, dtype=jnp.float64) -> jnp.ndarray:
+        """Global cell-center coordinates along `axis`.
+
+        Equivalent to the reference's `x_g(ix,dx,T) + dx/2` per-cell
+        coordinate (diffusion_2D_ap.jl:28), for the whole global axis.
+        """
+        n = self.global_shape[axis]
+        d = self.spacing[axis]
+        return (jnp.arange(n, dtype=dtype) + 0.5) * d
+
+    def coord_mesh(self, dtype=jnp.float64) -> tuple[jnp.ndarray, ...]:
+        """Broadcastable global coordinate arrays, one per axis (x_g/y_g analog)."""
+        out = []
+        for ax in range(self.ndim):
+            shape = [1] * self.ndim
+            shape[ax] = self.global_shape[ax]
+            out.append(self.cell_centers(ax, dtype=dtype).reshape(shape))
+        return tuple(out)
+
+    def local_cell_centers(self, axis: int, axis_index, dtype=jnp.float64):
+        """Cell centers of one shard along `axis`, for use inside shard_map.
+
+        `axis_index` is typically `lax.axis_index(grid.axis_names[axis])`.
+        This is the shard-local x_g/y_g: each device initializes *its* piece
+        of the global initial condition, exactly as each reference rank does
+        (diffusion_2D_ap.jl:28).
+        """
+        ln = self.local_shape[axis]
+        d = self.spacing[axis]
+        start = axis_index * ln
+        return (start + jnp.arange(ln, dtype=dtype) + 0.5) * d
+
+
+def init_global_grid(
+    *global_shape: int,
+    lengths: Sequence[float] | None = None,
+    dims: Sequence[int] | None = None,
+    devices: Sequence[jax.Device] | None = None,
+    axis_names: Sequence[str] | None = None,
+) -> GlobalGrid:
+    """Build a GlobalGrid over the available devices.
+
+    TPU-native analog of `init_global_grid(nx, ny, nz)`
+    (diffusion_2D_ap.jl:17): constructs the cartesian topology (a Mesh over
+    `jax.devices()`), picks the process-grid shape (suggest_dims =
+    MPI_Dims_create analog), and records global geometry. Device binding is
+    implicit (JAX owns all local devices; under `jax.distributed` the mesh
+    spans hosts) — the analog of the reference's rank-per-GPU `device!`
+    selection (rocmaware_test_selectdevice.jl:7-9).
+
+    Args:
+      *global_shape: global cells per axis, e.g. (504, 504). Trailing size-1
+        axes (the reference's `nz=1` idiom) are dropped.
+      lengths: physical lengths; default 10.0 per axis (diffusion_2D_ap.jl:11).
+      dims: process-grid shape; default near-square factorization of device
+        count. Use (1,)*ndim for single-device grids.
+      devices: devices to use; default all of `jax.devices()` (prefix that
+        fills `prod(dims)`).
+      axis_names: mesh axis names; default ("gx","gy","gz")[:ndim].
+    """
+    shape = tuple(int(n) for n in global_shape)
+    while len(shape) > 1 and shape[-1] == 1:
+        shape = shape[:-1]
+        # Strip explicit dims in lockstep with the (nx, ny, 1) idiom.
+        if dims is not None and len(dims) == len(shape) + 1 and dims[-1] == 1:
+            dims = tuple(dims)[:-1]
+    ndim = len(shape)
+    if lengths is None:
+        lengths = (10.0,) * ndim
+    lengths = tuple(float(l) for l in lengths)
+    if devices is None:
+        devices = jax.devices()
+    if dims is None:
+        dims = suggest_dims(len(devices), ndim)
+        # Shrink to dims that actually divide the global shape.
+        dims = tuple(d if n % d == 0 else math.gcd(n, d) for n, d in zip(shape, dims))
+        used = int(np.prod(dims))
+        if used < len(devices):
+            import warnings
+
+            warnings.warn(
+                f"global shape {shape} is not divisible by the natural "
+                f"{suggest_dims(len(devices), ndim)} device grid; shrunk to "
+                f"dims {dims}, using {used} of {len(devices)} devices. Pass "
+                f"a divisible shape (or explicit dims=) to use every device.",
+                stacklevel=2,
+            )
+    dims = tuple(int(d) for d in dims)
+    nproc = int(np.prod(dims))
+    if nproc > len(devices):
+        raise ValueError(f"dims {dims} need {nproc} devices, have {len(devices)}")
+    if axis_names is None:
+        axis_names = AXIS_NAMES[:ndim]
+    dev_grid = np.asarray(devices[:nproc]).reshape(dims)
+    mesh = Mesh(dev_grid, tuple(axis_names))
+    return GlobalGrid(mesh=mesh, global_shape=shape, lengths=lengths)

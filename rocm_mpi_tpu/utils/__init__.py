@@ -1,0 +1,1 @@
+"""Utilities: timers/metrics, visualization, logging, profiling."""

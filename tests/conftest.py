@@ -1,0 +1,27 @@
+"""Test harness: simulate an 8-device TPU mesh on CPU.
+
+The reference assumes a Slurm cluster and has no way to test multi-rank
+behavior locally (SURVEY.md §4.5). The TPU-native answer is XLA's virtual
+host devices: force 8 CPU devices so every mesh/halo/collective test runs
+single-process, no hardware needed. f64 is enabled to match the reference's
+Float64 physics (diffusion_2D_ap.jl:22-26).
+
+Note: this environment pre-imports jax at interpreter startup with
+JAX_PLATFORMS=axon pinned, so we must override via jax.config (which works
+any time before backend initialization), not via os.environ.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses we spawn
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_enable_x64", True)
+
+assert len(jax.devices()) == 8, (
+    "test harness requires 8 virtual CPU devices, got "
+    f"{jax.devices()} — was a backend initialized before conftest ran?"
+)

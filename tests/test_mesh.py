@@ -1,0 +1,84 @@
+"""GlobalGrid topology/geometry tests (D1/D3/D9 parity)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rocm_mpi_tpu.parallel import GlobalGrid, init_global_grid, suggest_dims
+
+
+def test_suggest_dims_near_square():
+    assert suggest_dims(8, 2) == (4, 2)
+    assert suggest_dims(4, 2) == (2, 2)
+    assert suggest_dims(1, 2) == (1, 1)
+    assert suggest_dims(8, 3) == (2, 2, 2)
+    assert suggest_dims(6, 2) == (3, 2)
+    assert suggest_dims(7, 2) == (7, 1)
+    assert suggest_dims(12, 3) == (3, 2, 2)
+
+
+def test_init_topology_8_devices():
+    grid = init_global_grid(256, 256)
+    assert grid.dims == (4, 2)
+    assert grid.nprocs == 8
+    assert grid.local_shape == (64, 128)
+    assert grid.axis_names == ("gx", "gy")
+    # 2.0.0 cartesian coords cover the mesh
+    coords = {grid.device_coords(d) for d in grid.mesh.devices.flat}
+    assert coords == {(i, j) for i in range(4) for j in range(2)}
+
+
+def test_trailing_unit_axis_dropped():
+    # Reference idiom: init_global_grid(nx, ny, 1) for a 2D run
+    # (diffusion_2D_ap.jl:17).
+    grid = init_global_grid(128, 128, 1, dims=(2, 2))
+    assert grid.ndim == 2
+    assert grid.global_shape == (128, 128)
+
+
+def test_geometry_matches_reference_formulas():
+    # dx = lx/nx_g, cell center = x_g + dx/2 (diffusion_2D_ap.jl:19,28).
+    grid = init_global_grid(128, 64, lengths=(10.0, 10.0), dims=(1, 1))
+    dx, dy = grid.spacing
+    assert dx == pytest.approx(10.0 / 128)
+    assert dy == pytest.approx(10.0 / 64)
+    x = grid.cell_centers(0)
+    assert x.shape == (128,)
+    assert float(x[0]) == pytest.approx(dx / 2)
+    assert float(x[-1]) == pytest.approx(10.0 - dx / 2)
+
+
+def test_local_cell_centers_tile_global():
+    grid = init_global_grid(64, 64, dims=(4, 2))
+    x_global = np.asarray(grid.cell_centers(0))
+    tiles = [np.asarray(grid.local_cell_centers(0, i)) for i in range(4)]
+    np.testing.assert_allclose(np.concatenate(tiles), x_global)
+
+
+def test_sharding_places_shards():
+    grid = init_global_grid(64, 64, dims=(4, 2))
+    x = jax.device_put(jnp.zeros(grid.global_shape), grid.sharding)
+    assert len(x.addressable_shards) == 8
+    assert x.addressable_shards[0].data.shape == grid.local_shape
+
+
+def test_indivisible_shape_raises():
+    with pytest.raises(ValueError):
+        GlobalGrid(
+            mesh=init_global_grid(64, 64, dims=(4, 2)).mesh,
+            global_shape=(63, 64),
+            lengths=(10.0, 10.0),
+        )
+
+
+def test_explicit_dims_with_trailing_unit_axis():
+    grid = init_global_grid(128, 128, 1, dims=(2, 2, 1))
+    assert grid.ndim == 2
+    assert grid.dims == (2, 2)
+
+
+def test_warns_when_devices_dropped():
+    with pytest.warns(UserWarning, match="using 4 of 8"):
+        grid = init_global_grid(250, 250)
+    assert grid.nprocs == 4

@@ -1,0 +1,43 @@
+"""Ring-exchange smoke test — the analog (and automation) of the reference's
+rocmaware_test_selectdevice.jl capability proof (SURVEY.md §3.5, §4.1)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec
+
+from rocm_mpi_tpu.parallel import init_global_grid
+from rocm_mpi_tpu.parallel.ring import ring_exchange, ring_exchange_demo
+
+
+def test_ring_exchange_demo_values():
+    grid = init_global_grid(8 * 4, lengths=(1.0,), dims=(8,))
+    sent, received = ring_exchange_demo(grid.mesh, width=4)
+    n = 8
+    sent = np.asarray(sent).reshape(n, 4)
+    received = np.asarray(received).reshape(n, 4)
+    for i in range(n):
+        assert (sent[i] == i).all()
+        # Device i receives from its left neighbor — same assertion the
+        # reference makes by printing recv on each rank (…selectdevice.jl:23).
+        assert (received[i] == (i - 1) % n).all()
+
+
+def test_ring_exchange_reverse_shift():
+    grid = init_global_grid(16, lengths=(1.0,), dims=(8,))
+    mesh = grid.mesh
+    x = jax.device_put(
+        jnp.repeat(jnp.arange(8.0), 2), grid.sharding
+    )
+    out = jax.jit(
+        shard_map(
+            lambda b: ring_exchange(b, "gx", shift=-1),
+            mesh=mesh,
+            in_specs=PartitionSpec("gx"),
+            out_specs=PartitionSpec("gx"),
+        )
+    )(x)
+    out = np.asarray(out).reshape(8, 2)
+    for i in range(8):
+        assert (out[i] == (i + 1) % 8).all()
