@@ -1,0 +1,148 @@
+"""Shared CLI driver for the diffusion apps (reference L6/L1 analog).
+
+The reference's five apps share an identical skeleton — init grid, IC, hot
+loop, T_eff printout, gather + heatmap (SURVEY.md §3). Here that skeleton is
+one driver parameterized by variant; each app file pins its variant and
+defaults, exactly as runme.sh selects which .jl to run
+(/root/reference/scripts/runme.sh:5-9).
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+OUTPUT_DIR = pathlib.Path(__file__).resolve().parent.parent / "output"
+
+
+def make_parser(variant: str, *, nx: int, ny: int, nt: int, do_vis: bool):
+    p = argparse.ArgumentParser(
+        description=f"2D heat diffusion — {variant} variant"
+    )
+    p.add_argument("--nx", type=int, default=nx, help="global grid points, x")
+    p.add_argument("--ny", type=int, default=ny, help="global grid points, y")
+    p.add_argument(
+        "--fact",
+        type=int,
+        default=0,
+        help="if set, nx=ny=fact*1024 (perf.jl:21 'fact' knob)",
+    )
+    p.add_argument("--nt", type=int, default=nt, help="time steps")
+    p.add_argument("--warmup", type=int, default=10, help="untimed steps")
+    p.add_argument(
+        "--dtype", default="f64", choices=["f32", "f64", "bf16"],
+        help="f64 matches the reference; f32 is the TPU fast path",
+    )
+    p.add_argument(
+        "--dims", default=None,
+        help="process grid, e.g. 2,2 (default: auto near-square)",
+    )
+    p.add_argument(
+        "--cpu-devices", type=int, default=0, metavar="N",
+        help="simulate N virtual CPU devices instead of real hardware",
+    )
+    vis = p.add_mutually_exclusive_group()
+    vis.add_argument("--vis", dest="do_vis", action="store_true", default=do_vis)
+    vis.add_argument("--no-vis", dest="do_vis", action="store_false")
+    p.add_argument(
+        "--transport", default=None, choices=["ici", "host"],
+        help="halo transport: device-direct collectives vs host staging "
+        "(IGG_ROCMAWARE_MPI=1/0 analog)",
+    )
+    p.add_argument(
+        "--profile", default=None, metavar="DIR",
+        help="trace the timed loop with jax.profiler into DIR",
+    )
+    return p
+
+
+def setup_jax(args):
+    import jax
+
+    if args.cpu_devices:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+    if args.dtype == "f64":
+        jax.config.update("jax_enable_x64", True)
+    return jax
+
+
+def build_config(args):
+    from rocm_mpi_tpu.config import DiffusionConfig, with_fact
+
+    dims = None
+    if args.dims:
+        dims = tuple(int(d) for d in args.dims.split(","))
+    kwargs = {}
+    if args.transport:
+        kwargs["halo_transport"] = args.transport
+    cfg = DiffusionConfig(
+        global_shape=(args.nx, args.ny),
+        lengths=(10.0, 10.0),
+        nt=args.nt,
+        warmup=args.warmup,
+        dtype=args.dtype,
+        dims=dims,
+        do_vis=args.do_vis,
+        **kwargs,
+    )
+    if args.fact:
+        cfg = with_fact(cfg, args.fact)
+    return cfg
+
+
+def run_app(variant: str, args) -> int:
+    """The shared skeleton: init → run → report → (gather + heatmap)."""
+    jax = setup_jax(args)
+    import numpy as np
+
+    from rocm_mpi_tpu.models import HeatDiffusion
+    from rocm_mpi_tpu.parallel import gather_to_host0
+    from rocm_mpi_tpu.utils import viz
+    from rocm_mpi_tpu.utils.logging import log0
+
+    cfg = build_config(args)
+    model = HeatDiffusion(cfg)
+    grid = model.grid
+    log0(
+        f"Process {grid.me} grid {grid.global_shape} over mesh {grid.dims} "
+        f"({grid.nprocs} device(s): {jax.devices()[0].device_kind} …)"
+    )
+
+    import contextlib
+
+    profile_ctx = (
+        jax.profiler.trace(args.profile)
+        if args.profile
+        else contextlib.nullcontext()
+    )
+    log0("Starting the time loop 🚀...", end="")
+    with profile_ctx:
+        result = model.run(variant=variant)
+    log0("done")
+
+    per_chip = result.t_eff / grid.nprocs
+    log0(
+        f"Executed {result.nt} steps in = {result.wtime:.3e} sec "
+        f"(@ T_eff = {result.t_eff:.2f} GB/s aggregate, "
+        f"{per_chip:.2f} GB/s/chip, {result.gpts:.4f} Gpts/s)"
+    )
+
+    if cfg.do_vis:
+        T_v = gather_to_host0(result.T)
+        if T_v is not None:
+            log0(f"maximum(T_v) = {T_v.max()}")  # decay invariant (hide.jl:115)
+            path = OUTPUT_DIR / viz.artifact_name(
+                variant, grid.nprocs, grid.global_shape
+            )
+            viz.save_heatmap(
+                T_v, path, title=f"{variant} nt={result.nt} mesh={grid.dims}"
+            )
+            log0(f"wrote {path}")
+    else:
+        # Cheap scalar invariant even without vis: peak must decay.
+        log0(f"maximum(T) = {float(result.T.max())}")
+    return 0

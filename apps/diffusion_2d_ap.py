@@ -1,0 +1,21 @@
+"""2D heat diffusion — array-programming variant (C1 analog).
+
+The baseline level of the performance ladder: the step is plain jnp
+array ops in staggered flux form on the *global* sharded field; XLA/GSPMD
+auto-partitions over the device mesh and inserts the halo communication that
+the reference performs explicitly with `update_halo!`
+(/root/reference/scripts/diffusion_2D_ap.jl). Defaults match the reference:
+128² grid (global here; per-rank there), 1000 steps, Float64, heatmap
+artifact written to output/.
+
+  python apps/diffusion_2d_ap.py --cpu-devices 4      # 2x2 virtual mesh
+  python apps/diffusion_2d_ap.py --nx 252 --ny 252    # single real chip
+"""
+
+import sys
+
+from _common import make_parser, run_app
+
+if __name__ == "__main__":
+    args = make_parser("ap", nx=128, ny=128, nt=1000, do_vis=True).parse_args()
+    sys.exit(run_app("ap", args))

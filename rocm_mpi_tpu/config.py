@@ -1,0 +1,94 @@
+"""Configuration dataclasses — the framework's flag system.
+
+The reference has no CLI flags or config files: physics/numerics are
+hardcoded constants at the top of each `diffusion2D()`
+(/root/reference/scripts/diffusion_2D_ap.jl:10-16,
+ scripts/diffusion_2D_perf.jl:16-25), variants are chosen by editing
+runme.sh, and environment variables are the real config system
+(IGG_ROCMAWARE_MPI etc., scripts/setenv.sh:11-18; SURVEY.md §5.6). Here every
+knob the reference treats as tunable (grid size/fact, tile shape, boundary
+width b_width, step count nt, do_vis, dtype, halo transport) is an explicit
+dataclass field, with env-var overrides only for the transport toggle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import jax.numpy as jnp
+
+DTYPES = {
+    "f32": jnp.float32,
+    "f64": jnp.float64,
+    "bf16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "bfloat16": jnp.bfloat16,
+}
+
+# Halo transport selector — analog of the reference's IGG_ROCMAWARE_MPI env
+# toggle (scripts/setenv.sh:13,18; README.md:25-35): "ici" passes
+# device-resident shards straight to the collective (ROCm-aware / GPU-direct
+# analog), "host" stages the exchange through host memory (the =0 fallback,
+# kept as a correctness oracle).
+HALO_TRANSPORT_ENV = "RMT_HALO_TRANSPORT"
+
+
+@dataclasses.dataclass(frozen=True)
+class DiffusionConfig:
+    """All knobs of a diffusion run (any variant, 2D or 3D)."""
+
+    global_shape: tuple[int, ...] = (128, 128)
+    lengths: tuple[float, ...] = (10.0, 10.0)  # lx, ly (ap.jl:11)
+    lam: float = 1.0  # thermal conductivity λ (ap.jl:12)
+    cp0: float = 1.0  # heat capacity (ap.jl:13)
+    nt: int = 1000  # time steps (ap.jl:16)
+    warmup: int = 10  # steps excluded from timing (perf.jl:48,56)
+    dtype: str = "f64"
+    dims: tuple[int, ...] | None = None  # process grid; None = auto
+    b_width: tuple[int, ...] = (32, 4)  # boundary frame width (hide.jl:42)
+    do_vis: bool = False  # (perf.jl:15)
+    halo_transport: str = dataclasses.field(
+        default_factory=lambda: os.environ.get(HALO_TRANSPORT_ENV, "ici")
+    )
+
+    def __post_init__(self):
+        if len(self.lengths) != len(self.global_shape):
+            raise ValueError("lengths rank must match global_shape rank")
+        if self.dtype not in DTYPES:
+            raise ValueError(f"dtype must be one of {sorted(DTYPES)}")
+        if self.halo_transport not in ("ici", "host"):
+            raise ValueError("halo_transport must be 'ici' or 'host'")
+
+    @property
+    def ndim(self) -> int:
+        return len(self.global_shape)
+
+    @property
+    def jax_dtype(self):
+        return DTYPES[self.dtype]
+
+    @property
+    def spacing(self) -> tuple[float, ...]:
+        return tuple(
+            l / n for l, n in zip(self.lengths, self.global_shape)
+        )
+
+    @property
+    def dt(self) -> float:
+        """Stable explicit time step.
+
+        2D: min(dx²,dy²)·Cp0/λ/4.1 (diffusion_2D_ap.jl:20). Generalized to
+        N dimensions as /(2·ndim + 0.1) — the reference's 4.1 is the 2D case
+        of the 2·ndim CFL bound with the same 0.1 safety margin.
+        """
+        h2 = min(d * d for d in self.spacing)
+        return h2 * self.cp0 / self.lam / (2 * self.ndim + 0.1)
+
+
+def with_fact(cfg: DiffusionConfig, fact: int) -> DiffusionConfig:
+    """Scale the grid as the reference's `fact` knob: nx = fact·1024
+    (diffusion_2D_perf.jl:21-22)."""
+    shape = tuple(fact * 1024 for _ in cfg.global_shape)
+    return dataclasses.replace(cfg, global_shape=shape)

@@ -1,1 +1,3 @@
 """Physics models: the diffusion workloads at each performance level."""
+
+from rocm_mpi_tpu.models.diffusion import HeatDiffusion, RunResult  # noqa: F401

@@ -1,0 +1,182 @@
+"""The transient heat-diffusion model — the framework's flagship workload.
+
+One physics model at escalating performance levels, mirroring the
+reference's app ladder (SURVEY.md §2.1 C1-C4):
+
+  variant "ap"    — array-programming: global-array flux-form jnp ops; GSPMD
+                    auto-partitions and inserts halo comms (C1 analog).
+  variant "fused" — single fused jnp stencil, double-buffer-free functional
+                    update (C3's math, compiler-scheduled).
+  Pallas/overlap variants ("kp", "perf", "hide") are added by
+  rocm_mpi_tpu.ops.pallas_kernels / parallel.overlap and registered here.
+
+The hot loop lives *inside* one jitted `lax.fori_loop` — the TPU-first
+answer to the reference's per-step `wait(@roc …); update_halo!` host
+round-trips (scripts/diffusion_2D_perf.jl:47-52): nothing leaves the device
+between tic and toc.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from rocm_mpi_tpu.config import DiffusionConfig
+from rocm_mpi_tpu.ops.diffusion import gaussian_ic, step_flux_form, step_fused
+from rocm_mpi_tpu.parallel.mesh import GlobalGrid, init_global_grid
+from rocm_mpi_tpu.utils import metrics
+
+
+@dataclasses.dataclass
+class RunResult:
+    T: jax.Array  # final temperature field (global, sharded)
+    wtime: float  # seconds over the timed steps
+    nt: int
+    warmup: int
+    config: DiffusionConfig
+
+    @property
+    def wtime_it(self) -> float:
+        return metrics.wtime_per_it(self.wtime, self.nt, self.warmup)
+
+    @property
+    def t_eff(self) -> float:
+        return metrics.t_eff_gbs(
+            self.T.shape, self.T.dtype.itemsize, self.wtime_it
+        )
+
+    @property
+    def gpts(self) -> float:
+        return metrics.gpts_per_s(self.T.shape, self.wtime_it)
+
+
+class HeatDiffusion:
+    """Heat diffusion on a sharded global grid, with selectable step variant."""
+
+    def __init__(
+        self,
+        config: DiffusionConfig,
+        grid: GlobalGrid | None = None,
+        devices=None,
+    ):
+        self.config = config
+        if grid is None:
+            grid = init_global_grid(
+                *config.global_shape,
+                lengths=config.lengths,
+                dims=config.dims,
+                devices=devices,
+            )
+        if grid.global_shape != config.global_shape:
+            raise ValueError(
+                f"grid shape {grid.global_shape} != config {config.global_shape}"
+            )
+        if grid.lengths != config.lengths:
+            raise ValueError(
+                f"grid lengths {grid.lengths} != config {config.lengths}"
+            )
+        self.grid = grid
+        self._step_fns: dict[str, Callable] = {}
+        self.register_variant("ap", self._make_jnp_step(step_flux_form))
+        self.register_variant("fused", self._make_jnp_step(step_fused))
+
+    # ---- state ----------------------------------------------------------
+
+    def init_state(self):
+        """(T, Cp) on-device, sharded over the grid mesh.
+
+        T₀ = centered Gaussian via global cell-center coordinates — each
+        device materializes its shard of the global IC, as each reference
+        rank does through x_g/y_g (diffusion_2D_ap.jl:28). Cp = Cp0·ones
+        (ap.jl:25).
+        """
+        cfg, grid = self.config, self.grid
+        dtype = cfg.jax_dtype
+
+        @functools.partial(jax.jit, out_shardings=grid.sharding)
+        def make_T():
+            coords = grid.coord_mesh(dtype=dtype)
+            return gaussian_ic(coords, cfg.lengths, dtype=dtype)
+
+        @functools.partial(jax.jit, out_shardings=grid.sharding)
+        def make_Cp():
+            return jnp.full(grid.global_shape, cfg.cp0, dtype=dtype)
+
+        return make_T(), make_Cp()
+
+    # ---- variants -------------------------------------------------------
+
+    def register_variant(self, name: str, step_fn: Callable):
+        """step_fn(T, Cp, lam, dt, spacing, grid) -> new T."""
+        self._step_fns[name] = step_fn
+
+    @property
+    def variants(self) -> tuple[str, ...]:
+        return tuple(self._step_fns)
+
+    def _make_jnp_step(self, raw_step):
+        def step(T, Cp, lam, dt, spacing, grid):
+            del grid  # global formulation: GSPMD handles the decomposition
+            return raw_step(T, Cp, lam, dt, spacing)
+
+        return step
+
+    def advance_fn(self, variant: str):
+        """jitted (T, Cp, n_steps) -> T after n_steps.
+
+        `n_steps` is *traced* (dynamic fori_loop bound) so the warmup call
+        and the timed call share one compiled program — otherwise the timed
+        window would include a recompile for the new static step count and
+        the warmup would fail its purpose (perf.jl:48's it==11 tic assumes
+        the code is warm).
+
+        NOTE: donates T (argument 0) — the functional analog of the
+        reference's `T, T2 = T2, T` double-buffer swap (perf.jl:50): XLA
+        reuses the input buffer instead of allocating a second field. The
+        caller must not reuse the passed-in T afterwards.
+        """
+        cfg, grid = self.config, self.grid
+        step = self._step_fns[variant]
+        dt = cfg.jax_dtype(cfg.dt)
+
+        @functools.partial(jax.jit, donate_argnums=0)
+        def advance(T, Cp, n):
+            body = lambda _, T: step(T, Cp, cfg.lam, dt, cfg.spacing, grid)
+            return lax.fori_loop(0, n, body, T)
+
+        return advance
+
+    # ---- driver ---------------------------------------------------------
+
+    def run(
+        self, variant: str = "ap", nt: int | None = None, warmup: int | None = None
+    ) -> RunResult:
+        """Run `nt` steps; time all but the first `warmup` (perf.jl:47-53)."""
+        cfg = self.config
+        nt = cfg.nt if nt is None else nt
+        warmup = cfg.warmup if warmup is None else warmup
+        if not 0 <= warmup < nt:
+            raise ValueError(f"need 0 <= warmup < nt, got {warmup}, {nt}")
+        if cfg.halo_transport == "host" and variant in ("ap", "fused"):
+            import warnings
+
+            warnings.warn(
+                f"halo_transport='host' is not honored by variant '{variant}' "
+                "(global-array formulation; GSPMD owns the communication). "
+                "Use a shard_map variant for the host-staged oracle path.",
+                stacklevel=2,
+            )
+        T, Cp = self.init_state()
+        advance = self.advance_fn(variant)
+        timer = metrics.Timer()
+        if warmup:
+            T = advance(T, Cp, warmup)
+        timer.tic(T)
+        T = advance(T, Cp, nt - warmup)
+        wtime = timer.toc(T)
+        return RunResult(T=T, wtime=wtime, nt=nt, warmup=warmup, config=cfg)

@@ -1,0 +1,104 @@
+"""Heat-diffusion step functions (pure jnp) and the analytic golden solution.
+
+Physics: Fourier's law + conservation of energy,
+    q = -λ ∇T ;  ∂T/∂t = 1/cₚ (-∇·q)
+exactly as the reference's array-programming update
+(/root/reference/scripts/diffusion_2D_ap.jl:38-41). Boundary condition:
+global-domain edge cells are *never updated* (the reference updates
+`T[2:end-1,2:end-1]` only) — Dirichlet with the initial boundary values
+held fixed.
+
+Two step formulations, both functional (return the new field):
+
+* `step_flux_form` — the 3-stage staggered-grid update (flux arrays qx/qy of
+  shapes (nx-1,ny-2)/(nx-2,ny-1), then divergence; ap.jl:22-24,38-41).
+* `step_fused` — the single-pass 5-point (2·ndim+1-point) stencil that the
+  reference's fused perf kernel computes inline (scripts/diffusion_2D_perf.jl:3-13),
+  recomputing fluxes to trade FLOPs for memory traffic.
+
+The two are algebraically identical; tests assert fp-level agreement.
+
+NOTE on a reference quirk: the fused kernel *multiplies* by Cp
+(`dt*(Cp[ix,iy]*(…))`, perf.jl:8) where the ap/kp variants *divide*
+(`1.0./inn(Cp)`, ap.jl:40). With the shipped Cp = Cp0 = 1.0 the two
+coincide. This framework uses the physically-correct 1/cₚ everywhere.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from rocm_mpi_tpu.ops.stencil import d_a, d_i, inn
+
+
+def step_flux_form(T, Cp, lam, dt, spacing):
+    """One explicit step in staggered flux form (ap variant, any ndim).
+
+    Mirrors diffusion_2D_ap.jl:38-41: per axis a flux q_ax = -λ d_i(T)/d_ax
+    on the staggered grid, then dTdt = 1/cₚ Σ_ax (-d_a(q_ax)/d_ax), then an
+    interior-only update.
+    """
+    ndim = T.ndim
+    dTdt = jnp.zeros_like(inn(T))
+    for ax in range(ndim):
+        d = spacing[ax]
+        q = -lam * d_i(T, ax) / d  # Fourier's law on the staggered grid
+        dTdt = dTdt - d_a(q, ax) / d
+    dTdt = dTdt / inn(Cp)
+    interior = tuple(slice(1, -1) for _ in range(ndim))
+    return T.at[interior].add(dt * dTdt)
+
+
+def step_fused(T, Cp, lam, dt, spacing):
+    """One explicit step as a single fused stencil (perf variant, any ndim).
+
+    The jnp expression of the reference's fused memory-bound kernel
+    (diffusion_2D_perf.jl:3-13): read the 2·ndim+1-point neighborhood of T,
+    write the interior of the output; edge cells pass through unchanged
+    (the kernel's `ix>1 && ix<nx && …` guard).
+    """
+    ndim = T.ndim
+    interior = tuple(slice(1, -1) for _ in range(ndim))
+    lap = jnp.zeros_like(T[interior])
+    for ax in range(ndim):
+        d2 = spacing[ax] * spacing[ax]
+        hi = tuple(
+            slice(2, None) if a == ax else slice(1, -1) for a in range(ndim)
+        )
+        lo = tuple(
+            slice(None, -2) if a == ax else slice(1, -1) for a in range(ndim)
+        )
+        lap = lap + (T[hi] - 2.0 * T[interior] + T[lo]) / d2
+    Tnew_in = T[interior] + dt * lam / Cp[interior] * lap
+    return T.at[interior].set(Tnew_in)
+
+
+def gaussian_ic(coords, lengths, dtype=None):
+    """Initial condition: unit Gaussian at the domain center.
+
+    T₀ = exp(-Σ_ax (x_ax - l_ax/2)²), the reference IC with cell-centered
+    coordinates (diffusion_2D_ap.jl:28: exp(-(x_g+dx/2-lx/2)² - …)).
+
+    `coords` are broadcastable per-axis cell-center arrays
+    (GlobalGrid.coord_mesh).
+    """
+    r2 = sum((c - l / 2.0) ** 2 for c, l in zip(coords, lengths))
+    T = jnp.exp(-r2)
+    return T.astype(dtype) if dtype is not None else T
+
+
+def analytic_solution(coords, lengths, diffusivity, t):
+    """Exact solution of the free-space heat equation for `gaussian_ic`.
+
+    With T₀ = exp(-r²) (i.e. 1/(4a₀) = 1/4, a₀=1) and D = λ/cₚ, the
+    solution at time t is
+        T(x,t) = (1 + 4Dt)^(-d/2) · exp(-r² / (1 + 4Dt)).
+    Valid while the field is negligible at the domain boundary (the Dirichlet
+    edges then don't matter) — the golden-test regime. This is the
+    quantitative version of the reference's visual acceptance check
+    ("smooth centered Gaussian", docs/Temp_4_252_252.png; SURVEY.md §4.2).
+    """
+    d = len(coords)
+    s = 1.0 + 4.0 * diffusivity * t
+    r2 = sum((c - l / 2.0) ** 2 for c, l in zip(coords, lengths))
+    return s ** (-d / 2.0) * jnp.exp(-r2 / s)

@@ -1,0 +1,58 @@
+"""Pure-jnp stencil slicing helpers — the array-programming vocabulary.
+
+These are the view helpers the reference's array-programming app defines
+(`d_xa/d_xi/d_ya/d_yi/inn`, /root/reference/scripts/diffusion_2D_ap.jl:3-7),
+generalized to N dimensions. In JAX they are functional (return new arrays);
+XLA fuses the slices into the consuming elementwise kernels, so — unlike the
+Julia broadcasts, which launch one GPU kernel each — a whole update chain
+compiles to a single fused device program.
+
+Naming (reference convention):
+  d_<axis>a(A): forward difference along <axis>, all other axes full.
+  d_<axis>i(A): forward difference along <axis>, all other axes inner (1:-1).
+  inn(A): interior of A (1:-1 on every axis).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _slc(ndim: int, axis: int, s: slice, other: slice) -> tuple[slice, ...]:
+    return tuple(s if ax == axis else other for ax in range(ndim))
+
+
+def d_a(A: jnp.ndarray, axis: int) -> jnp.ndarray:
+    """Forward difference along `axis`, full extent on other axes (d_xa/d_ya)."""
+    hi = _slc(A.ndim, axis, slice(1, None), slice(None))
+    lo = _slc(A.ndim, axis, slice(None, -1), slice(None))
+    return A[hi] - A[lo]
+
+
+def d_i(A: jnp.ndarray, axis: int) -> jnp.ndarray:
+    """Forward difference along `axis`, inner extent on other axes (d_xi/d_yi)."""
+    hi = _slc(A.ndim, axis, slice(1, None), slice(1, -1))
+    lo = _slc(A.ndim, axis, slice(None, -1), slice(1, -1))
+    return A[hi] - A[lo]
+
+
+def inn(A: jnp.ndarray) -> jnp.ndarray:
+    """Interior of A: drop one boundary cell on every axis."""
+    return A[tuple(slice(1, -1) for _ in range(A.ndim))]
+
+
+# 2D aliases matching the reference names exactly (diffusion_2D_ap.jl:3-7).
+def d_xa(A):
+    return d_a(A, 0)
+
+
+def d_ya(A):
+    return d_a(A, 1)
+
+
+def d_xi(A):
+    return d_i(A, 0)
+
+
+def d_yi(A):
+    return d_i(A, 1)

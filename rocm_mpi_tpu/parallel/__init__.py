@@ -5,4 +5,5 @@ from rocm_mpi_tpu.parallel.mesh import (  # noqa: F401
     init_global_grid,
     suggest_dims,
 )
+from rocm_mpi_tpu.parallel.gather import gather_to_host0  # noqa: F401
 from rocm_mpi_tpu.parallel.ring import ring_exchange, ring_exchange_demo  # noqa: F401

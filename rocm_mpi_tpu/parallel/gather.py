@@ -1,0 +1,30 @@
+"""Gather the global field to host process 0 for visualization (D4).
+
+Reference: `gather!(T_nh, T_v)` assembles each rank's halo-stripped local
+array into the global buffer on rank 0 for plotting
+(/root/reference/scripts/diffusion_2D_ap.jl:31-34,45-46), via MPI_Gather.
+
+TPU-native: shards are non-overlapping, so there is nothing to strip — a
+device-to-host transfer of the global array *is* the gather. Single process:
+`np.asarray` assembles all addressable shards. Multi-host (pod slice):
+`multihost_utils.process_allgather` moves every shard to every host over DCN
+and we keep the result on process 0 only, matching the reference's
+rank-0-only `T_v`.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def gather_to_host0(x) -> np.ndarray | None:
+    """Return the full global array as numpy on process 0 (None elsewhere)."""
+    if jax.process_count() == 1:
+        return np.asarray(jax.device_get(x))
+    from jax.experimental import multihost_utils
+
+    full = multihost_utils.process_allgather(x, tiled=True)
+    if jax.process_index() == 0:
+        return np.asarray(full)
+    return None

@@ -1,0 +1,16 @@
+"""Process-0-gated logging (the reference's `me==0 && println` idiom,
+/root/reference/scripts/diffusion_2D_ap.jl:36,44)."""
+
+from __future__ import annotations
+
+import jax
+
+
+def is_main() -> bool:
+    return jax.process_index() == 0
+
+
+def log0(*args, **kwargs):
+    """Print only on process 0 (rank-0 gating)."""
+    if is_main():
+        print(*args, **kwargs, flush=True)

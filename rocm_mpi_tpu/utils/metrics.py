@@ -1,0 +1,66 @@
+"""Walltime timers and the performance metrics (D5, SURVEY.md §5.5).
+
+The reference's one metric is effective memory throughput
+    T_eff = A_eff / wtime_it,  A_eff = (2+1)/1e9 · nx·ny · sizeof(dtype) GB
+(read T + write T2 + read Cp = 3 whole-array passes), with
+    wtime_it = wtime / (nt - warmup)
+excluding 10 warmup iterations (/root/reference/scripts/diffusion_2D_perf.jl:55-58,
+tic/toc at :48,53). The driver's headline metric Gpts/s = nx·ny/wtime_it/1e9
+is the same measurement, hardware-agnostically normalized per grid point.
+
+TPU note: `tic`/`toc` bracket device work with `block_until_ready` — the
+analog of the reference's `wait(signal)` sync before `toc` — because JAX
+dispatch is async.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+
+import jax
+
+
+class Timer:
+    """tic/toc walltime timer (ImplicitGlobalGrid tic()/toc() analog)."""
+
+    def __init__(self):
+        self._t0 = None
+        self.elapsed = None
+
+    def tic(self, *sync):
+        """Start timing. Pass device arrays to sync on first."""
+        for x in sync:
+            jax.block_until_ready(x)
+        self._t0 = time.perf_counter()
+
+    def toc(self, *sync) -> float:
+        """Stop timing (after syncing on `sync`); returns elapsed seconds."""
+        for x in sync:
+            jax.block_until_ready(x)
+        if self._t0 is None:
+            raise RuntimeError("toc() before tic()")
+        self.elapsed = time.perf_counter() - self._t0
+        return self.elapsed
+
+
+def wtime_per_it(wtime: float, nt: int, warmup: int = 10) -> float:
+    """wtime_it = wtime/(nt - warmup) (perf.jl:56)."""
+    if nt <= warmup:
+        raise ValueError(f"nt={nt} must exceed warmup={warmup}")
+    return wtime / (nt - warmup)
+
+
+def a_eff_gb(shape, itemsize: int, n_passes: int = 3) -> float:
+    """A_eff in GB: n_passes whole-array memory passes per step (perf.jl:55)."""
+    return n_passes / 1e9 * math.prod(shape) * itemsize
+
+
+def t_eff_gbs(shape, itemsize: int, wtime_it: float, n_passes: int = 3) -> float:
+    """Effective memory throughput T_eff [GB/s] (perf.jl:57)."""
+    return a_eff_gb(shape, itemsize, n_passes) / wtime_it
+
+
+def gpts_per_s(shape, wtime_it: float) -> float:
+    """Grid points processed per second [Gpts/s] — the driver's metric."""
+    return math.prod(shape) / wtime_it / 1e9

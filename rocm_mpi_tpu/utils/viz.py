@@ -1,0 +1,47 @@
+"""Headless heatmap rendering (D10, reference L5).
+
+The reference renders the gathered global temperature field with Plots.jl/GR
+in headless mode and saves `../output/Temp_<variant>_<nprocs>_<nxg>_<nyg>.png`
+(/root/reference/scripts/diffusion_2D_ap.jl:30,47). Here: matplotlib Agg on
+process 0, same filename scheme, same transpose-for-display convention
+(`heatmap(transpose(T_v))` — axis 0 of the field is x, which matplotlib
+plots vertically unless transposed).
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+import numpy as np
+
+
+def artifact_name(variant: str, nprocs: int, global_shape) -> str:
+    """Temp_<variant>_<nprocs>_<nx_g>_<ny_g>.png (ap.jl:47)."""
+    dims = "_".join(str(n) for n in global_shape)
+    return f"Temp_{variant}_{nprocs}_{dims}.png"
+
+
+def save_heatmap(field, path, title: str | None = None) -> pathlib.Path:
+    """Render `field` (2D, or 3D mid-slice) to `path` as a PNG heatmap."""
+    import matplotlib
+
+    matplotlib.use("Agg")  # headless (GKSwstype="nul" analog, ap.jl:30)
+    import matplotlib.pyplot as plt
+
+    field = np.asarray(field)
+    if field.ndim == 3:
+        field = field[:, :, field.shape[2] // 2]
+    if field.ndim != 2:
+        raise ValueError(f"expected 2D/3D field, got shape {field.shape}")
+
+    path = pathlib.Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fig, ax = plt.subplots(figsize=(6, 5))
+    im = ax.imshow(field.T, origin="lower", cmap="inferno")
+    fig.colorbar(im, ax=ax)
+    if title:
+        ax.set_title(title)
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+    return path
