@@ -24,10 +24,16 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-from jax import lax
+from jax import lax, shard_map
 
 from rocm_mpi_tpu.config import DiffusionConfig
-from rocm_mpi_tpu.ops.diffusion import gaussian_ic, step_flux_form, step_fused
+from rocm_mpi_tpu.ops.diffusion import (
+    gaussian_ic,
+    step_flux_form,
+    step_fused,
+    step_fused_padded,
+)
+from rocm_mpi_tpu.parallel.halo import exchange_halo, global_boundary_mask
 from rocm_mpi_tpu.parallel.mesh import GlobalGrid, init_global_grid
 from rocm_mpi_tpu.utils import metrics
 
@@ -84,6 +90,7 @@ class HeatDiffusion:
         self._step_fns: dict[str, Callable] = {}
         self.register_variant("ap", self._make_jnp_step(step_flux_form))
         self.register_variant("fused", self._make_jnp_step(step_fused))
+        self.register_variant("shard", self._make_shard_step(step_fused_padded))
 
     # ---- state ----------------------------------------------------------
 
@@ -126,6 +133,30 @@ class HeatDiffusion:
 
         return step
 
+    def _make_shard_step(self, padded_update):
+        """Explicit-decomposition step: shard_map + ppermute halo exchange.
+
+        The manual counterpart of "ap": each device exchanges width-1 ghosts
+        with its cartesian neighbors (exchange_halo = update_halo! analog),
+        applies `padded_update` to its block, and Dirichlet-masks global
+        boundary cells. This is the structure the perf/hide ladder builds on.
+        """
+
+        def step(T, Cp, lam, dt, spacing, grid):
+            def local_step(Tl, Cpl):
+                Tp = exchange_halo(Tl, grid)
+                new = padded_update(Tp, Cpl, lam, dt, spacing)
+                return jnp.where(global_boundary_mask(grid), Tl, new)
+
+            return shard_map(
+                local_step,
+                mesh=grid.mesh,
+                in_specs=(grid.spec, grid.spec),
+                out_specs=grid.spec,
+            )(T, Cp)
+
+        return step
+
     def advance_fn(self, variant: str):
         """jitted (T, Cp, n_steps) -> T after n_steps.
 
@@ -162,13 +193,15 @@ class HeatDiffusion:
         warmup = cfg.warmup if warmup is None else warmup
         if not 0 <= warmup < nt:
             raise ValueError(f"need 0 <= warmup < nt, got {warmup}, {nt}")
-        if cfg.halo_transport == "host" and variant in ("ap", "fused"):
+        if cfg.halo_transport == "host":
+            if variant == "shard":
+                return self._run_host_staged(nt, warmup)
             import warnings
 
             warnings.warn(
                 f"halo_transport='host' is not honored by variant '{variant}' "
                 "(global-array formulation; GSPMD owns the communication). "
-                "Use a shard_map variant for the host-staged oracle path.",
+                "Use variant 'shard' for the host-staged oracle path.",
                 stacklevel=2,
             )
         T, Cp = self.init_state()
@@ -180,3 +213,28 @@ class HeatDiffusion:
         T = advance(T, Cp, nt - warmup)
         wtime = timer.toc(T)
         return RunResult(T=T, wtime=wtime, nt=nt, warmup=warmup, config=cfg)
+
+    def _run_host_staged(self, nt: int, warmup: int) -> RunResult:
+        """Debug oracle: numpy stepper with host-staged halos
+        (IGG_ROCMAWARE_MPI=0 analog; parallel.halo.HostStagedStepper)."""
+        import numpy as np
+
+        from rocm_mpi_tpu.parallel.halo import HostStagedStepper
+
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "halo_transport='host' is a single-process debug oracle; it "
+                "needs every shard host-addressable. Run it on one host "
+                "(virtual devices) to bisect transport vs math."
+            )
+        cfg = self.config
+        T, Cp = self.init_state()
+        T_np, Cp_np = np.asarray(T), np.asarray(Cp)
+        stepper = HostStagedStepper(self.grid, cfg.lam, cfg.dt)
+        timer = metrics.Timer()
+        T_np = stepper.run(T_np, Cp_np, warmup)
+        timer.tic()
+        T_np = stepper.run(T_np, Cp_np, nt - warmup)
+        wtime = timer.toc()
+        T_out = jax.device_put(T_np, self.grid.sharding)
+        return RunResult(T=T_out, wtime=wtime, nt=nt, warmup=warmup, config=cfg)

@@ -55,22 +55,33 @@ def step_fused(T, Cp, lam, dt, spacing):
     The jnp expression of the reference's fused memory-bound kernel
     (diffusion_2D_perf.jl:3-13): read the 2·ndim+1-point neighborhood of T,
     write the interior of the output; edge cells pass through unchanged
-    (the kernel's `ix>1 && ix<nx && …` guard).
+    (the kernel's `ix>1 && ix<nx && …` guard). Delegates to
+    `step_fused_padded`, viewing T's own boundary ring as the padding.
     """
-    ndim = T.ndim
-    interior = tuple(slice(1, -1) for _ in range(ndim))
-    lap = jnp.zeros_like(T[interior])
+    interior = tuple(slice(1, -1) for _ in range(T.ndim))
+    return T.at[interior].set(
+        step_fused_padded(T, Cp[interior], lam, dt, spacing)
+    )
+
+
+def step_fused_padded(Tp, Cp, lam, dt, spacing):
+    """Candidate fused update for *every* cell of a block, given its
+    width-1-padded neighborhood `Tp` (shape = Cp.shape + 2 per axis).
+
+    The per-shard form of `step_fused` used under shard_map: ghosts arrive
+    from `parallel.halo.exchange_halo`, and the caller masks out
+    global-boundary cells (Dirichlet). Equivalent of the reference's fused
+    kernel body computed at interior offsets (diffusion_2D_perf.jl:3-13).
+    """
+    ndim = Cp.ndim
+    core = tuple(slice(1, -1) for _ in range(ndim))
+    lap = jnp.zeros_like(Cp)
     for ax in range(ndim):
         d2 = spacing[ax] * spacing[ax]
-        hi = tuple(
-            slice(2, None) if a == ax else slice(1, -1) for a in range(ndim)
-        )
-        lo = tuple(
-            slice(None, -2) if a == ax else slice(1, -1) for a in range(ndim)
-        )
-        lap = lap + (T[hi] - 2.0 * T[interior] + T[lo]) / d2
-    Tnew_in = T[interior] + dt * lam / Cp[interior] * lap
-    return T.at[interior].set(Tnew_in)
+        hi = tuple(slice(2, None) if a == ax else slice(1, -1) for a in range(ndim))
+        lo = tuple(slice(None, -2) if a == ax else slice(1, -1) for a in range(ndim))
+        lap = lap + (Tp[hi] - 2.0 * Tp[core] + Tp[lo]) / d2
+    return Tp[core] + dt * lam / Cp * lap
 
 
 def gaussian_ic(coords, lengths, dtype=None):

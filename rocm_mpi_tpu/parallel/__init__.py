@@ -6,4 +6,10 @@ from rocm_mpi_tpu.parallel.mesh import (  # noqa: F401
     suggest_dims,
 )
 from rocm_mpi_tpu.parallel.gather import gather_to_host0  # noqa: F401
+from rocm_mpi_tpu.parallel.halo import (  # noqa: F401
+    HostStagedStepper,
+    exchange_halo,
+    global_boundary_mask,
+    neighbor_shift,
+)
 from rocm_mpi_tpu.parallel.ring import ring_exchange, ring_exchange_demo  # noqa: F401

@@ -1,0 +1,183 @@
+"""Halo exchange (D2) — `update_halo!` re-designed for the TPU.
+
+Reference behavior: each rank's local array overlaps its cartesian neighbors
+by 2 cells; `update_halo!(T)` refreshes the overlap with MPI point-to-point,
+GPU-direct when IGG_ROCMAWARE_MPI=1, staged through host memory when =0
+(/root/reference/scripts/diffusion_2D_ap.jl:42, scripts/setenv.sh:11-18).
+
+TPU-native design: shards are non-overlapping; ghost cells are *transient*.
+Inside `shard_map`, `exchange_halo(u, grid)` pads every sharded axis of the
+local block with `width` cells fetched from the cartesian neighbors via
+`lax.ppermute` — which XLA lowers to collective-permute riding the ICI, the
+interconnect analog of GPU-direct MPI (no host staging, SURVEY.md §2.4).
+Axes are exchanged sequentially, so the second axis sends slices of the
+already-padded first axis and corner ghosts arrive from diagonal neighbors
+for free (the standard two-stage corner trick).
+
+Non-periodic boundaries: ppermute entries are omitted at the domain edge, so
+edge ghosts arrive as zeros. Their values are never *used*: the global
+boundary cells they would feed are Dirichlet-fixed and masked out by
+`global_boundary_mask` (the reference equivalently never updates
+`T[1,:]`-type cells — ap.jl:41 updates the interior view only).
+
+The host-staged fallback (`HostStagedStepper`, the IGG_ROCMAWARE_MPI=0
+analog) lives here too: a pure-numpy step driver usable as a transport-free
+correctness oracle — "is it the device collective or my math?" (SURVEY.md §4.4).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from rocm_mpi_tpu.parallel.mesh import GlobalGrid
+
+
+def _edge(u, axis: int, side: str, width: int):
+    idx = [slice(None)] * u.ndim
+    idx[axis] = slice(0, width) if side == "lo" else slice(-width, None)
+    return u[tuple(idx)]
+
+
+def neighbor_shift(x, axis_name: str, direction: int):
+    """Send `x` to the neighbor `direction` steps up the mesh axis
+    (non-periodic: edge devices receive zeros)."""
+    n = lax.axis_size(axis_name)
+    if direction == +1:
+        perm = [(i, i + 1) for i in range(n - 1)]
+    elif direction == -1:
+        perm = [(i + 1, i) for i in range(n - 1)]
+    else:
+        raise ValueError("direction must be +1 or -1")
+    return lax.ppermute(x, axis_name, perm)
+
+
+def exchange_halo(u, grid: GlobalGrid, width: int = 1, axes=None):
+    """Pad the local block `u` with neighbor ghost cells (inside shard_map).
+
+    Returns an array grown by 2*width along each exchanged axis. This is the
+    `update_halo!(T)` analog: one call per step, all axes
+    (diffusion_2D_ap.jl:42).
+    """
+    axes = range(grid.ndim) if axes is None else axes
+    for ax in axes:
+        name = grid.axis_names[ax]
+        ghost_lo = neighbor_shift(_edge(u, ax, "hi", width), name, +1)
+        ghost_hi = neighbor_shift(_edge(u, ax, "lo", width), name, -1)
+        u = jnp.concatenate([ghost_lo, u, ghost_hi], axis=ax)
+    return u
+
+
+def global_boundary_mask(grid: GlobalGrid, dtype=bool):
+    """Per-shard mask of global-domain boundary cells (inside shard_map).
+
+    True where the cell lies on the global boundary — the cells the
+    reference never updates (interior-only update, ap.jl:41). Uses
+    `lax.axis_index` to locate the shard in the cartesian topology.
+    """
+    local = grid.local_shape
+    mask = jnp.zeros(local, dtype=bool)
+    for ax, name in enumerate(grid.axis_names):
+        ln = local[ax]
+        n_g = grid.global_shape[ax]
+        gidx = lax.axis_index(name) * ln + lax.broadcasted_iota(
+            jnp.int32, local, ax
+        )
+        mask = mask | (gidx == 0) | (gidx == n_g - 1)
+    return mask.astype(dtype) if dtype is not bool else mask
+
+
+class HostStagedStepper:
+    """Pure-numpy diffusion stepper with explicitly host-staged halos.
+
+    The IGG_ROCMAWARE_MPI=0 analog (README.md:25-35): every step, each
+    shard's boundary slices are copied through host memory to its neighbors'
+    ghost buffers, then each shard is updated independently. Device-free by
+    construction, so any disagreement with the `shard` variant isolates the
+    device collective path — the same bisection affordance the reference's
+    toggle provides. Debug/oracle use only; O(host-memory-bandwidth).
+    """
+
+    def __init__(self, grid: GlobalGrid, lam: float, dt: float):
+        self.grid = grid
+        self.lam = lam
+        self.dt = dt
+
+    def _shard_slices(self, coords) -> tuple[slice, ...]:
+        local = self.grid.local_shape
+        return tuple(
+            slice(c * ln, (c + 1) * ln) for c, ln in zip(coords, local)
+        )
+
+    def step(self, T: np.ndarray, Cp: np.ndarray) -> np.ndarray:
+        grid = self.grid
+        ndim = grid.ndim
+        local = grid.local_shape
+        spacing = grid.spacing
+
+        # Phase 1 — host-staged halo exchange: every shard's padded block is
+        # assembled in host memory, ghost slices read from neighbor shards
+        # (zeros at the domain edge, as in exchange_halo).
+        padded = {}
+        for coords in np.ndindex(*grid.dims):
+            block = np.zeros(
+                tuple(ln + 2 for ln in local), dtype=T.dtype
+            )
+            inner = tuple(slice(1, -1) for _ in range(ndim))
+            core = self._shard_slices(coords)
+            block[inner] = T[core]
+            for ax in range(ndim):
+                for side, nb_off in (("lo", -1), ("hi", +1)):
+                    nb = list(coords)
+                    nb[ax] += nb_off
+                    if not 0 <= nb[ax] < grid.dims[ax]:
+                        continue  # domain edge: ghost stays zero (unused)
+                    nb_core = self._shard_slices(nb)
+                    src = list(nb_core)
+                    dst = [slice(1, 1 + ln) for ln in local]
+                    if nb_off == -1:  # ghost row 0 <- neighbor's last row
+                        src[ax] = slice(nb_core[ax].stop - 1, nb_core[ax].stop)
+                        dst[ax] = slice(0, 1)
+                    else:  # last ghost row <- neighbor's first row
+                        src[ax] = slice(nb_core[ax].start, nb_core[ax].start + 1)
+                        dst[ax] = slice(local[ax] + 1, local[ax] + 2)
+                    block[tuple(dst)] = T[tuple(src)]
+            padded[coords] = block
+
+        # Phase 2 — independent per-shard update (fused stencil), global
+        # boundary cells Dirichlet-fixed.
+        out = np.array(T, copy=True)
+        for coords, block in padded.items():
+            inner = tuple(slice(1, -1) for _ in range(ndim))
+            core = self._shard_slices(coords)
+            lap = np.zeros(local, dtype=T.dtype)
+            for ax in range(ndim):
+                hi_s = tuple(
+                    slice(2, None) if a == ax else slice(1, -1)
+                    for a in range(ndim)
+                )
+                lo_s = tuple(
+                    slice(None, -2) if a == ax else slice(1, -1)
+                    for a in range(ndim)
+                )
+                lap += (block[hi_s] - 2.0 * block[inner] + block[lo_s]) / (
+                    spacing[ax] * spacing[ax]
+                )
+            new = T[core] + self.dt * self.lam / Cp[core] * lap
+            # Dirichlet mask: global boundary cells keep their old values.
+            keep = np.zeros(local, dtype=bool)
+            for ax in range(ndim):
+                gidx = coords[ax] * local[ax] + np.arange(local[ax])
+                edge = (gidx == 0) | (gidx == grid.global_shape[ax] - 1)
+                sh = [1] * ndim
+                sh[ax] = local[ax]
+                keep |= edge.reshape(sh)
+            out[core] = np.where(keep, T[core], new)
+        return out
+
+    def run(self, T: np.ndarray, Cp: np.ndarray, nt: int) -> np.ndarray:
+        for _ in range(nt):
+            T = self.step(T, Cp)
+        return T
