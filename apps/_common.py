@@ -18,17 +18,24 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 OUTPUT_DIR = pathlib.Path(__file__).resolve().parent.parent / "output"
 
 
-def make_parser(variant: str, *, nx: int, ny: int, nt: int, do_vis: bool):
+def make_parser(
+    variant: str, *, nx: int, ny: int, nt: int, do_vis: bool, nz: int = 0
+):
+    ndim = "3D" if nz else "2D"
     p = argparse.ArgumentParser(
-        description=f"2D heat diffusion — {variant} variant"
+        description=f"{ndim} heat diffusion — {variant} variant"
     )
     p.add_argument("--nx", type=int, default=nx, help="global grid points, x")
     p.add_argument("--ny", type=int, default=ny, help="global grid points, y")
     p.add_argument(
+        "--nz", type=int, default=nz, help="global grid points, z (0 = 2D)"
+    )
+    p.add_argument(
         "--fact",
         type=int,
         default=0,
-        help="if set, nx=ny=fact*1024 (perf.jl:21 'fact' knob)",
+        help="if set, every grid axis becomes fact*1024 "
+        "(perf.jl:21 'fact' knob; in 3D this includes nz)",
     )
     p.add_argument("--nt", type=int, default=nt, help="time steps")
     p.add_argument("--warmup", type=int, default=10, help="untimed steps")
@@ -79,9 +86,14 @@ def build_config(args):
     kwargs = {}
     if args.transport:
         kwargs["halo_transport"] = args.transport
+    if getattr(args, "b_width", None):
+        kwargs["b_width"] = tuple(int(b) for b in args.b_width.split(","))
+    shape = (args.nx, args.ny)
+    if getattr(args, "nz", 0):
+        shape += (args.nz,)
     cfg = DiffusionConfig(
-        global_shape=(args.nx, args.ny),
-        lengths=(10.0, 10.0),
+        global_shape=shape,
+        lengths=(10.0,) * len(shape),
         nt=args.nt,
         warmup=args.warmup,
         dtype=args.dtype,

@@ -1,0 +1,19 @@
+"""2D heat diffusion — kernel-programming variant (C2 analog).
+
+The hand-written-kernels rung of the ladder
+(/root/reference/scripts/diffusion_2D_kp.jl): the step is three separate
+Pallas kernels (Flux → Residual → Update) with the reference's staggered
+flux-grid shapes, instead of C1's array ops or C3's single fused kernel.
+Reference defaults: 128², 1000 steps, heatmap artifact.
+
+  python apps/diffusion_2d_kp.py --cpu-devices 4
+  python apps/diffusion_2d_kp.py --dtype f32          # single real chip
+"""
+
+import sys
+
+from _common import make_parser, run_app
+
+if __name__ == "__main__":
+    args = make_parser("kp", nx=128, ny=128, nt=1000, do_vis=True).parse_args()
+    sys.exit(run_app("kp", args))

@@ -1,0 +1,24 @@
+"""2D heat diffusion — fused-kernel performance variant (C3 analog).
+
+The memory-bound rung of the ladder
+(/root/reference/scripts/diffusion_2D_perf.jl): a single fused Pallas
+stencil kernel per step (row-striped through VMEM for large grids),
+double-buffered via XLA buffer donation instead of an explicit T/T2 swap,
+explicit ppermute halo exchange when sharded, and the T_eff/Gpts printout on
+warmup-excluded timing. Reference defaults: fact=12 → 12288² grid, 1000
+steps. dtype defaults to f32 (the TPU fast path; Mosaic has no f64 — use
+--dtype f64 on CPU meshes for parity runs).
+
+  python apps/diffusion_2d_perf.py                      # 12288², real chip
+  python apps/diffusion_2d_perf.py --fact 2 --cpu-devices 4 --dtype f64
+"""
+
+import sys
+
+from _common import make_parser, run_app
+
+if __name__ == "__main__":
+    parser = make_parser("perf", nx=12288, ny=12288, nt=1000, do_vis=False)
+    parser.set_defaults(dtype="f32")
+    args = parser.parse_args()
+    sys.exit(run_app("perf", args))

@@ -1,0 +1,30 @@
+"""3D heat diffusion — communication/computation overlap variant.
+
+The 3D weak-scaling target (driver BASELINE.json: 128³ per chip, 6-neighbor
+halo, v4-32). The reference suite is 2D-only; this is its natural extension
+on the same machinery: the N-D halo exchange (6 face ppermutes with edge/
+corner ghosts via the sequential-axis trick), the N-D overlap step (boundary
+shell slabs + ghost-free interior, exchange hidden behind interior compute),
+and the same fused Pallas stencil (7-point in 3D, plane-striped through VMEM
+for blocks over budget).
+
+  python apps/diffusion_3d_perf_hide.py --cpu-devices 8     # 2x2x2 mesh
+  python apps/diffusion_3d_perf_hide.py --nx 256 --ny 256 --nz 256
+"""
+
+import sys
+
+from _common import make_parser, run_app
+
+if __name__ == "__main__":
+    parser = make_parser(
+        "hide", nx=128, ny=128, nz=128, nt=100, do_vis=False
+    )
+    parser.set_defaults(dtype="f32")
+    parser.add_argument(
+        "--b-width",
+        default="8,8,128",
+        help="boundary shell width bx,by,bz (clamped to shard/2)",
+    )
+    args = parser.parse_args()
+    sys.exit(run_app("hide", args))

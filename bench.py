@@ -1,0 +1,62 @@
+"""Framework benchmark — prints ONE JSON line.
+
+Headline metric (driver BASELINE.json): Gpts/s/chip for 2D heat diffusion at
+252² per chip — the reference's acceptance-run geometry (4 ranks × 126²
+inner = global 252², docs/Temp_4_252_252.png) measured with the reference's
+warmup-excluded timing (wtime/(nt-warmup), diffusion_2D_perf.jl:48-56).
+
+Path benchmarked: the VMEM-resident multi-step Pallas kernel — at 252² the
+whole field lives on-chip, so the entire time loop runs inside one kernel
+(rocm_mpi_tpu.ops.pallas_kernels.fused_multi_step). dtype f32 (the TPU-native
+choice; Mosaic has no f64 — the reference's f64 was the GPU-native choice).
+
+vs_baseline: the reference publishes no numbers (BASELINE.md). The divisor is
+an *estimate* of the reference's fused-kernel rate on one MI50: peak HBM BW
+1024 GB/s × ~70% achievable for a memory-bound stencil ≈ 717 GB/s T_eff,
+A_eff = 24 B/point (3 f64 passes, perf.jl:55) → ≈ 29.9 Gpts/s/GPU.
+"""
+
+import json
+import sys
+
+REF_ESTIMATE_GPTS = 29.9  # estimated MI50 fused-kernel rate (see docstring)
+
+
+def main() -> int:
+    from rocm_mpi_tpu.config import DiffusionConfig
+    from rocm_mpi_tpu.models import HeatDiffusion
+
+    cfg = DiffusionConfig(
+        global_shape=(252, 252),
+        lengths=(10.0, 10.0),
+        nt=10_000,
+        warmup=1_000,
+        dtype="f32",
+        dims=(1, 1),
+    )
+    model = HeatDiffusion(cfg)
+    # One throwaway run to warm every compile cache, then the measured run.
+    model.run_vmem_resident(nt=200, warmup=100)
+    result = model.run_vmem_resident()
+    gpts = result.gpts
+    print(
+        f"252²/chip f32: {result.nt - result.warmup} timed steps, "
+        f"{result.wtime_it * 1e6:.3f} µs/step, T_eff={result.t_eff:.1f} GB/s "
+        f"(VMEM-resident; HBM-equivalent figure)",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "Gpts/s/chip (2D diffusion, 252²/chip)",
+                "value": round(gpts, 4),
+                "unit": "Gpts/s",
+                "vs_baseline": round(gpts / REF_ESTIMATE_GPTS, 4),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

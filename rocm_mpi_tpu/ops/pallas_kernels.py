@@ -1,0 +1,360 @@
+"""Pallas TPU stencil kernels (D7) — the hand-tuned rungs of the ladder.
+
+TPU-native re-design of the reference's hand-written GPU kernels:
+
+* `fused_step_padded` — the fused memory-bound diffusion kernel
+  (/root/reference/scripts/diffusion_2D_perf.jl:3-13). Whole-block-in-VMEM
+  for shard sizes that fit (the 252²/chip benchmark regime: the entire field
+  lives on-chip), row-striped with a 3-slot neighbor-block trick for large
+  single-chip grids (the 12288² regime), pipelining HBM→VMEM stripe loads
+  against VPU compute.
+* `fused_multi_step` — a TPU-only optimization with no reference analog:
+  when the whole field fits in VMEM, run the *entire time loop inside one
+  kernel*, never spilling T to HBM between steps. The reference pays 3
+  whole-array HBM passes per step by construction; on TPU the memory-bound
+  assumption dissolves for VMEM-resident fields.
+
+The `gridsize`-is-workitems convention of `@roc` does not carry over: Pallas
+grids count *blocks* (SURVEY.md §7 hard-parts note). The reference's
+`threads=(32,8)` tuning knob maps to the stripe height `tm` here.
+
+f64 note: Mosaic (the TPU Pallas compiler) does not support f64; the f64
+parity path uses these kernels in interpreter mode (tests) or the jnp
+step functions (production), per SURVEY.md §7 "f64 on TPU".
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Whole-block kernels hold ~5 block-sized buffers in VMEM; stay well under
+# the ~16 MB/core budget (pallas_guide.md "Memory Hierarchy").
+_VMEM_BLOCK_BUDGET_BYTES = 2 * 1024 * 1024
+
+
+def _supports_compiled(dtype) -> bool:
+    return jnp.dtype(dtype).itemsize <= 4
+
+
+def _out_struct(shape, exemplar):
+    """ShapeDtypeStruct matching `exemplar`'s dtype and mesh-varying axes.
+
+    Inside shard_map (jax>=0.9 check_vma), pallas_call outputs must declare
+    which mesh axes they vary over; propagate the input's vma set.
+    """
+    return jax.ShapeDtypeStruct(
+        shape, exemplar.dtype, vma=jax.typeof(exemplar).vma
+    )
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _lap_from_padded(Tp, inv_d2):
+    """Σ_ax (hi - 2·c + lo)/dx² from a width-1-padded block (5/7-point)."""
+    ndim = Tp.ndim
+    core = tuple(slice(1, -1) for _ in range(ndim))
+    lap = None
+    for ax in range(ndim):
+        hi = tuple(slice(2, None) if a == ax else slice(1, -1) for a in range(ndim))
+        lo = tuple(slice(None, -2) if a == ax else slice(1, -1) for a in range(ndim))
+        term = (Tp[hi] - 2.0 * Tp[core] + Tp[lo]) * inv_d2[ax]
+        lap = term if lap is None else lap + term
+    return lap
+
+
+# ---------------------------------------------------------------------------
+# Whole-block kernel: core update from a padded block (shard fits in VMEM).
+# ---------------------------------------------------------------------------
+
+
+def _fused_kernel_whole(Tp_ref, Cp_ref, out_ref, *, lam, dt, inv_d2):
+    Tp = Tp_ref[:]
+    core = tuple(slice(1, -1) for _ in range(Tp.ndim))
+    out_ref[:] = Tp[core] + (dt * lam) / Cp_ref[:] * _lap_from_padded(Tp, inv_d2)
+
+
+def fused_step_padded(Tp, Cp, lam, dt, spacing, interpret=None):
+    """Candidate update for every core cell given the padded block `Tp`.
+
+    Pallas counterpart of ops.diffusion.step_fused_padded (same contract:
+    caller supplies ghosts via halo.exchange_halo and masks global-boundary
+    cells). Dispatches whole-block vs row-striped by VMEM footprint.
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    if not _supports_compiled(Tp.dtype) and not interpret:
+        raise TypeError(
+            f"Mosaic does not support {Tp.dtype}; use the jnp path or "
+            "interpret mode for f64 parity runs"
+        )
+    # Bake scalars into the kernel as Python floats (captured jnp scalars
+    # are rejected by pallas_call; physics constants are static anyway).
+    lam, dt = float(lam), float(dt)
+    inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
+    nbytes = Cp.size * Cp.dtype.itemsize
+    if Tp.ndim in (2, 3) and nbytes > _VMEM_BLOCK_BUDGET_BYTES:
+        return _fused_step_striped(Tp, Cp, lam, dt, inv_d2, interpret)
+    kernel = functools.partial(
+        _fused_kernel_whole, lam=lam, dt=dt, inv_d2=inv_d2
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=_out_struct(Cp.shape, Cp),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(Tp, Cp)
+
+
+# ---------------------------------------------------------------------------
+# Row-striped kernel for large 2D grids: 3-slot neighbor-block trick.
+# Output stripe i (tm rows of the core) reads padded rows [i·tm, i·tm+tm+2),
+# assembled from padded row-blocks i and i+1 — overlapping windows built
+# from non-overlapping BlockSpecs.
+# ---------------------------------------------------------------------------
+
+
+def _fused_kernel_striped(Ta_ref, Tb_ref, Cp_ref, out_ref, *, lam, dt, inv_d2):
+    Ta = Ta_ref[:]  # padded rows [i·tm, i·tm+tm)
+    Tb = Tb_ref[:]  # padded rows [i·tm+tm, i·tm+2·tm); last block is partial
+    # `ext` is a fully padded block for this output stripe: padded along
+    # axis 0 by the stripe overlap, along the rest by Tp's own pad ring.
+    ext = jnp.concatenate([Ta, Tb[:2]], axis=0)  # rows [i·tm, i·tm+tm+2)
+    core = tuple(slice(1, -1) for _ in range(ext.ndim))
+    out_ref[:] = ext[core] + (dt * lam) / Cp_ref[:] * _lap_from_padded(
+        ext, inv_d2
+    )
+
+
+def _pick_tm(n_rows: int, row_elems: int, itemsize: int) -> int:
+    """Stripe height: largest divisor of `n_rows` that keeps one stripe
+    (`row_elems` elements per padded row) within the per-buffer VMEM budget
+    (~6 stripe-sized buffers live at once with pipelining). The analog of
+    the reference's `threads=(32,8)` tile knob (perf.jl:23), chosen
+    automatically."""
+    per_buffer = _VMEM_BLOCK_BUDGET_BYTES
+    target = max(8, per_buffer // max(1, row_elems * itemsize))
+    best = 1
+    for d in range(1, min(n_rows, target) + 1):
+        if n_rows % d == 0 and (d % 8 == 0 or best < 8):
+            best = max(best, d)
+    return best
+
+
+def _fused_step_striped(Tp, Cp, lam, dt, inv_d2, interpret):
+    core = Cp.shape  # Tp is core + 2 per axis
+    n1, rest = core[0], core[1:]
+    rest_p = tuple(n + 2 for n in rest)
+    row_bytes = 1
+    for n in rest_p:
+        row_bytes *= n
+    tm = _pick_tm(n1, row_bytes, Cp.dtype.itemsize)
+    grid = (n1 // tm,)
+    kernel = functools.partial(
+        _fused_kernel_striped, lam=lam, dt=dt, inv_d2=inv_d2
+    )
+    zeros = (0,) * len(rest)
+    return pl.pallas_call(
+        kernel,
+        out_shape=_out_struct(core, Cp),
+        grid=grid,
+        in_specs=[
+            # Padded row-block i (height tm, full padded extent elsewhere).
+            pl.BlockSpec(
+                (tm,) + rest_p, lambda i: (i,) + zeros, memory_space=pltpu.VMEM
+            ),
+            # Padded row-block i+1: only its first 2 rows are read. For the
+            # last stripe this block starts at padded row n1, which exists
+            # (the pad ring supplies rows n1, n1+1); its out-of-range tail
+            # is Pallas-masked and never read.
+            pl.BlockSpec(
+                (tm,) + rest_p,
+                lambda i: (i + 1,) + zeros,
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (tm,) + rest, lambda i: (i,) + zeros, memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (tm,) + rest, lambda i: (i,) + zeros, memory_space=pltpu.VMEM
+        ),
+        interpret=interpret,
+    )(Tp, Tp, Cp)
+
+
+# ---------------------------------------------------------------------------
+# kp rung: three separate kernels with staggered-grid shapes — the
+# kernel-programming teaching ladder of the reference
+# (/root/reference/scripts/diffusion_2D_kp.jl: Flux! :16-26, Residual!
+# :33-40, Update! :47-54), with the same staggered shapes qx=(nx-1,ny-2),
+# qy=(nx-2,ny-1), dTdt=(nx-2,ny-2) (scripts/diffusion_2D_ap.jl:22-24).
+# Expressed against a width-1-padded block so the same contract serves
+# single-device and shard_map use. Whole-array VMEM kernels: the kp rung
+# runs 128²-class grids (kp.jl:62); its point is pedagogy and the
+# 3-sync-per-step cost the fused rung removes, not scale.
+# ---------------------------------------------------------------------------
+
+
+def _flux_kernel(Tp_ref, qx_ref, qy_ref, *, lam, inv_d):
+    # Fourier's law on the staggered grid: q = -λ ∂T (kp.jl Flux!).
+    Tp = Tp_ref[:]
+    qx_ref[:] = -lam * (Tp[1:, 1:-1] - Tp[:-1, 1:-1]) * inv_d[0]
+    qy_ref[:] = -lam * (Tp[1:-1, 1:] - Tp[1:-1, :-1]) * inv_d[1]
+
+
+def _residual_kernel(qx_ref, qy_ref, Cp_ref, dTdt_ref, *, inv_d):
+    # Conservation of energy: ∂T/∂t = 1/cₚ(-∇·q) (kp.jl Residual!).
+    qx, qy = qx_ref[:], qy_ref[:]
+    div = (qx[1:, :] - qx[:-1, :]) * inv_d[0] + (
+        qy[:, 1:] - qy[:, :-1]
+    ) * inv_d[1]
+    dTdt_ref[:] = -div / Cp_ref[:]
+
+
+def _update_kernel(Tp_ref, dTdt_ref, out_ref, *, dt):
+    # Temperature update: T_new = T_old + dt·∂T/∂t (kp.jl Update!).
+    out_ref[:] = Tp_ref[1:-1, 1:-1] + dt * dTdt_ref[:]
+
+
+def kp_step_padded(Tp, Cp, lam, dt, spacing, interpret=None):
+    """Candidate core update via the 3-kernel ladder (kp variant).
+
+    Same contract as fused_step_padded but as three separate device
+    programs per step — reproducing the reference kp rung's structure
+    (three launches + three syncs, kp.jl:87-92) to make the fused rung's
+    win measurable.
+    """
+    if Cp.ndim != 2:
+        raise ValueError(
+            "the kp ladder rung is 2D-only (as is the reference's kp app); "
+            "use variants 'perf'/'hide' for 3D grids"
+        )
+    if interpret is None:
+        interpret = _interpret_default()
+    if not _supports_compiled(Tp.dtype) and not interpret:
+        raise TypeError(f"Mosaic does not support {Tp.dtype}")
+    lam, dt = float(lam), float(dt)
+    inv_d = tuple(1.0 / float(d) for d in spacing)
+    lx, ly = Cp.shape  # core shape; Tp is (lx+2, ly+2)
+
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    qx, qy = pl.pallas_call(
+        functools.partial(_flux_kernel, lam=lam, inv_d=inv_d),
+        out_shape=(
+            _out_struct((lx + 1, ly), Tp),
+            _out_struct((lx, ly + 1), Tp),
+        ),
+        in_specs=[vmem],
+        out_specs=(vmem, vmem),
+        interpret=interpret,
+    )(Tp)
+    dTdt = pl.pallas_call(
+        functools.partial(_residual_kernel, inv_d=inv_d),
+        out_shape=_out_struct((lx, ly), Cp),
+        in_specs=[vmem, vmem, vmem],
+        out_specs=vmem,
+        interpret=interpret,
+    )(qx, qy, Cp)
+    return pl.pallas_call(
+        functools.partial(_update_kernel, dt=dt),
+        out_shape=_out_struct((lx, ly), Cp),
+        in_specs=[vmem, vmem],
+        out_specs=vmem,
+        interpret=interpret,
+    )(Tp, dTdt)
+
+
+# ---------------------------------------------------------------------------
+# Whole-loop-in-VMEM kernel: nt steps without touching HBM (single shard).
+# ---------------------------------------------------------------------------
+
+
+def _multi_step_kernel(T_ref, Cp_ref, out_ref, *, lam, dt, inv_d2, chunk):
+    shape = T_ref.shape
+    ndim = len(shape)
+    # Dirichlet edge mask of the *block* — for the single-shard use this IS
+    # the global boundary (the reference's interior-only guard, perf.jl:7).
+    mask = None
+    for ax in range(ndim):
+        idx = lax.broadcasted_iota(jnp.int32, shape, ax)
+        m = (idx == 0) | (idx == shape[ax] - 1)
+        mask = m if mask is None else (mask | m)
+    Cp_inv = (dt * lam) / Cp_ref[:]
+
+    def body(_, T):
+        padded = jnp.pad(T, 1)  # zero ghosts; edge cells masked anyway
+        new = padded[tuple(slice(1, -1) for _ in range(ndim))] + Cp_inv * (
+            _lap_from_padded(padded, inv_d2)
+        )
+        return jnp.where(mask, T, new)
+
+    out_ref[:] = lax.fori_loop(0, chunk, body, T_ref[:])
+
+
+DEFAULT_STEP_CHUNK = 32
+
+
+def fused_multi_step(T, Cp, lam, dt, spacing, n_steps, chunk=None, interpret=None):
+    """Advance a *single-shard* field `n_steps` barely leaving VMEM.
+
+    TPU-only optimization (no reference analog — the GPU version must round-
+    trip HBM every step): the kernel runs `chunk` steps per invocation with
+    the field VMEM-resident, and an outer XLA loop repeats it — one HBM
+    round-trip every `chunk` steps instead of 3 whole-array passes per step.
+    `chunk` is static (Mosaic compile time scales with it; a dynamic
+    in-kernel trip count stalls the compiler) and must divide `n_steps`;
+    default gcd(n_steps, 32). The outer trip count is dynamic, so one
+    compiled program serves every `n_steps` with the same chunk. Global
+    boundary = block boundary (Dirichlet).
+    """
+    import math
+
+    if interpret is None:
+        interpret = _interpret_default()
+    if not _supports_compiled(T.dtype) and not interpret:
+        raise TypeError(f"Mosaic does not support {T.dtype}")
+    nbytes = T.size * T.dtype.itemsize
+    if nbytes > _VMEM_BLOCK_BUDGET_BYTES:
+        raise ValueError(
+            f"field of {nbytes} bytes exceeds the VMEM-resident budget "
+            f"({_VMEM_BLOCK_BUDGET_BYTES}); use the per-step path"
+        )
+    n_static = isinstance(n_steps, int)
+    if chunk is None:
+        chunk = (
+            math.gcd(n_steps, DEFAULT_STEP_CHUNK) if n_static else DEFAULT_STEP_CHUNK
+        )
+    if n_static and n_steps % chunk != 0:
+        raise ValueError(f"chunk {chunk} must divide n_steps {n_steps}")
+    lam, dt = float(lam), float(dt)
+    inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
+    kernel = functools.partial(
+        _multi_step_kernel, lam=lam, dt=dt, inv_d2=inv_d2, chunk=chunk
+    )
+    run_chunk = pl.pallas_call(
+        kernel,
+        out_shape=_out_struct(T.shape, T),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )
+    # For traced n_steps divisibility can't be checked at trace time: the
+    # trip count floors, so a non-multiple silently rounds DOWN to the
+    # nearest chunk — callers with dynamic n must guarantee divisibility
+    # (run_vmem_resident does, via gcd).
+    return lax.fori_loop(0, n_steps // chunk, lambda _, x: run_chunk(x, Cp), T)

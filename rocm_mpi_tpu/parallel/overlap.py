@@ -1,0 +1,105 @@
+"""Communication/computation overlap (D8) — the perf_hide rung, working.
+
+Reference intent (/root/reference/scripts/diffusion_2D_perf_hide.jl): split
+the update into a boundary frame of width `b_width` computed on a
+HIGH-priority HSA queue and an interior computed on a LOW-priority queue,
+with `update_halo!` issued between the two waits so the exchange hides
+behind interior compute. The shipped code never got there: its active
+variant (2) under-covers the frame and skips the halo entirely, and the true
+overlap variant (3) is commented "not ready yet" (hide.jl:84-101;
+SURVEY.md §3.4 caveat). This module implements variant (3)'s *semantics* —
+for any number of dimensions (2D frame, 3D shell) — and lets XLA's
+latency-hiding scheduler do the queue juggling:
+
+Per step, inside one shard_map program:
+  1. `ppermute` the current field's edge slices to the cartesian neighbors
+     (the halo exchange) — depends only on the field's edges;
+  2. update the interior region — depends on NO ghost value, so XLA is free
+     to run the collective and the interior compute concurrently (this
+     dataflow independence is the whole trick: no user-visible queues,
+     priorities, or signals — SURVEY.md §2.2 D8);
+  3. update the boundary slabs once their ghosts arrive;
+  4. splice slabs + interior, Dirichlet-mask the global edge.
+
+Unlike the reference's two-queue scheme, correctness never rests on manual
+signal ordering (hide.jl:69,86-90): the schedule is derived from dataflow,
+so there is nothing to race (SURVEY.md §5.2).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+
+from rocm_mpi_tpu.parallel.halo import exchange_halo, global_boundary_mask
+from rocm_mpi_tpu.parallel.mesh import GlobalGrid
+
+
+def effective_b_width(local_shape, b_width) -> tuple[int, ...]:
+    """Clamp the boundary-frame width per axis to at most half the shard
+    (the reference's b_width=(32,4) knob, hide.jl:42, made shape-safe).
+    A short b_width tuple is extended by repeating its last entry (so the
+    2D default applies to 3D grids)."""
+    b_width = tuple(b_width)
+    if len(b_width) < len(local_shape):
+        b_width = b_width + (b_width[-1],) * (len(local_shape) - len(b_width))
+    return tuple(
+        max(1, min(int(b), ln // 2)) for b, ln in zip(b_width, local_shape)
+    )
+
+
+def make_overlap_step(
+    grid: GlobalGrid,
+    padded_update: Callable,
+    b_width: tuple[int, ...],
+):
+    """Build the shard-local overlap step (any ndim).
+
+    `padded_update(Tp, Cp, lam, dt, spacing)` is any core-update kernel with
+    the padded contract (jnp or Pallas). Returns
+    `local_step(Tl, Cpl, lam, dt, spacing) -> Tl_new`.
+
+    The shard is decomposed axis-by-axis into boundary slabs and one
+    interior box: axis 0 contributes the first/last `b` rows (full extent
+    elsewhere), axis 1 the first/last `b` columns of the remaining middle,
+    and so on; the innermost box is the ghost-free interior. Only the
+    axis-0/…​ slabs read exchanged ghosts — the interior reads purely local
+    data, which is what makes the exchange hideable.
+    """
+    local = grid.local_shape
+    ndim = grid.ndim
+    bw = effective_b_width(local, b_width)
+
+    def local_step(Tl, Cpl, lam, dt, spacing):
+        # (1) halo exchange of the current field — edge-slice ppermutes.
+        Tp = exchange_halo(Tl, grid)  # core + 2 per axis
+
+        def region(bounds):
+            """Candidate update of the core box given by `bounds`
+            (per-axis (lo, hi) core ranges), read from the padded field."""
+            tp = Tp[tuple(slice(lo, hi + 2) for lo, hi in bounds)]
+            cp = Cpl[tuple(slice(lo, hi) for lo, hi in bounds)]
+            return padded_update(tp, cp, lam, dt, spacing)
+
+        def build(axis, prefix):
+            """Assemble the box whose axes < `axis` are already restricted
+            to their middles (`prefix` bounds) and axes ≥ `axis` are full."""
+            if axis == ndim:
+                # (2) the interior: no ghost dependence → overlappable.
+                return region(prefix)
+            n, b = local[axis], bw[axis]
+            rest = [(0, local[a]) for a in range(axis + 1, ndim)]
+            lo_slab = region(prefix + [(0, b)] + rest)  # (3) reads ghosts
+            hi_slab = region(prefix + [(n - b, n)] + rest)
+            parts = [lo_slab]
+            if n - 2 * b > 0:
+                parts.append(build(axis + 1, prefix + [(b, n - b)]))
+            parts.append(hi_slab)
+            return jnp.concatenate(parts, axis=axis)
+
+        new = build(0, [])
+        # (4) Dirichlet: global-domain edge cells never change.
+        return jnp.where(global_boundary_mask(grid), Tl, new)
+
+    return local_step

@@ -1,0 +1,73 @@
+"""Overlap (perf_hide) correctness: the variant-(3) semantics the reference
+never shipped must agree with every other rung (SURVEY.md §3.4, §4b)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from rocm_mpi_tpu.config import DiffusionConfig
+from rocm_mpi_tpu.models import HeatDiffusion
+from rocm_mpi_tpu.parallel.overlap import effective_b_width
+
+
+def _compare(cfg, ref_variant="ap", rtol=1e-13):
+    model = HeatDiffusion(cfg)
+    res_h = model.run(variant="hide")
+    res_r = model.run(variant=ref_variant)
+    np.testing.assert_allclose(
+        np.asarray(res_h.T), np.asarray(res_r.T), rtol=rtol, atol=1e-15
+    )
+
+
+def test_hide_matches_ap_f64_mesh():
+    _compare(
+        DiffusionConfig(
+            global_shape=(64, 64), nt=40, warmup=0, dims=(4, 2), b_width=(4, 4)
+        )
+    )
+
+
+def test_hide_matches_ap_f32_pallas_strips():
+    cfg = DiffusionConfig(
+        global_shape=(64, 64), nt=30, warmup=0, dims=(2, 2),
+        b_width=(8, 8), dtype="f32",
+    )
+    model = HeatDiffusion(cfg)
+    res_h = model.run(variant="hide")
+    res_p = model.run(variant="perf")
+    np.testing.assert_allclose(
+        np.asarray(res_h.T), np.asarray(res_p.T), rtol=1e-6, atol=1e-7
+    )
+
+
+def test_hide_with_reference_b_width_clamped():
+    # Reference b_width=(32,4) on shards smaller than the frame: clamp.
+    _compare(
+        DiffusionConfig(
+            global_shape=(32, 32), nt=20, warmup=0, dims=(4, 2), b_width=(32, 4)
+        )
+    )
+
+
+def test_hide_strips_cover_whole_shard():
+    # b_width == shard/2: interior is empty; strips must tile exactly.
+    _compare(
+        DiffusionConfig(
+            global_shape=(32, 32), nt=10, warmup=0, dims=(2, 2), b_width=(8, 8)
+        )
+    )
+
+
+def test_effective_b_width():
+    assert effective_b_width((64, 64), (32, 4)) == (32, 4)
+    assert effective_b_width((16, 64), (32, 4)) == (8, 4)
+    assert effective_b_width((3, 3), (32, 32)) == (1, 1)
+
+
+def test_hide_single_device():
+    _compare(
+        DiffusionConfig(
+            global_shape=(48, 48), nt=25, warmup=0, dims=(1, 1), b_width=(4, 4)
+        )
+    )
