@@ -69,6 +69,9 @@ def make_parser(
 def setup_jax(args):
     import jax
 
+    from rocm_mpi_tpu.parallel.distributed import maybe_initialize_distributed
+
+    maybe_initialize_distributed()
     if args.cpu_devices:
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", args.cpu_devices)

@@ -160,6 +160,23 @@ def _fused_step_striped(Tp, Cp, lam, dt, inv_d2, interpret):
     for n in rest_p:
         row_bytes *= n
     tm = _pick_tm(n1, row_bytes, Cp.dtype.itemsize)
+    if tm < 2:
+        # The stripe overlap reads two rows of the next block, so tm >= 2 is
+        # structural. A prime row count has no usable divisor: fall back to
+        # the whole-block kernel (correct; may stress VMEM on huge grids).
+        kernel = functools.partial(
+            _fused_kernel_whole, lam=lam, dt=dt, inv_d2=inv_d2
+        )
+        return pl.pallas_call(
+            kernel,
+            out_shape=_out_struct(core, Cp),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            interpret=interpret,
+        )(Tp, Cp)
     grid = (n1 // tm,)
     kernel = functools.partial(
         _fused_kernel_striped, lam=lam, dt=dt, inv_d2=inv_d2

@@ -1,0 +1,42 @@
+"""Multi-host process wiring (D9) — the launch-layer analog.
+
+Reference: processes are created by Slurm (`srun -n N --mpi=pmix`), wired
+into MPI_COMM_WORLD by PMIx, and each rank binds one GPU via the node-local
+communicator split (/root/reference/README.md:18,
+scripts/rocmaware_test_selectdevice.jl:7-9; SURVEY.md §2.2 D9).
+
+TPU-native: one process per host, `jax.distributed.initialize()` discovers
+the pod slice (coordinator/process env comes from the TPU runtime or the
+launcher), and every local chip is bound automatically — there is no manual
+device selection to do. Cross-host collectives ride DCN, intra-slice ride
+ICI. `scripts/run.sh` sets RMT_DISTRIBUTED=1 on multi-host launches, the
+runme.sh analog.
+"""
+
+from __future__ import annotations
+
+import os
+
+_initialized = False
+
+
+def maybe_initialize_distributed() -> bool:
+    """Call jax.distributed.initialize() when a multi-host launch is
+    requested (RMT_DISTRIBUTED=1, or explicit JAX coordinator env).
+
+    Idempotent; returns True when running in (or just joined) a multi-host
+    setup. Single-host runs are a no-op — the reference's single-node case.
+    """
+    global _initialized
+    import jax
+
+    if _initialized:
+        return True
+    want = os.environ.get("RMT_DISTRIBUTED") == "1" or (
+        "JAX_COORDINATOR_ADDRESS" in os.environ
+    )
+    if not want:
+        return False
+    jax.distributed.initialize()
+    _initialized = True
+    return True

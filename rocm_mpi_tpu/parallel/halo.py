@@ -100,10 +100,17 @@ class HostStagedStepper:
     toggle provides. Debug/oracle use only; O(host-memory-bandwidth).
     """
 
-    def __init__(self, grid: GlobalGrid, lam: float, dt: float):
+    def __init__(
+        self, grid: GlobalGrid, lam: float, dt: float, use_native: bool | None = None
+    ):
         self.grid = grid
         self.lam = lam
         self.dt = dt
+        if use_native is None:
+            from rocm_mpi_tpu.parallel import native_halo
+
+            use_native = native_halo.available() and grid.ndim <= 3
+        self.use_native = use_native
 
     def _shard_slices(self, coords) -> tuple[slice, ...]:
         local = self.grid.local_shape
@@ -112,6 +119,18 @@ class HostStagedStepper:
         )
 
     def step(self, T: np.ndarray, Cp: np.ndarray) -> np.ndarray:
+        """One host-staged step. Dispatches to the native C++ engine
+        (native/halostage.cpp, bit-identical, multithreaded) when built;
+        falls back to the readable numpy implementation below."""
+        if self.use_native and T.dtype == np.float64:
+            from rocm_mpi_tpu.parallel import native_halo
+
+            return native_halo.host_staged_step(
+                T, Cp, self.grid.dims, self.grid.spacing, self.lam, self.dt
+            )
+        return self.step_python(T, Cp)
+
+    def step_python(self, T: np.ndarray, Cp: np.ndarray) -> np.ndarray:
         grid = self.grid
         ndim = grid.ndim
         local = grid.local_shape
@@ -147,7 +166,10 @@ class HostStagedStepper:
             padded[coords] = block
 
         # Phase 2 — independent per-shard update (fused stencil), global
-        # boundary cells Dirichlet-fixed.
+        # boundary cells Dirichlet-fixed. Multiply by the precomputed
+        # reciprocal (not divide) so results are bit-identical to the native
+        # engine (native/halostage.cpp) and the Pallas kernels.
+        inv_d2 = tuple(1.0 / (d * d) for d in spacing)
         out = np.array(T, copy=True)
         for coords, block in padded.items():
             inner = tuple(slice(1, -1) for _ in range(ndim))
@@ -162,9 +184,9 @@ class HostStagedStepper:
                     slice(None, -2) if a == ax else slice(1, -1)
                     for a in range(ndim)
                 )
-                lap += (block[hi_s] - 2.0 * block[inner] + block[lo_s]) / (
-                    spacing[ax] * spacing[ax]
-                )
+                lap += (
+                    block[hi_s] - 2.0 * block[inner] + block[lo_s]
+                ) * inv_d2[ax]
             new = T[core] + self.dt * self.lam / Cp[core] * lap
             # Dirichlet mask: global boundary cells keep their old values.
             keep = np.zeros(local, dtype=bool)

@@ -1,0 +1,86 @@
+"""ctypes binding for the native host-staging engine (native/halostage.cpp).
+
+The C++ library implements the same pack → stage → unpack → update cycle as
+the pure-numpy HostStagedStepper (parallel/halo.py), multithreaded one task
+per shard. The numpy version stays as the readable oracle; tests assert the
+two are bit-identical. Build with `make -C native` (g++; no pybind11 —
+plain ctypes over an extern-C ABI).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pathlib
+
+import numpy as np
+
+_LIB_PATH = (
+    pathlib.Path(__file__).resolve().parent.parent.parent
+    / "native"
+    / "libhalostage.so"
+)
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not _LIB_PATH.exists():
+        return None
+    lib = ctypes.CDLL(str(_LIB_PATH))
+    if lib.rmt_abi_version() != 1:
+        return None
+    lib.rmt_host_staged_step.restype = ctypes.c_int
+    lib.rmt_host_staged_step.argtypes = [
+        ctypes.POINTER(ctypes.c_double),  # T
+        ctypes.POINTER(ctypes.c_double),  # Cp
+        ctypes.POINTER(ctypes.c_double),  # out
+        ctypes.POINTER(ctypes.c_int64),  # shape
+        ctypes.POINTER(ctypes.c_int64),  # dims
+        ctypes.c_int,  # ndim
+        ctypes.POINTER(ctypes.c_double),  # inv_d2
+        ctypes.c_double,  # lam
+        ctypes.c_double,  # dt
+        ctypes.c_int,  # threads
+    ]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    """True when the built library is present and ABI-compatible."""
+    return _load() is not None
+
+
+def host_staged_step(
+    T: np.ndarray,
+    Cp: np.ndarray,
+    dims,
+    spacing,
+    lam: float,
+    dt: float,
+    threads: int = 0,
+) -> np.ndarray:
+    """One native host-staged diffusion step; same contract as
+    HostStagedStepper.step (f64, row-major, 2D/3D)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(
+            "native halostage library not built — run `make -C native`"
+        )
+    T = np.ascontiguousarray(T, dtype=np.float64)
+    Cp = np.ascontiguousarray(Cp, dtype=np.float64)
+    out = np.empty_like(T)
+    ndim = T.ndim
+    shape = (ctypes.c_int64 * ndim)(*T.shape)
+    dims_c = (ctypes.c_int64 * ndim)(*dims)
+    inv_d2 = (ctypes.c_double * ndim)(*(1.0 / (d * d) for d in spacing))
+    p = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+    rc = lib.rmt_host_staged_step(
+        p(T), p(Cp), p(out), shape, dims_c, ndim, inv_d2,
+        float(lam), float(dt), int(threads),
+    )
+    if rc != 0:
+        raise ValueError(f"rmt_host_staged_step failed with code {rc}")
+    return out

@@ -44,6 +44,13 @@ def effective_b_width(local_shape, b_width) -> tuple[int, ...]:
     b_width = tuple(b_width)
     if len(b_width) < len(local_shape):
         b_width = b_width + (b_width[-1],) * (len(local_shape) - len(b_width))
+    for ln in local_shape:
+        if ln < 2:
+            raise ValueError(
+                f"hide variant needs every shard axis >= 2 cells (local "
+                f"shape {tuple(local_shape)}); use variant 'shard' for "
+                "degenerate decompositions"
+            )
     return tuple(
         max(1, min(int(b), ln // 2)) for b, ln in zip(b_width, local_shape)
     )

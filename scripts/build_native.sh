@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Build the native components — the analog of the reference's startup.sh
+# dependency bootstrap (/root/reference/startup.sh installs pinned Julia
+# deps; here the only build artifact is the C++ host-staging engine).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+make -C native
+python - <<'EOF'
+from rocm_mpi_tpu.parallel import native_halo
+assert native_halo.available(), "native library failed its ABI probe"
+print("native halostage engine built and loadable")
+EOF

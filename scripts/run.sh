@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# App launcher — the analog of the reference's scripts/runme.sh
+# (/root/reference/scripts/runme.sh: sources setenv, runs one diffusion app
+# under srun). Select the app by argument instead of editing comments
+# (README.md:21 documents the reference's comment-toggling).
+#
+# Usage:
+#   scripts/run.sh ap|kp|perf|perf_hide|3d|ring [extra app flags...]
+#   RMT_DISTRIBUTED=1 scripts/run.sh perf_hide      # multi-host pod slice
+set -euo pipefail
+cd "$(dirname "$0")/.."
+source scripts/setenv.sh "${RMT_TRANSPORT_ARG:-}"
+
+app="${1:-ap}"
+shift || true
+case "$app" in
+  ap) exec python apps/diffusion_2d_ap.py "$@" ;;
+  kp) exec python apps/diffusion_2d_kp.py "$@" ;;
+  perf) exec python apps/diffusion_2d_perf.py "$@" ;;
+  perf_hide|hide) exec python apps/diffusion_2d_perf_hide.py "$@" ;;
+  3d) exec python apps/diffusion_3d_perf_hide.py "$@" ;;
+  ring) exec python apps/ici_ring_test.py "$@" ;;
+  *) echo "unknown app '$app' (ap|kp|perf|perf_hide|3d|ring)" >&2; exit 2 ;;
+esac

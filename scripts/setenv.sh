@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Environment configuration — the analog of the reference's scripts/setenv.sh
+# (module loads + the ROCm-aware/host-staged MPI toggle,
+# /root/reference/scripts/setenv.sh). On TPU there are no modules to load;
+# the knobs that remain:
+#
+#   RMT_HALO_TRANSPORT=ici   # device-direct collectives over the
+#                            # interconnect (the ROCm-aware / GPU-direct
+#                            # analog; default)
+#   RMT_HALO_TRANSPORT=host  # host-staged oracle path (the
+#                            # IGG_ROCMAWARE_MPI=0 analog) — single process,
+#                            # 'shard' variant only
+#   RMT_DISTRIBUTED=1        # multi-host: jax.distributed.initialize()
+#                            # (the srun/PMIx analog)
+#
+# Source this before running apps: `source scripts/setenv.sh [host]`
+
+if [ "${1:-}" = "host" ]; then
+  export RMT_HALO_TRANSPORT=host
+else
+  export RMT_HALO_TRANSPORT=ici
+fi
+
+# Simulated multi-chip CPU mesh for development without hardware
+# (the reference has no such affordance; SURVEY.md §4.5):
+#   export JAX_PLATFORMS=cpu
+#   export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+
+echo "RMT_HALO_TRANSPORT=${RMT_HALO_TRANSPORT}"

@@ -1,0 +1,55 @@
+"""Native host-staging engine (native/halostage.cpp): must be bit-identical
+to the pure-numpy oracle. Skipped when the library isn't built
+(`make -C native`)."""
+
+import numpy as np
+import pytest
+
+from rocm_mpi_tpu.parallel import HostStagedStepper, init_global_grid
+from rocm_mpi_tpu.parallel import native_halo
+
+pytestmark = pytest.mark.skipif(
+    not native_halo.available(), reason="native library not built"
+)
+
+
+@pytest.mark.parametrize(
+    "shape,dims",
+    [((64, 48), (4, 2)), ((24, 24, 24), (2, 2, 2)), ((40,), (8,))],
+)
+def test_native_bit_identical_to_numpy(shape, dims):
+    grid = init_global_grid(*shape, dims=dims)
+    rng = np.random.default_rng(1)
+    T = rng.random(shape)
+    Cp = 1.0 + rng.random(shape)
+    stepper = HostStagedStepper(grid, lam=1.3, dt=1e-4)
+    ref = stepper.step_python(T, Cp)
+    got = native_halo.host_staged_step(
+        T, Cp, dims, grid.spacing, 1.3, 1e-4
+    )
+    np.testing.assert_array_equal(ref, got)
+
+
+def test_stepper_auto_dispatch_matches_python():
+    grid = init_global_grid(32, 32, dims=(2, 2))
+    rng = np.random.default_rng(2)
+    T, Cp = rng.random((32, 32)), np.ones((32, 32))
+    s = HostStagedStepper(grid, 1.0, 1e-4)
+    assert s.use_native
+    np.testing.assert_array_equal(s.step(T, Cp), s.step_python(T, Cp))
+
+
+def test_native_rejects_bad_geometry():
+    with pytest.raises(ValueError, match="code 2"):
+        native_halo.host_staged_step(
+            np.zeros((10, 10)), np.ones((10, 10)), (3, 3), (0.1, 0.1), 1.0, 1e-4
+        )
+
+
+def test_single_thread_matches_threaded():
+    grid = init_global_grid(64, 64, dims=(4, 2))
+    rng = np.random.default_rng(3)
+    T, Cp = rng.random((64, 64)), 1.0 + rng.random((64, 64))
+    a = native_halo.host_staged_step(T, Cp, (4, 2), grid.spacing, 1.0, 1e-4, threads=1)
+    b = native_halo.host_staged_step(T, Cp, (4, 2), grid.spacing, 1.0, 1e-4, threads=8)
+    np.testing.assert_array_equal(a, b)
