@@ -60,25 +60,36 @@ void update_shard(const Geom& g, const double* T, const double* Cp,
   int64_t lo[kMaxDim];  // global origin of this shard
   for (int a = 0; a < g.ndim; ++a) lo[a] = sc[a] * g.local[a];
 
-  // Stage: copy core and face ghosts into the padded block. A cell of the
-  // padded block at p (0..local+1) maps to global coordinate lo + p - 1;
-  // we copy every in-range global cell that is either in-core or exactly
-  // one cell outside a face (face ghosts; corner/edge ghosts are unused by
-  // the 5/7-point stencil but staged too when in range — harmless).
-  int64_t p[kMaxDim];
+  // Stage row-wise: the last axis is stride-1 in both the global field and
+  // the padded block, so every staged row is one contiguous memcpy. A cell
+  // of the padded block at p (0..local+1) maps to global coord lo + p - 1.
+  // Core rows copy their core columns plus the in-domain last-axis face
+  // ghosts; face-ghost rows (exactly one non-last axis outside the core)
+  // copy core columns only; edge/corner rows are never read by the
+  // 5/7-point stencil and stay zero.
+  const int last = g.ndim - 1;
+  int64_t p[kMaxDim] = {0};
   auto stage = [&](auto&& self, int axis) -> void {
-    if (axis == g.ndim) {
+    if (axis == last) {
       int64_t gcoord[kMaxDim];
       int outside = 0;
-      for (int a = 0; a < g.ndim; ++a) {
+      for (int a = 0; a < last; ++a) {
         gcoord[a] = lo[a] + p[a] - 1;
         if (gcoord[a] < 0 || gcoord[a] >= g.shape[a]) return;  // off-domain
         if (p[a] == 0 || p[a] == g.local[a] + 1) ++outside;
       }
-      if (outside > 1) return;  // corner ghost: not needed, skip the copy
-      int64_t poff = 0;
-      for (int a = 0; a < g.ndim; ++a) poff += p[a] * pstride[a];
-      block[poff] = T[gidx(g, gcoord)];
+      if (outside > 1) return;  // edge/corner row: not read, skip
+      // Padded last-axis positions [first, stop) to stage for this row.
+      int64_t first = 1, stop = g.local[last] + 1;
+      if (outside == 0) {  // core row: include in-domain face ghosts
+        if (lo[last] > 0) first = 0;
+        if (lo[last] + g.local[last] < g.shape[last]) stop = g.local[last] + 2;
+      }
+      gcoord[last] = lo[last] + first - 1;
+      int64_t poff = first;
+      for (int a = 0; a < last; ++a) poff += p[a] * pstride[a];
+      std::memcpy(&block[poff], &T[gidx(g, gcoord)],
+                  static_cast<size_t>(stop - first) * sizeof(double));
       return;
     }
     if (axis >= kMaxDim) return;  // unreachable; bounds recursion depth

@@ -122,7 +122,11 @@ class HostStagedStepper:
         """One host-staged step. Dispatches to the native C++ engine
         (native/halostage.cpp, bit-identical, multithreaded) when built;
         falls back to the readable numpy implementation below."""
-        if self.use_native and T.dtype == np.float64:
+        if (
+            self.use_native
+            and T.dtype == np.float64
+            and Cp.dtype == np.float64
+        ):
             from rocm_mpi_tpu.parallel import native_halo
 
             return native_halo.host_staged_step(

@@ -17,8 +17,12 @@
 
 if [ "${1:-}" = "host" ]; then
   export RMT_HALO_TRANSPORT=host
-else
+elif [ "${1:-}" = "ici" ]; then
   export RMT_HALO_TRANSPORT=ici
+else
+  # No explicit argument: respect an already-exported RMT_HALO_TRANSPORT
+  # (e.g. `RMT_HALO_TRANSPORT=host scripts/run.sh perf`), default ici.
+  export RMT_HALO_TRANSPORT="${RMT_HALO_TRANSPORT:-ici}"
 fi
 
 # Simulated multi-chip CPU mesh for development without hardware
