@@ -26,16 +26,29 @@ def main() -> int:
     from rocm_mpi_tpu.config import DiffusionConfig
     from rocm_mpi_tpu.models import HeatDiffusion
 
+    import jax
+
     # Step counts are large multiples of the in-kernel chunk (256): the
     # fixed host→device dispatch latency of the one timed XLA call (~65 ms
     # measured through the tunneled-chip transport) must be amortized to
     # noise, or it — not the kernel — is what gets measured. At ~0.4 µs/step
     # the 4.19M timed steps take ~1.7 s, making the dispatch overhead <4%.
+    # Off-TPU the kernel runs in the Pallas *interpreter* — millions of
+    # steps would take days — so shrink to a smoke-test step count there.
+    if jax.default_backend() == "tpu":
+        warmup, timed = 32_768, 4_194_304
+    else:
+        warmup, timed = 32, 256
+        print(
+            "bench.py: no TPU backend — interpret-mode smoke run "
+            f"({timed} steps); the reported rate is NOT the benchmark",
+            file=sys.stderr,
+        )
     cfg = DiffusionConfig(
         global_shape=(252, 252),
         lengths=(10.0, 10.0),
-        nt=32_768 + 4_194_304,
-        warmup=32_768,
+        nt=warmup + timed,
+        warmup=warmup,
         dtype="f32",
         dims=(1, 1),
     )

@@ -5,7 +5,7 @@
 # (README.md:21 documents the reference's comment-toggling).
 #
 # Usage:
-#   scripts/run.sh ap|kp|perf|perf_hide|3d|ring [extra app flags...]
+#   scripts/run.sh ap|kp|perf|perf_hide|prof|3d|ring [extra app flags...]
 #   RMT_DISTRIBUTED=1 scripts/run.sh perf_hide      # multi-host pod slice
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -18,7 +18,8 @@ case "$app" in
   kp) exec python apps/diffusion_2d_kp.py "$@" ;;
   perf) exec python apps/diffusion_2d_perf.py "$@" ;;
   perf_hide|hide) exec python apps/diffusion_2d_perf_hide.py "$@" ;;
+  prof|perf_hide_prof) exec python apps/diffusion_2d_perf_hide_prof.py "$@" ;;
   3d) exec python apps/diffusion_3d_perf_hide.py "$@" ;;
   ring) exec python apps/ici_ring_test.py "$@" ;;
-  *) echo "unknown app '$app' (ap|kp|perf|perf_hide|3d|ring)" >&2; exit 2 ;;
+  *) echo "unknown app '$app' (ap|kp|perf|perf_hide|prof|3d|ring)" >&2; exit 2 ;;
 esac
