@@ -50,15 +50,9 @@ def main() -> int:
 
     cfg = build_config(args)
     if cfg.halo_transport == "host":
-        import warnings
+        from rocm_mpi_tpu.models.diffusion import warn_host_transport_ignored
 
-        warnings.warn(
-            "halo_transport='host' is not honored by the profiling app — "
-            "the profiled 'hide' program keeps its device-side "
-            "communication; only variant 'shard' routes to the host-staged "
-            "oracle stepper.",
-            stacklevel=1,
-        )
+        warn_host_transport_ignored("hide")
     model = HeatDiffusion(cfg)
     T, Cp = model.init_state()
     advance = model.advance_fn("hide")

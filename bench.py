@@ -14,6 +14,11 @@ vs_baseline: the reference publishes no numbers (BASELINE.md). The divisor is
 an *estimate* of the reference's fused-kernel rate on one MI50: peak HBM BW
 1024 GB/s × ~70% achievable for a memory-bound stencil ≈ 717 GB/s T_eff,
 A_eff = 24 B/point (3 f64 passes, perf.jl:55) → ≈ 29.9 Gpts/s/GPU.
+
+`--suite` additionally measures the whole ladder (per-step perf/hide at
+252², temporal-blocked and per-step paths at 12288², 3D) and prints a
+human-readable table to stderr — the source of BASELINE.md's measured
+numbers. The default single-line contract is unchanged.
 """
 
 import json
@@ -22,11 +27,59 @@ import sys
 REF_ESTIMATE_GPTS = 29.9  # estimated MI50 fused-kernel rate (see docstring)
 
 
+def run_suite() -> None:
+    import jax
+
+    if jax.default_backend() != "tpu":
+        print(
+            "bench.py --suite requires a TPU backend (off-TPU the kernels "
+            "run in the Pallas interpreter — hours per row); skipping",
+            file=sys.stderr,
+        )
+        return
+
+    from rocm_mpi_tpu.config import DiffusionConfig
+    from rocm_mpi_tpu.models import HeatDiffusion
+
+    def row(label, shape, runner, nt, warmup, **kw):
+        cfg = DiffusionConfig(
+            global_shape=shape,
+            lengths=(10.0,) * len(shape),
+            nt=nt,
+            warmup=warmup,
+            dtype="f32",
+            dims=(1,) * len(shape),
+        )
+        model = HeatDiffusion(cfg)
+        r = getattr(model, runner)(**kw)
+        print(
+            f"{label:34s} {r.wtime_it * 1e6:12.3f} us/step  "
+            f"T_eff={r.t_eff:8.1f} GB/s  {r.gpts:8.3f} Gpts/s",
+            file=sys.stderr,
+        )
+
+    row("252² VMEM-resident loop", (252, 252), "run_vmem_resident",
+        32_768 + 1_048_576, 32_768)
+    row("252² per-step perf (ppermute)", (252, 252), "run",
+        220_000, 20_000, variant="perf")
+    row("252² per-step hide (overlap)", (252, 252), "run",
+        220_000, 20_000, variant="hide")
+    row("12288² temporal-blocked (k=8)", (12288, 12288), "run_hbm_blocked",
+        328, 8)
+    row("12288² per-step perf", (12288, 12288), "run", 110, 10,
+        variant="perf")
+    row("128³ 3D temporal-blocked (k=8)", (128, 128, 128), "run_hbm_blocked",
+        3_208, 8)
+
+
 def main() -> int:
     from rocm_mpi_tpu.config import DiffusionConfig
     from rocm_mpi_tpu.models import HeatDiffusion
 
     import jax
+
+    if "--suite" in sys.argv:
+        run_suite()
 
     # Step counts are large multiples of the in-kernel chunk (256): the
     # fixed host→device dispatch latency of the one timed XLA call (~65 ms

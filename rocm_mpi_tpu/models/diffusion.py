@@ -38,6 +38,21 @@ from rocm_mpi_tpu.parallel.mesh import GlobalGrid, init_global_grid
 from rocm_mpi_tpu.utils import metrics
 
 
+def warn_host_transport_ignored(variant: str) -> None:
+    """The one warning for halo_transport='host' on a variant that keeps its
+    device-side communication (only 'shard' routes to the host-staged
+    oracle). Shared so the message can't drift between call sites."""
+    import warnings
+
+    warnings.warn(
+        f"halo_transport='host' is not honored by variant {variant!r} — "
+        "only variant 'shard' routes to the host-staged oracle stepper; "
+        "all other variants keep their device-side communication (GSPMD "
+        "or ppermute).",
+        stacklevel=3,
+    )
+
+
 @dataclasses.dataclass
 class RunResult:
     T: jax.Array  # final temperature field (global, sharded)
@@ -262,20 +277,51 @@ class HeatDiffusion:
         if cfg.halo_transport == "host":
             if variant == "shard":
                 return self._run_host_staged(nt, warmup)
-            import warnings
-
-            warnings.warn(
-                f"halo_transport='host' is not honored by variant "
-                f"'{variant}' — only variant 'shard' routes to the "
-                "host-staged oracle stepper; all other variants keep their "
-                "device-side communication (GSPMD or ppermute).",
-                stacklevel=2,
-            )
+            warn_host_transport_ignored(variant)
         T, Cp = self.init_state()
         advance = self.advance_fn(variant)
         timer = metrics.Timer()
         if warmup:
             T = advance(T, Cp, warmup)
+        timer.tic(T)
+        T = advance(T, Cp, nt - warmup)
+        wtime = timer.toc(T)
+        return RunResult(T=T, wtime=wtime, nt=nt, warmup=warmup, config=cfg)
+
+    def _run_single_shard(
+        self, nt, warmup, multi_step_fn, granularity: int, granularity_kw: str
+    ) -> RunResult:
+        """Shared scaffold of the single-shard fast paths: validate, pick a
+        step granularity dividing both the warmup and timed windows (so one
+        compiled program, built outside the timed window, serves both — the
+        outer trip count stays dynamic), then tic/advance/toc.
+
+        `multi_step_fn(T, Cp, lam, dt, spacing, n, <granularity_kw>=g)` is
+        one of ops.pallas_kernels.fused_multi_step / fused_multi_step_hbm.
+        """
+        import math
+
+        cfg = self.config
+        nt = cfg.nt if nt is None else nt
+        warmup = cfg.warmup if warmup is None else warmup
+        if not 0 <= warmup < nt:
+            raise ValueError(f"need 0 <= warmup < nt, got {warmup}, {nt}")
+        if self.grid.nprocs != 1:
+            raise ValueError("single-shard fast paths require an unsharded grid")
+        key = granularity_kw
+        gran = math.gcd(math.gcd(warmup, nt - warmup), granularity) or 1
+
+        T, Cp = self.init_state()
+        dt = cfg.jax_dtype(cfg.dt)
+
+        @functools.partial(jax.jit, donate_argnums=0)
+        def advance(T, Cp, n):
+            return multi_step_fn(
+                T, Cp, cfg.lam, dt, cfg.spacing, n, **{key: gran}
+            )
+
+        timer = metrics.Timer()
+        T = advance(T, Cp, warmup)  # n=0 still compiles the shared program
         timer.tic(T)
         T = advance(T, Cp, nt - warmup)
         wtime = timer.toc(T)
@@ -290,39 +336,37 @@ class HeatDiffusion:
         TPU-only optimization with no reference analog; only valid when the
         grid is unsharded (nprocs == 1) and fits the VMEM budget.
         """
-        from rocm_mpi_tpu.ops.pallas_kernels import fused_multi_step
+        from rocm_mpi_tpu.ops.pallas_kernels import (
+            DEFAULT_STEP_CHUNK,
+            fused_multi_step,
+        )
 
-        cfg = self.config
-        nt = cfg.nt if nt is None else nt
-        warmup = cfg.warmup if warmup is None else warmup
-        if not 0 <= warmup < nt:
-            raise ValueError(f"need 0 <= warmup < nt, got {warmup}, {nt}")
-        if self.grid.nprocs != 1:
-            raise ValueError("run_vmem_resident requires an unsharded grid")
-        import math
+        return self._run_single_shard(
+            nt, warmup, fused_multi_step, DEFAULT_STEP_CHUNK, "chunk"
+        )
 
-        from rocm_mpi_tpu.ops.pallas_kernels import DEFAULT_STEP_CHUNK
+    def run_hbm_blocked(
+        self,
+        nt: int | None = None,
+        warmup: int | None = None,
+        block_steps: int | None = None,
+    ) -> RunResult:
+        """Single-shard large-grid fast path: temporal blocking — every HBM
+        sweep advances the field `block_steps` steps
+        (ops.pallas_kernels.fused_multi_step_hbm), beating the 3-passes-per-
+        step bound the reference's fused kernel is built around
+        (perf.jl:55). Only valid when the grid is unsharded; the sharded
+        variants keep per-step halo semantics.
+        """
+        from rocm_mpi_tpu.ops.pallas_kernels import (
+            DEFAULT_TB_STEPS,
+            fused_multi_step_hbm,
+        )
 
-        T, Cp = self.init_state()
-        dt = cfg.jax_dtype(cfg.dt)
-        # One static in-kernel chunk shared by warmup and timed calls →
-        # exactly one Mosaic compile, outside the timed window; the outer
-        # trip count stays dynamic.
-        chunk = math.gcd(math.gcd(warmup, nt - warmup), DEFAULT_STEP_CHUNK)
-        chunk = max(chunk, 1)
-
-        @functools.partial(jax.jit, donate_argnums=0)
-        def advance(T, Cp, n):
-            return fused_multi_step(
-                T, Cp, cfg.lam, dt, cfg.spacing, n, chunk=chunk
-            )
-
-        timer = metrics.Timer()
-        T = advance(T, Cp, warmup)  # n=0 still compiles the shared program
-        timer.tic(T)
-        T = advance(T, Cp, nt - warmup)
-        wtime = timer.toc(T)
-        return RunResult(T=T, wtime=wtime, nt=nt, warmup=warmup, config=cfg)
+        k = DEFAULT_TB_STEPS if block_steps is None else block_steps
+        return self._run_single_shard(
+            nt, warmup, fused_multi_step_hbm, k, "block_steps"
+        )
 
     def _run_host_staged(self, nt: int, warmup: int) -> RunResult:
         """Debug oracle: numpy stepper with host-staged halos

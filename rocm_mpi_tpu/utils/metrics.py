@@ -8,9 +8,15 @@ excluding 10 warmup iterations (/root/reference/scripts/diffusion_2D_perf.jl:55-
 tic/toc at :48,53). The driver's headline metric Gpts/s = nx·ny/wtime_it/1e9
 is the same measurement, hardware-agnostically normalized per grid point.
 
-TPU note: `tic`/`toc` bracket device work with `block_until_ready` — the
-analog of the reference's `wait(signal)` sync before `toc` — because JAX
-dispatch is async.
+TPU note: `tic`/`toc` bracket device work with a *value fetch* — the analog
+of the reference's `wait(signal)` sync before `toc` — because JAX dispatch
+is async AND, on the tunneled-chip transport this framework targets,
+`block_until_ready` (both the module function and the array method) returns
+before remote execution finishes; only materializing a value on the host
+actually waits. Measured: a 2.5 s computation "synced" with
+block_until_ready times at 0.000 s, with a scalar fetch at 2.49 s. The
+fetch costs one tiny transfer round-trip, which the caller amortizes by
+timing windows of many steps.
 """
 
 from __future__ import annotations
@@ -19,6 +25,21 @@ import math
 import time
 
 import jax
+
+
+def force(x):
+    """Truly wait for `x`: block_until_ready, then fetch one scalar.
+
+    The fetch is an O(1) single-element slice (not a whole-array pull) and
+    is skipped for non-fully-addressable global arrays (multi-host runs),
+    where cross-host fetches are invalid — there, block_until_ready is the
+    real runtime's sync and the fetch workaround is neither possible nor
+    needed (the no-op behavior is a quirk of the single-host tunnel).
+    """
+    x = jax.block_until_ready(x)
+    if hasattr(x, "ndim") and getattr(x, "is_fully_addressable", False):
+        jax.device_get(x[(0,) * x.ndim])
+    return x
 
 
 class Timer:
@@ -31,13 +52,13 @@ class Timer:
     def tic(self, *sync):
         """Start timing. Pass device arrays to sync on first."""
         for x in sync:
-            jax.block_until_ready(x)
+            force(x)
         self._t0 = time.perf_counter()
 
     def toc(self, *sync) -> float:
         """Stop timing (after syncing on `sync`); returns elapsed seconds."""
         for x in sync:
-            jax.block_until_ready(x)
+            force(x)
         if self._t0 is None:
             raise RuntimeError("toc() before tic()")
         self.elapsed = time.perf_counter() - self._t0

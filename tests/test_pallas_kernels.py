@@ -99,3 +99,59 @@ def test_kp_variant_matches_ap_on_mesh():
     np.testing.assert_allclose(
         np.asarray(res_kp.T), np.asarray(res_ap.T), rtol=1e-13, atol=1e-15
     )
+
+
+def test_temporal_blocked_matches_stepwise():
+    """fused_multi_step_hbm (k steps per sweep) == k individual steps."""
+    n = 48  # 3 stripes of 16
+    T = _rand((n, n), dtype=jnp.float32)
+    Cp = (1.0 + _rand((n, n), seed=1, dtype=jnp.float32))
+    lam, dt, spacing = 1.0, 1e-4, (0.5, 0.5)
+    # oracle: 16 steps through the VMEM-resident kernel (itself tested
+    # against the jnp stepper above)
+    ref = fused_multi_step(T, Cp, lam, dt, spacing, 16, chunk=16)
+    got = pk.fused_multi_step_hbm(T, Cp, lam, dt, spacing, 16, block_steps=8)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-5, atol=1e-6
+    )
+
+
+def test_temporal_blocked_3d():
+    T = _rand((32, 12, 10), dtype=jnp.float32)
+    Cp = 1.0 + _rand((32, 12, 10), seed=2, dtype=jnp.float32)
+    lam, dt, spacing = 0.8, 5e-5, (0.3, 0.4, 0.5)
+    ref = fused_multi_step(T, Cp, lam, dt, spacing, 8, chunk=8)
+    got = pk.fused_multi_step_hbm(T, Cp, lam, dt, spacing, 8, block_steps=4)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-5, atol=1e-6
+    )
+
+
+def test_temporal_blocked_validation():
+    T = _rand((48, 48), dtype=jnp.float32)
+    Cp = jnp.ones((48, 48), jnp.float32)
+    with pytest.raises(ValueError, match="multiple"):
+        pk.fused_multi_step_hbm(T, Cp, 1.0, 1e-4, (0.5, 0.5), 12, block_steps=8)
+    with pytest.raises(ValueError, match="block_steps"):
+        pk.fused_multi_step_hbm(T, Cp, 1.0, 1e-4, (0.5, 0.5), 16, block_steps=9)
+    with pytest.raises(ValueError, match="axis-0"):
+        pk.fused_multi_step_hbm(
+            T[:20], Cp[:20], 1.0, 1e-4, (0.5, 0.5), 8, block_steps=8
+        )
+
+
+def test_run_hbm_blocked_model_runner():
+    cfg = DiffusionConfig(
+        global_shape=(64, 40),
+        lengths=(10.0, 8.0),
+        nt=32,
+        warmup=8,
+        dtype="f32",
+        dims=(1, 1),
+    )
+    model = HeatDiffusion(cfg)
+    res_tb = model.run_hbm_blocked()
+    res_ps = model.run(variant="perf")
+    np.testing.assert_allclose(
+        np.asarray(res_tb.T), np.asarray(res_ps.T), rtol=2e-5, atol=1e-6
+    )
