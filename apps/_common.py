@@ -55,6 +55,11 @@ def make_parser(
     vis.add_argument("--vis", dest="do_vis", action="store_true", default=do_vis)
     vis.add_argument("--no-vis", dest="do_vis", action="store_false")
     p.add_argument(
+        "--vis-shards", action="store_true",
+        help="also render one panel per shard (the poc_rocmaware.png-style "
+        "halo-exchange proof; 2D + --vis only)",
+    )
+    p.add_argument(
         "--transport", default=None, choices=["ici", "host"],
         help="halo transport: device-direct collectives vs host staging "
         "(IGG_ROCMAWARE_MPI=1/0 analog)",
@@ -157,6 +162,13 @@ def run_app(variant: str, args) -> int:
                 T_v, path, title=f"{variant} nt={result.nt} mesh={grid.dims}"
             )
             log0(f"wrote {path}")
+            if getattr(args, "vis_shards", False) and grid.ndim == 2:
+                ppath = OUTPUT_DIR / f"poc_{variant}_{grid.nprocs}.png"
+                viz.save_shard_panels(
+                    T_v, grid.dims, ppath,
+                    title=f"per-device shards — {variant} mesh={grid.dims}",
+                )
+                log0(f"wrote {ppath}")
     else:
         # Cheap scalar invariant even without vis: peak must decay.
         log0(f"maximum(T) = {float(result.T.max())}")

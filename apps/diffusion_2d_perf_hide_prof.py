@@ -52,7 +52,7 @@ def main() -> int:
     if cfg.halo_transport == "host":
         from rocm_mpi_tpu.models.diffusion import warn_host_transport_ignored
 
-        warn_host_transport_ignored("hide")
+        warn_host_transport_ignored("hide", stacklevel=2)
     model = HeatDiffusion(cfg)
     T, Cp = model.init_state()
     advance = model.advance_fn("hide")

@@ -1,0 +1,111 @@
+"""Weak-scaling harness — the driver-baseline north-star measurement.
+
+Target (BASELINE.md): the overlap variant at 252²/device on a pod slice at
+≥90% weak-scaling efficiency vs single chip. This harness holds the local
+shard size fixed, grows the global grid with the device count (the same
+weak-scaling protocol as the reference's per-rank-constant grids,
+/root/reference/scripts/diffusion_2D_perf.jl:21-22 — 12288² *per rank*),
+and reports per-device throughput and efficiency vs the 1-device run.
+
+On real multi-chip hardware this measures the target directly. On one chip
+(or `--cpu-devices N` virtual devices) it exercises the full sharded code
+path — mesh construction, ppermute halo, overlap scheduling — so the
+scaling *mechanics* are testable anywhere, as with everything else in this
+framework.
+
+  python apps/weak_scaling.py --cpu-devices 8        # 1,2,4,8 virtual devs
+  python apps/weak_scaling.py --local 252 --variant hide   # real hardware
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--local", type=int, default=252,
+                   help="per-device shard edge (target geometry: 252)")
+    p.add_argument("--nt", type=int, default=2000)
+    p.add_argument("--warmup", type=int, default=200)
+    p.add_argument("--variant", default="hide",
+                   choices=["ap", "fused", "shard", "perf", "kp", "hide"])
+    p.add_argument("--dtype", default="f32")
+    p.add_argument("--cpu-devices", type=int, default=0, metavar="N")
+    p.add_argument("--counts", default=None,
+                   help="comma-separated device counts (default: powers of 2 "
+                   "up to all available)")
+    p.add_argument("--json", action="store_true",
+                   help="emit one JSON line per count as well")
+    args = p.parse_args(argv)
+
+    import jax
+
+    if args.cpu_devices:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+    if args.dtype == "f64":
+        jax.config.update("jax_enable_x64", True)
+
+    from rocm_mpi_tpu.config import DiffusionConfig
+    from rocm_mpi_tpu.models import HeatDiffusion
+    from rocm_mpi_tpu.parallel.mesh import suggest_dims
+
+    n_avail = len(jax.devices())
+    if args.counts:
+        counts = [int(c) for c in args.counts.split(",")]
+    else:
+        counts, c = [], 1
+        while c <= n_avail:
+            counts.append(c)
+            c *= 2
+    base_per_dev = base_n = None
+    print(
+        f"weak scaling: variant={args.variant}, {args.local}²/device, "
+        f"nt={args.nt}, dtype={args.dtype}, {n_avail} device(s) available"
+    )
+    for n in counts:
+        if n > n_avail:
+            print(f"n={n}: skipped (only {n_avail} devices)")
+            continue
+        dims = suggest_dims(n, 2)
+        shape = (args.local * dims[0], args.local * dims[1])
+        cfg = DiffusionConfig(
+            global_shape=shape,
+            lengths=(10.0 * dims[0], 10.0 * dims[1]),
+            nt=args.nt,
+            warmup=args.warmup,
+            dtype=args.dtype,
+            dims=dims,
+        )
+        model = HeatDiffusion(cfg, devices=jax.devices()[:n])
+        r = model.run(variant=args.variant)
+        per_dev = r.gpts / n
+        if base_per_dev is None:
+            # The efficiency baseline is the smallest count actually run;
+            # the north-star "vs single chip" number requires n=1 in the
+            # list, so label the baseline explicitly.
+            base_per_dev, base_n = per_dev, n
+        eff = per_dev / base_per_dev
+        print(
+            f"n={n:4d} mesh={dims} global={shape}: "
+            f"{r.wtime_it * 1e6:9.3f} us/step  {r.gpts:9.4f} Gpts/s "
+            f"({per_dev:7.4f}/dev)  efficiency={eff:6.1%} vs n={base_n}"
+        )
+        if args.json:
+            print(json.dumps({
+                "metric": f"weak-scaling {args.variant} {args.local}²/dev",
+                "devices": n, "dims": dims, "gpts": round(r.gpts, 4),
+                "gpts_per_device": round(per_dev, 4),
+                "efficiency": round(eff, 4),
+            }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,10 +38,12 @@ from rocm_mpi_tpu.parallel.mesh import GlobalGrid, init_global_grid
 from rocm_mpi_tpu.utils import metrics
 
 
-def warn_host_transport_ignored(variant: str) -> None:
+def warn_host_transport_ignored(variant: str, stacklevel: int = 3) -> None:
     """The one warning for halo_transport='host' on a variant that keeps its
     device-side communication (only 'shard' routes to the host-staged
-    oracle). Shared so the message can't drift between call sites."""
+    oracle). Shared so the message can't drift between call sites.
+    Default stacklevel attributes to run()'s caller; direct callers pass 2.
+    """
     import warnings
 
     warnings.warn(
@@ -49,7 +51,7 @@ def warn_host_transport_ignored(variant: str) -> None:
         "only variant 'shard' routes to the host-staged oracle stepper; "
         "all other variants keep their device-side communication (GSPMD "
         "or ppermute).",
-        stacklevel=3,
+        stacklevel=stacklevel,
     )
 
 
@@ -363,7 +365,24 @@ class HeatDiffusion:
             fused_multi_step_hbm,
         )
 
+        import math
+
+        cfg = self.config
         k = DEFAULT_TB_STEPS if block_steps is None else block_steps
+        nt_v = cfg.nt if nt is None else nt
+        wu_v = cfg.warmup if warmup is None else warmup
+        eff = math.gcd(math.gcd(wu_v, nt_v - wu_v), k) or 1
+        if eff != k:
+            import warnings
+
+            warnings.warn(
+                f"temporal blocking degraded: block_steps={k} requested but "
+                f"warmup={wu_v} / timed={nt_v - wu_v} force k={eff} (both "
+                "windows must be multiples of block_steps to share one "
+                "compiled program); pick step counts divisible by "
+                f"{k} to keep the full k-steps-per-sweep saving.",
+                stacklevel=2,
+            )
         return self._run_single_shard(
             nt, warmup, fused_multi_step_hbm, k, "block_steps"
         )

@@ -366,15 +366,9 @@ def fused_multi_step(T, Cp, lam, dt, spacing, n_steps, chunk=None, interpret=Non
     lam, dt = float(lam), float(dt)
     inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
     # Masked update coefficient, computed ONCE per advance call (not per
-    # step): dt·λ/Cp on the interior, exactly 0.0 on the Dirichlet edge —
-    # for the single-shard use the block edge IS the global boundary (the
-    # reference's interior-only guard, perf.jl:7).
-    mask = None
-    for ax in range(T.ndim):
-        idx = lax.broadcasted_iota(jnp.int32, T.shape, ax)
-        m = (idx == 0) | (idx == T.shape[ax] - 1)
-        mask = m if mask is None else (mask | m)
-    Cm = jnp.where(mask, jnp.zeros_like(Cp), (dt * lam) / Cp)
+    # step) — for the single-shard use the block edge IS the global
+    # boundary (the reference's interior-only guard, perf.jl:7).
+    Cm = _edge_masked_cm(T, Cp, lam, dt)
     kernel = functools.partial(_multi_step_kernel, inv_d2=inv_d2, chunk=chunk)
     run_chunk = pl.pallas_call(
         kernel,
@@ -450,10 +444,12 @@ _TB_TM = 16  # stripe height; with g=8 ghosts, tuned to the ~16 MB VMEM limit
 def fused_multi_step_hbm(T, Cp, lam, dt, spacing, n_steps, block_steps=None,
                          interpret=None):
     """Advance a *single-shard* HBM-resident field `n_steps` via temporal
-    blocking: each memory sweep advances the whole field `block_steps` steps,
-    reading every cell ~1.5× and writing it once — instead of the 3 whole-
-    array HBM passes per step the per-step path (and the reference's fused
-    GPU kernel, perf.jl:3-13) pays by construction. The TPU grid executes
+    blocking: each memory sweep advances the whole field `block_steps`
+    steps. Per sweep, each stripe loads tm+2g rows per tm output rows —
+    with tm=16, g=8 that is 2 reads of T, 2 of Cm, 1 write = 5 whole-array
+    passes per k steps (~0.6 passes/step at k=8), instead of the 3 passes
+    *per step* the per-step path (and the reference's fused GPU kernel,
+    perf.jl:3-13) pays by construction. The TPU grid executes
     stripes sequentially, so sweep s+1 only starts after sweep s wrote its
     stripes; correctness needs no inter-stripe synchronization beyond the
     light-cone ghost blocks (see _tb_kernel).

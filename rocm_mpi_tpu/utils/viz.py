@@ -45,3 +45,44 @@ def save_heatmap(field, path, title: str | None = None) -> pathlib.Path:
     fig.savefig(path, dpi=120)
     plt.close(fig)
     return path
+
+
+def save_shard_panels(field, dims, path, title: str | None = None):
+    """Render each shard of a 2D field as its own panel — the halo-exchange
+    PoC artifact (the reference's docs/poc_rocmaware.png shows one GKS
+    window per rank, README.md:5-7). A working exchange shows the blob
+    spilling smoothly across panel edges; a broken one shows clipped or
+    seamed blobs.
+    """
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    field = np.asarray(field)
+    if field.ndim != 2 or len(dims) != 2:
+        raise ValueError("shard panels are 2D-only")
+    lx, ly = field.shape[0] // dims[0], field.shape[1] // dims[1]
+    path = pathlib.Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    vmax = field.max() or 1.0
+    # Panel rows follow display convention: axis 1 (y) is vertical,
+    # top row = highest y shard, so panels tile like the field itself.
+    fig, axes = plt.subplots(
+        dims[1], dims[0],
+        figsize=(3 * dims[0], 2.6 * dims[1]), squeeze=False,
+    )
+    for cx in range(dims[0]):
+        for cy in range(dims[1]):
+            shard = field[cx * lx:(cx + 1) * lx, cy * ly:(cy + 1) * ly]
+            ax = axes[dims[1] - 1 - cy][cx]
+            ax.imshow(shard.T, origin="lower", cmap="inferno",
+                      vmin=0.0, vmax=vmax)
+            ax.set_title(f"device ({cx},{cy})", fontsize=8)
+            ax.set_xticks([]), ax.set_yticks([])
+    if title:
+        fig.suptitle(title)
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+    return path

@@ -5,7 +5,7 @@
 # (README.md:21 documents the reference's comment-toggling).
 #
 # Usage:
-#   scripts/run.sh ap|kp|perf|perf_hide|prof|3d|ring [extra app flags...]
+#   scripts/run.sh ap|kp|perf|perf_hide|prof|3d|ring|scale [extra app flags...]
 #   RMT_DISTRIBUTED=1 scripts/run.sh perf_hide      # multi-host pod slice
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -21,5 +21,6 @@ case "$app" in
   prof|perf_hide_prof) exec python apps/diffusion_2d_perf_hide_prof.py "$@" ;;
   3d) exec python apps/diffusion_3d_perf_hide.py "$@" ;;
   ring) exec python apps/ici_ring_test.py "$@" ;;
-  *) echo "unknown app '$app' (ap|kp|perf|perf_hide|prof|3d|ring)" >&2; exit 2 ;;
+  scale|weak_scaling) exec python apps/weak_scaling.py "$@" ;;
+  *) echo "unknown app '$app' (ap|kp|perf|perf_hide|prof|3d|ring|scale)" >&2; exit 2 ;;
 esac

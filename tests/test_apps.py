@@ -1,0 +1,107 @@
+"""End-to-end app integration tests (L6): every entry point runs as a real
+subprocess on virtual CPU devices, exactly as a user would invoke it.
+
+The reference's acceptance procedure is "run the app under srun and check
+the output" (README.md:14-19); these tests automate that for the whole app
+ladder — exit code, key printout lines, and the artifacts (heatmap PNG for
+the vis path, prof.txt report for the profiling app).
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+APPS = REPO / "apps"
+
+
+def run_app(script, *args, timeout=240):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # app must pick cpu via --cpu-devices
+    proc = subprocess.run(
+        [sys.executable, str(APPS / script), *args],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd=REPO,
+        env=env,
+    )
+    assert proc.returncode == 0, (
+        f"{script} failed ({proc.returncode}):\n{proc.stdout}\n{proc.stderr}"
+    )
+    return proc.stdout + proc.stderr
+
+
+@pytest.mark.parametrize("n", [1, 3, 8])
+def test_ring_app(n):
+    out = run_app("ici_ring_test.py", "--cpu-devices", str(n))
+    assert "ring exchange: PASS" in out
+
+
+@pytest.mark.parametrize(
+    "script,extra",
+    [
+        ("diffusion_2d_ap.py", []),
+        ("diffusion_2d_kp.py", []),
+        ("diffusion_2d_perf.py", ["--fact", "0"]),
+        ("diffusion_2d_perf_hide.py", ["--fact", "0", "--b-width", "8,8"]),
+    ],
+)
+def test_2d_apps_run(script, extra):
+    out = run_app(
+        script,
+        "--cpu-devices", "4", "--nx", "64", "--ny", "64", "--nt", "20",
+        "--warmup", "4", "--no-vis", *extra,
+    )
+    assert "Executed 20 steps" in out
+    assert "maximum(T)" in out
+
+
+def test_ap_app_writes_heatmap(tmp_path):
+    out = run_app(
+        "diffusion_2d_ap.py",
+        "--cpu-devices", "4", "--nx", "64", "--ny", "64", "--nt", "10",
+        "--warmup", "2", "--vis",
+    )
+    assert "wrote" in out
+    png = REPO / "output" / "Temp_ap_4_64_64.png"
+    assert png.exists() and png.stat().st_size > 0
+
+
+def test_3d_app_runs():
+    out = run_app(
+        "diffusion_3d_perf_hide.py",
+        "--cpu-devices", "8", "--nx", "32", "--ny", "32", "--nz", "32",
+        "--nt", "10", "--warmup", "2", "--b-width", "4,4,32", "--no-vis",
+    )
+    assert "Executed 10 steps" in out
+
+
+def test_weak_scaling_app():
+    out = run_app(
+        "weak_scaling.py",
+        "--cpu-devices", "4", "--local", "32", "--nt", "20", "--warmup", "4",
+        "--variant", "shard", "--json",
+    )
+    assert "efficiency=100.0%" in out  # n=1 row defines the baseline
+    assert '"devices": 4' in out
+
+
+def test_prof_app_writes_report(tmp_path):
+    report = tmp_path / "prof.txt"
+    trace = tmp_path / "trace"
+    out = run_app(
+        "diffusion_2d_perf_hide_prof.py",
+        "--cpu-devices", "4", "--nx", "64", "--ny", "64", "--nt", "20",
+        "--b-width", "8,8",
+        "--report", str(report), "--profile", str(trace),
+    )
+    assert "Executed 20 steps" in out
+    text = report.read_text()
+    assert "XLA cost analysis" in text
+    assert trace.is_dir()
