@@ -44,21 +44,18 @@ def main(argv=None) -> int:
                    help="emit one JSON line per count as well")
     args = p.parse_args(argv)
 
-    import jax
+    from _common import setup_jax
 
-    if args.cpu_devices:
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
-    if args.dtype == "f64":
-        jax.config.update("jax_enable_x64", True)
-
+    jax = setup_jax(args)  # distributed init + --cpu-devices + x64, shared
     from rocm_mpi_tpu.config import DiffusionConfig
     from rocm_mpi_tpu.models import HeatDiffusion
     from rocm_mpi_tpu.parallel.mesh import suggest_dims
 
     n_avail = len(jax.devices())
     if args.counts:
-        counts = [int(c) for c in args.counts.split(",")]
+        # Ascending, deduplicated: the first row run IS the efficiency
+        # baseline, so the smallest count must come first.
+        counts = sorted({int(c) for c in args.counts.split(",")})
     else:
         counts, c = [], 1
         while c <= n_avail:

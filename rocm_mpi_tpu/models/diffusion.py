@@ -387,6 +387,57 @@ class HeatDiffusion:
             nt, warmup, fused_multi_step_hbm, k, "block_steps"
         )
 
+    def run_deep(
+        self,
+        nt: int | None = None,
+        warmup: int | None = None,
+        block_steps: int | None = None,
+    ) -> RunResult:
+        """Sharded fast path: deep-halo sweeps (parallel.deep_halo) — one
+        width-k ghost exchange per k steps, the multi-chip form of temporal
+        blocking. Works on any mesh (including 1 device, where it reduces
+        to the VMEM-resident loop plus crop overhead). f32/bf16 only on
+        real TPUs (the local kernel is Pallas).
+        """
+        import math
+
+        from rocm_mpi_tpu.ops.pallas_kernels import DEFAULT_TB_STEPS
+        from rocm_mpi_tpu.parallel.deep_halo import make_deep_sweep
+
+        cfg = self.config
+        nt = cfg.nt if nt is None else nt
+        warmup = cfg.warmup if warmup is None else warmup
+        if not 0 <= warmup < nt:
+            raise ValueError(f"need 0 <= warmup < nt, got {warmup}, {nt}")
+        if cfg.halo_transport == "host":
+            warn_host_transport_ignored("deep", stacklevel=2)
+        k = DEFAULT_TB_STEPS if block_steps is None else block_steps
+        eff = math.gcd(math.gcd(warmup, nt - warmup), k) or 1
+        if eff != k:
+            import warnings
+
+            warnings.warn(
+                f"deep-halo sweep depth degraded: block_steps={k} requested "
+                f"but warmup={warmup} / timed={nt - warmup} force k={eff}; "
+                "pick step counts divisible by the sweep depth.",
+                stacklevel=2,
+            )
+        k = eff
+        dt = cfg.jax_dtype(cfg.dt)
+        sweep = make_deep_sweep(self.grid, k, cfg.lam, dt, cfg.spacing)
+
+        @functools.partial(jax.jit, donate_argnums=0)
+        def advance(T, Cp, n_sweeps):
+            return lax.fori_loop(0, n_sweeps, lambda _, x: sweep(x, Cp), T)
+
+        T, Cp = self.init_state()
+        timer = metrics.Timer()
+        T = advance(T, Cp, warmup // k)
+        timer.tic(T)
+        T = advance(T, Cp, (nt - warmup) // k)
+        wtime = timer.toc(T)
+        return RunResult(T=T, wtime=wtime, nt=nt, warmup=warmup, config=cfg)
+
     def _run_host_staged(self, nt: int, warmup: int) -> RunResult:
         """Debug oracle: numpy stepper with host-staged halos
         (IGG_ROCMAWARE_MPI=0 analog; parallel.halo.HostStagedStepper)."""

@@ -387,6 +387,38 @@ def fused_multi_step(T, Cp, lam, dt, spacing, n_steps, chunk=None, interpret=Non
     return lax.fori_loop(0, n_steps // chunk, lambda _, x: run_chunk(x, Cm), T)
 
 
+def multi_step_cm(T, Cm, spacing, n_steps: int, interpret=None):
+    """`n_steps` unrolled roll-based steps on a VMEM-resident block with a
+    caller-supplied masked update coefficient `Cm` (same contract as the
+    coefficient `fused_multi_step` builds internally: dt·λ/Cp where the
+    cell updates, exactly 0.0 where it is held fixed).
+
+    This is the local compute of the deep-halo sweep
+    (parallel.deep_halo): the caller pads the block and zeroes `Cm` on
+    ghost/Dirichlet cells; `n_steps` must not exceed the ghost width.
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    if not _supports_compiled(T.dtype) and not interpret:
+        raise TypeError(f"Mosaic does not support {T.dtype}")
+    if T.shape != Cm.shape:
+        raise ValueError(f"shape mismatch: T {T.shape} vs Cm {Cm.shape}")
+    inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
+    kernel = functools.partial(
+        _multi_step_kernel, inv_d2=inv_d2, chunk=int(n_steps)
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=_out_struct(T.shape, T),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(T, Cm)
+
+
 # ---------------------------------------------------------------------------
 # Temporal blocking for HBM-resident fields: k steps per memory sweep.
 # ---------------------------------------------------------------------------

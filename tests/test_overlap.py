@@ -71,3 +71,69 @@ def test_hide_single_device():
             global_shape=(48, 48), nt=25, warmup=0, dims=(1, 1), b_width=(4, 4)
         )
     )
+
+
+class TestDeepHalo:
+    """Deep-halo sweeps (parallel.deep_halo): k steps per width-k exchange."""
+
+    def _model(self, shape=(64, 64), dims=(2, 2), nt=24, warmup=8):
+        from rocm_mpi_tpu.config import DiffusionConfig
+        from rocm_mpi_tpu.models import HeatDiffusion
+
+        cfg = DiffusionConfig(
+            global_shape=shape,
+            lengths=(10.0,) * len(shape),
+            nt=nt,
+            warmup=warmup,
+            dtype="f32",
+            dims=dims,
+        )
+        return HeatDiffusion(cfg)
+
+    def test_matches_per_step_path(self):
+        import numpy as np
+
+        m = self._model()
+        r_deep = m.run_deep(block_steps=8)
+        r_ref = m.run(variant="perf")
+        np.testing.assert_allclose(
+            np.asarray(r_deep.T), np.asarray(r_ref.T), rtol=2e-5, atol=1e-6
+        )
+
+    def test_k1_matches_per_step_path(self):
+        import numpy as np
+
+        m = self._model(nt=6, warmup=2)
+        r_deep = m.run_deep(block_steps=1)
+        r_ref = m.run(variant="perf")
+        np.testing.assert_allclose(
+            np.asarray(r_deep.T), np.asarray(r_ref.T), rtol=2e-5, atol=1e-6
+        )
+
+    def test_3d_mesh(self):
+        import numpy as np
+
+        m = self._model(shape=(32, 32, 16), dims=(2, 2, 2), nt=8, warmup=4)
+        r_deep = m.run_deep(block_steps=4)
+        r_ref = m.run(variant="perf")
+        np.testing.assert_allclose(
+            np.asarray(r_deep.T), np.asarray(r_ref.T), rtol=2e-5, atol=1e-6
+        )
+
+    def test_depth_exceeding_shard_raises(self):
+        import pytest
+
+        from rocm_mpi_tpu.parallel.deep_halo import make_deep_sweep
+
+        m = self._model(shape=(16, 16), dims=(4, 2))
+        with pytest.raises(ValueError, match="exceeds"):
+            make_deep_sweep(m.grid, 8, 1.0, 1e-4, (0.1, 0.1))
+
+    def test_degraded_depth_warns(self):
+        import warnings
+
+        m = self._model(nt=24, warmup=9)  # gcd(9, 15, 8) = 1
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            m.run_deep(block_steps=8)
+        assert any("degraded" in str(x.message) for x in w)
