@@ -68,6 +68,15 @@ def make_parser(
         "--profile", default=None, metavar="DIR",
         help="trace the timed loop with jax.profiler into DIR",
     )
+    p.add_argument(
+        "--deep", type=int, default=0, metavar="K",
+        help="use deep-halo sweeps: exchange width-K ghosts every K steps "
+        "instead of width-1 every step (parallel.deep_halo; f32/bf16)",
+    )
+    p.add_argument(
+        "--save-field", default=None, metavar="PATH.npy",
+        help="dump the final gathered field as .npy (process 0)",
+    )
     return p
 
 
@@ -139,9 +148,19 @@ def run_app(variant: str, args) -> int:
         if args.profile
         else contextlib.nullcontext()
     )
+    if getattr(args, "deep", 0):
+        # The deep-halo schedule replaces the variant's own step entirely
+        # (variant-specific knobs like --b-width are unused); label the
+        # run and its artifacts accordingly.
+        variant = f"deep{args.deep}"
+        log0(f"--deep: running deep-halo sweeps (k={args.deep}) instead of "
+             "the per-step variant")
     log0("Starting the time loop 🚀...", end="")
     with profile_ctx:
-        result = model.run(variant=variant)
+        if getattr(args, "deep", 0):
+            result = model.run_deep(block_steps=args.deep)
+        else:
+            result = model.run(variant=variant)
     log0("done")
 
     per_chip = result.t_eff / grid.nprocs
@@ -151,8 +170,12 @@ def run_app(variant: str, args) -> int:
         f"{per_chip:.2f} GB/s/chip, {result.gpts:.4f} Gpts/s)"
     )
 
+    T_v = (
+        gather_to_host0(result.T)
+        if (cfg.do_vis or getattr(args, "save_field", None))
+        else None
+    )
     if cfg.do_vis:
-        T_v = gather_to_host0(result.T)
         if T_v is not None:
             log0(f"maximum(T_v) = {T_v.max()}")  # decay invariant (hide.jl:115)
             path = OUTPUT_DIR / viz.artifact_name(
@@ -172,4 +195,13 @@ def run_app(variant: str, args) -> int:
     else:
         # Cheap scalar invariant even without vis: peak must decay.
         log0(f"maximum(T) = {float(result.T.max())}")
+
+    if getattr(args, "save_field", None) and T_v is not None:
+        # The persistence artifact (SURVEY.md §5.4: the reference's only
+        # persisted outputs are the PNG and prof.txt; the .npy dump is the
+        # machine-readable equivalent).
+        out = pathlib.Path(args.save_field)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        np.save(out, T_v)
+        log0(f"wrote {out}")
     return 0

@@ -363,6 +363,22 @@ def fused_multi_step(T, Cp, lam, dt, spacing, n_steps, chunk=None, interpret=Non
         )
     if n_static and n_steps % chunk != 0:
         raise ValueError(f"chunk {chunk} must divide n_steps {n_steps}")
+    # Mosaic compile time grows superlinearly in unrolled-steps × field
+    # size: 252² (64 vregs) compiles chunk=256 in tens of seconds, but
+    # 512² at chunk=64 exceeded 9 minutes (measured). For fields beyond
+    # the 252²-class, cap the chunk; gcd keeps divisibility. Small fields
+    # and explicitly-chosen chunks under the cap are untouched.
+    cap = 16
+    if nbytes > 256 * 1024 and chunk > cap:
+        reduced = math.gcd(chunk, cap) or 1
+        import warnings
+
+        warnings.warn(
+            f"fused_multi_step: chunk {chunk} on a {nbytes}-byte field "
+            f"would stall the Mosaic compiler; reduced to {reduced}.",
+            stacklevel=2,
+        )
+        chunk = reduced
     lam, dt = float(lam), float(dt)
     inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
     # Masked update coefficient, computed ONCE per advance call (not per

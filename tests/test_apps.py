@@ -105,3 +105,19 @@ def test_prof_app_writes_report(tmp_path):
     text = report.read_text()
     assert "XLA cost analysis" in text
     assert trace.is_dir()
+
+
+def test_deep_flag_and_save_field(tmp_path):
+    import numpy as np
+
+    field = tmp_path / "final.npy"
+    out = run_app(
+        "diffusion_2d_perf.py",
+        "--cpu-devices", "4", "--fact", "0", "--nx", "64", "--ny", "64",
+        "--nt", "24", "--warmup", "8", "--deep", "8", "--no-vis",
+        "--save-field", str(field),
+    )
+    assert "Executed 24 steps" in out
+    arr = np.load(field)
+    assert arr.shape == (64, 64)
+    assert 0 < arr.max() < 1.0
