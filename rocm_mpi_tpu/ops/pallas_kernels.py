@@ -340,8 +340,11 @@ def fused_multi_step(T, Cp, lam, dt, spacing, n_steps, chunk=None, interpret=Non
     round-trip every `chunk` steps instead of 3 whole-array passes per step.
     `chunk` is static (Mosaic compile time scales with it; a dynamic
     in-kernel trip count stalls the compiler) and must divide `n_steps`;
-    default gcd(n_steps, 256). The outer trip count is dynamic, so one
-    compiled program serves every `n_steps` with the same chunk. Global
+    default gcd(n_steps, 256), and on fields larger than the 252²-class
+    (256 KB) the effective chunk is capped at gcd(chunk, 16) — larger
+    unrolls over that many vregs stall the Mosaic compiler for minutes.
+    The outer trip count is dynamic, so one compiled program serves every
+    `n_steps` with the same chunk. Global
     boundary = block boundary (Dirichlet).
     """
     import math
@@ -366,19 +369,11 @@ def fused_multi_step(T, Cp, lam, dt, spacing, n_steps, chunk=None, interpret=Non
     # Mosaic compile time grows superlinearly in unrolled-steps × field
     # size: 252² (64 vregs) compiles chunk=256 in tens of seconds, but
     # 512² at chunk=64 exceeded 9 minutes (measured). For fields beyond
-    # the 252²-class, cap the chunk; gcd keeps divisibility. Small fields
-    # and explicitly-chosen chunks under the cap are untouched.
-    cap = 16
-    if nbytes > 256 * 1024 and chunk > cap:
-        reduced = math.gcd(chunk, cap) or 1
-        import warnings
-
-        warnings.warn(
-            f"fused_multi_step: chunk {chunk} on a {nbytes}-byte field "
-            f"would stall the Mosaic compiler; reduced to {reduced}.",
-            stacklevel=2,
-        )
-        chunk = reduced
+    # the 252²-class, cap the chunk (gcd keeps divisibility; see the
+    # docstring — the cap applies to explicit chunks too, because a
+    # stalled compile is strictly worse than a shorter unroll).
+    if nbytes > 256 * 1024:
+        chunk = math.gcd(chunk, 16) or 1
     lam, dt = float(lam), float(dt)
     inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
     # Masked update coefficient, computed ONCE per advance call (not per

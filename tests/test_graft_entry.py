@@ -1,0 +1,71 @@
+"""Driver entry-point self-tests: the compile-check and multi-chip dry run
+the external driver performs, exercised in-repo so regressions surface in
+CI rather than at judging time. Subprocesses, because dryrun_multichip must
+own jax backend initialization (the in-process test backend is pinned to
+the 8-device conftest configuration).
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def run_py(code, env_extra=None, timeout=240):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.update(env_extra or {})
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd=REPO,
+        env=env,
+    )
+    assert proc.returncode == 0, (
+        f"exit {proc.returncode}:\n{proc.stdout}\n{proc.stderr}"
+    )
+    return proc.stdout
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_dryrun_multichip_self_provisioned(n):
+    out = run_py(
+        f"import __graft_entry__ as g; g.dryrun_multichip({n})"
+    )
+    assert "dryrun_multichip ok" in out
+
+
+def test_dryrun_multichip_driver_flags():
+    # The documented driver invocation: devices provided via XLA_FLAGS.
+    out = run_py(
+        "import __graft_entry__ as g; g.dryrun_multichip(8)",
+        env_extra={
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+            "JAX_PLATFORMS": "cpu",
+        },
+    )
+    assert "dryrun_multichip ok" in out
+
+
+def test_entry_compiles_and_runs():
+    out = run_py(
+        """
+import jax
+jax.config.update("jax_platforms", "cpu")
+import __graft_entry__ as g
+fn, args = g.entry()
+out = jax.jit(fn)(*args)
+jax.block_until_ready(out)
+assert out.shape == args[0].shape
+print("entry ok", out.shape)
+"""
+    )
+    assert "entry ok" in out
