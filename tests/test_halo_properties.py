@@ -1,0 +1,91 @@
+"""Property-based halo-exchange tests (hypothesis): for arbitrary grid
+shapes, mesh dims, and ghost widths, `exchange_halo` inside shard_map must
+reproduce a trivially-correct numpy assembly of each shard's padded block
+(neighbor values where the domain continues, zeros past the edge).
+
+This generalizes the hand-picked cases in test_halo.py across the
+configuration space — the closest thing a communication layer gets to a
+race detector (SURVEY.md §5.2: the reference relies on manual discipline;
+here the property is machine-checked).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="property tests need the hypothesis package"
+)
+from hypothesis import given, settings, strategies as st  # noqa: E402
+from jax import shard_map  # noqa: E402
+
+from rocm_mpi_tpu.parallel import exchange_halo, init_global_grid  # noqa: E402
+
+
+def numpy_padded_oracle(g: np.ndarray, dims, coords, width: int):
+    """Shard (coords) of global array g, padded by `width` with true
+    neighbor values, zeros beyond the domain."""
+    local = tuple(n // d for n, d in zip(g.shape, dims))
+    out = np.zeros(tuple(ln + 2 * width for ln in local), dtype=g.dtype)
+    for idx in np.ndindex(*out.shape):
+        gcoord = tuple(
+            c * ln + i - width for c, ln, i in zip(coords, local, idx)
+        )
+        if all(0 <= gc < n for gc, n in zip(gcoord, g.shape)):
+            out[idx] = g[gcoord]
+    return out
+
+
+@st.composite
+def halo_cases(draw):
+    ndim = draw(st.integers(1, 3))
+    dims, shape = [], []
+    budget = 8  # device budget (conftest provides 8)
+    for _ in range(ndim):
+        d = draw(st.sampled_from([1, 2, 4]))
+        while d > 1 and d * int(np.prod(dims or [1])) > budget:
+            d //= 2
+        local = draw(st.integers(2, 5))  # always >= the max width below
+        dims.append(d)
+        shape.append(d * local)
+    width = draw(st.integers(1, 2))
+    return tuple(shape), tuple(dims), width
+
+
+@given(halo_cases())
+@settings(max_examples=25, deadline=None)
+def test_exchange_matches_numpy_oracle(case):
+    shape, dims, width = case
+    grid = init_global_grid(
+        *shape, lengths=tuple(1.0 for _ in shape), dims=dims
+    )
+    g = np.arange(int(np.prod(shape)), dtype=np.float64).reshape(shape)
+    x = jax.device_put(jnp.asarray(g), grid.sharding)
+
+    @jax.jit
+    def padded(x):
+        return shard_map(
+            lambda b: exchange_halo(b, grid, width=width),
+            mesh=grid.mesh,
+            in_specs=grid.spec,
+            out_specs=grid.spec,
+        )(x)
+
+    out = np.asarray(padded(x))
+    local_p = tuple(
+        n // d + 2 * width for n, d in zip(shape, dims)
+    )
+    # out is the per-shard padded blocks re-tiled into one global array of
+    # shape dims[i] * local_p[i]; slice each block back out and compare.
+    for coords in np.ndindex(*dims):
+        sl = tuple(
+            slice(c * lp, (c + 1) * lp) for c, lp in zip(coords, local_p)
+        )
+        block = out[sl]
+        expect = numpy_padded_oracle(g, dims, coords, width)
+        np.testing.assert_array_equal(block, expect, err_msg=(
+            f"shape={shape} dims={dims} width={width} coords={coords}"
+        ))
