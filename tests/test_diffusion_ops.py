@@ -11,7 +11,6 @@ from rocm_mpi_tpu.models import HeatDiffusion
 from rocm_mpi_tpu.ops import stencil
 from rocm_mpi_tpu.ops.diffusion import (
     analytic_solution,
-    gaussian_ic,
     step_flux_form,
     step_fused,
 )

@@ -1,7 +1,6 @@
 """Overlap (perf_hide) correctness: the variant-(3) semantics the reference
 never shipped must agree with every other rung (SURVEY.md §3.4, §4b)."""
 
-import dataclasses
 
 import numpy as np
 import pytest

@@ -5,7 +5,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import shard_map
-from jax.sharding import Mesh, PartitionSpec
+from jax.sharding import PartitionSpec
 
 from rocm_mpi_tpu.parallel import init_global_grid
 from rocm_mpi_tpu.parallel.ring import ring_exchange, ring_exchange_demo
