@@ -151,10 +151,17 @@ def run_app(variant: str, args) -> int:
     if getattr(args, "deep", 0):
         # The deep-halo schedule replaces the variant's own step entirely
         # (variant-specific knobs like --b-width are unused); label the
-        # run and its artifacts accordingly.
-        variant = f"deep{args.deep}"
-        log0(f"--deep: running deep-halo sweeps (k={args.deep}) instead of "
-             "the per-step variant")
+        # run and its artifacts with the depth that will actually execute
+        # (run_deep degrades k when the step counts aren't divisible).
+        from rocm_mpi_tpu.models.diffusion import effective_block_steps
+
+        k_eff = effective_block_steps(
+            cfg.nt, cfg.warmup, args.deep, warn=False
+        )
+        variant = f"deep{k_eff}"
+        log0(f"--deep: running deep-halo sweeps (k={k_eff}"
+             + (f", degraded from {args.deep}" if k_eff != args.deep else "")
+             + ") instead of the per-step variant")
     log0("Starting the time loop 🚀...", end="")
     with profile_ctx:
         if getattr(args, "deep", 0):

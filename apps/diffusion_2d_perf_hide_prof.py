@@ -42,6 +42,11 @@ def main() -> int:
         help="text report path (the reference's ./prof.txt analog)",
     )
     args = parser.parse_args()
+    if not 0 <= args.warmup < args.nt:
+        parser.error(
+            f"need 0 <= warmup < nt, got warmup={args.warmup} nt={args.nt} "
+            "(the default warmup is 12 — raise --nt or lower --warmup)"
+        )
 
     jax = setup_jax(args)
     from rocm_mpi_tpu.models import HeatDiffusion

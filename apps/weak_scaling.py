@@ -48,6 +48,7 @@ def main(argv=None) -> int:
 
     jax = setup_jax(args)  # distributed init + --cpu-devices + x64, shared
     from rocm_mpi_tpu.config import DiffusionConfig
+    from rocm_mpi_tpu.utils.logging import log0
     from rocm_mpi_tpu.models import HeatDiffusion
     from rocm_mpi_tpu.parallel.mesh import suggest_dims
 
@@ -62,13 +63,15 @@ def main(argv=None) -> int:
             counts.append(c)
             c *= 2
     base_per_dev = base_n = None
-    print(
+    # Process-0-gated output: on a multi-host slice every process runs this
+    # script, but only one may report (rank-0 printing, SURVEY.md §5.5).
+    log0(
         f"weak scaling: variant={args.variant}, {args.local}²/device, "
         f"nt={args.nt}, dtype={args.dtype}, {n_avail} device(s) available"
     )
     for n in counts:
         if n > n_avail:
-            print(f"n={n}: skipped (only {n_avail} devices)")
+            log0(f"n={n}: skipped (only {n_avail} devices)")
             continue
         dims = suggest_dims(n, 2)
         shape = (args.local * dims[0], args.local * dims[1])
@@ -89,12 +92,12 @@ def main(argv=None) -> int:
             # list, so label the baseline explicitly.
             base_per_dev, base_n = per_dev, n
         eff = per_dev / base_per_dev
-        print(
+        log0(
             f"n={n:4d} mesh={dims} global={shape}: "
             f"{r.wtime_it * 1e6:9.3f} us/step  {r.gpts:9.4f} Gpts/s "
             f"({per_dev:7.4f}/dev)  efficiency={eff:6.1%} vs n={base_n}"
         )
-        if args.json:
+        if args.json and jax.process_index() == 0:
             print(json.dumps({
                 "metric": f"weak-scaling {args.variant} {args.local}²/dev",
                 "devices": n, "dims": dims, "gpts": round(r.gpts, 4),

@@ -38,6 +38,30 @@ from rocm_mpi_tpu.parallel.mesh import GlobalGrid, init_global_grid
 from rocm_mpi_tpu.utils import metrics
 
 
+def effective_block_steps(
+    nt: int, warmup: int, k: int, *, label: str = "block_steps",
+    warn: bool = True, stacklevel: int = 3,
+) -> int:
+    """The sweep/chunk depth actually usable for the given step counts:
+    gcd(warmup, nt-warmup, k) — both windows must be multiples of the
+    depth so one compiled program serves both. The single source of truth
+    for every runner (and for callers labeling artifacts by depth), so the
+    warned, reported, and executed k can never diverge.
+    """
+    import math
+    import warnings
+
+    eff = math.gcd(math.gcd(warmup, nt - warmup), k) or 1
+    if warn and eff != k:
+        warnings.warn(
+            f"{label} degraded: {k} requested but warmup={warmup} / "
+            f"timed={nt - warmup} force k={eff}; pick step counts "
+            f"divisible by {k} to keep the full k-steps-per-sweep saving.",
+            stacklevel=stacklevel,
+        )
+    return eff
+
+
 def warn_host_transport_ignored(variant: str, stacklevel: int = 3) -> None:
     """The one warning for halo_transport='host' on a variant that keeps its
     device-side communication (only 'shard' routes to the host-staged
@@ -301,8 +325,6 @@ class HeatDiffusion:
         `multi_step_fn(T, Cp, lam, dt, spacing, n, <granularity_kw>=g)` is
         one of ops.pallas_kernels.fused_multi_step / fused_multi_step_hbm.
         """
-        import math
-
         cfg = self.config
         nt = cfg.nt if nt is None else nt
         warmup = cfg.warmup if warmup is None else warmup
@@ -311,7 +333,7 @@ class HeatDiffusion:
         if self.grid.nprocs != 1:
             raise ValueError("single-shard fast paths require an unsharded grid")
         key = granularity_kw
-        gran = math.gcd(math.gcd(warmup, nt - warmup), granularity) or 1
+        gran = effective_block_steps(nt, warmup, granularity, warn=False)
 
         T, Cp = self.init_state()
         dt = cfg.jax_dtype(cfg.dt)
@@ -365,24 +387,13 @@ class HeatDiffusion:
             fused_multi_step_hbm,
         )
 
-        import math
-
         cfg = self.config
         k = DEFAULT_TB_STEPS if block_steps is None else block_steps
         nt_v = cfg.nt if nt is None else nt
         wu_v = cfg.warmup if warmup is None else warmup
-        eff = math.gcd(math.gcd(wu_v, nt_v - wu_v), k) or 1
-        if eff != k:
-            import warnings
-
-            warnings.warn(
-                f"temporal blocking degraded: block_steps={k} requested but "
-                f"warmup={wu_v} / timed={nt_v - wu_v} force k={eff} (both "
-                "windows must be multiples of block_steps to share one "
-                "compiled program); pick step counts divisible by "
-                f"{k} to keep the full k-steps-per-sweep saving.",
-                stacklevel=2,
-            )
+        effective_block_steps(
+            nt_v, wu_v, k, label="temporal blocking block_steps", stacklevel=2
+        )
         return self._run_single_shard(
             nt, warmup, fused_multi_step_hbm, k, "block_steps"
         )
@@ -399,8 +410,6 @@ class HeatDiffusion:
         to the VMEM-resident loop plus crop overhead). f32/bf16 only on
         real TPUs (the local kernel is Pallas).
         """
-        import math
-
         from rocm_mpi_tpu.ops.pallas_kernels import DEFAULT_TB_STEPS
         from rocm_mpi_tpu.parallel.deep_halo import make_deep_sweep
 
@@ -412,17 +421,9 @@ class HeatDiffusion:
         if cfg.halo_transport == "host":
             warn_host_transport_ignored("deep", stacklevel=2)
         k = DEFAULT_TB_STEPS if block_steps is None else block_steps
-        eff = math.gcd(math.gcd(warmup, nt - warmup), k) or 1
-        if eff != k:
-            import warnings
-
-            warnings.warn(
-                f"deep-halo sweep depth degraded: block_steps={k} requested "
-                f"but warmup={warmup} / timed={nt - warmup} force k={eff}; "
-                "pick step counts divisible by the sweep depth.",
-                stacklevel=2,
-            )
-        k = eff
+        k = effective_block_steps(
+            nt, warmup, k, label="deep-halo sweep depth", stacklevel=2
+        )
         dt = cfg.jax_dtype(cfg.dt)
         sweep = make_deep_sweep(self.grid, k, cfg.lam, dt, cfg.spacing)
 

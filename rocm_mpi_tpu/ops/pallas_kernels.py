@@ -414,6 +414,14 @@ def multi_step_cm(T, Cm, spacing, n_steps: int, interpret=None):
         raise TypeError(f"Mosaic does not support {T.dtype}")
     if T.shape != Cm.shape:
         raise ValueError(f"shape mismatch: T {T.shape} vs Cm {Cm.shape}")
+    nbytes = T.size * T.dtype.itemsize
+    if nbytes > _VMEM_BLOCK_BUDGET_BYTES:
+        raise ValueError(
+            f"padded block of {nbytes} bytes exceeds the VMEM-resident "
+            f"budget ({_VMEM_BLOCK_BUDGET_BYTES}); deep-halo sweeps need "
+            "per-device shards that fit VMEM — shard the grid finer or "
+            "use the per-step variants / run_hbm_blocked for large shards"
+        )
     inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
     kernel = functools.partial(
         _multi_step_kernel, inv_d2=inv_d2, chunk=int(n_steps)
@@ -500,8 +508,9 @@ def fused_multi_step_hbm(T, Cp, lam, dt, spacing, n_steps, block_steps=None,
     Requires n_steps % block_steps == 0 (static check when n_steps is a
     Python int; for traced n_steps the trip count floors) and axis-0 length
     divisible by the stripe height (16). Measured on one v5e chip at 12288²
-    f32: 2.06 ms/step — effective T_eff 881 GB/s, above the chip's raw HBM
-    bandwidth, which a 3-passes-per-step design can never reach.
+    f32: ~2 ms/step — effective T_eff ~900 GB/s, above the chip's raw HBM
+    bandwidth, which a 3-passes-per-step design can never reach (current
+    measured numbers: BASELINE.md's results table).
     """
     if interpret is None:
         interpret = _interpret_default()

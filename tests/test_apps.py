@@ -62,14 +62,15 @@ def test_2d_apps_run(script, extra):
     assert "maximum(T)" in out
 
 
-def test_ap_app_writes_heatmap(tmp_path):
+def test_ap_app_writes_heatmap():
+    png = REPO / "output" / "Temp_ap_4_64_64.png"
+    png.unlink(missing_ok=True)  # a stale artifact must not mask a regression
     out = run_app(
         "diffusion_2d_ap.py",
         "--cpu-devices", "4", "--nx", "64", "--ny", "64", "--nt", "10",
         "--warmup", "2", "--vis",
     )
     assert "wrote" in out
-    png = REPO / "output" / "Temp_ap_4_64_64.png"
     assert png.exists() and png.stat().st_size > 0
 
 
