@@ -488,7 +488,8 @@ def _tb_kernel(Tu_ref, Tc_ref, Td_ref, Cu_ref, Cc_ref, Cd_ref, o_ref, *,
     o_ref[:] = T[g:g + tm]
 
 
-DEFAULT_TB_STEPS = 8
+DEFAULT_TB_STEPS = 8  # HBM temporal blocking: bounded by the g=8 ghost rows
+DEFAULT_DEEP_STEPS = 16  # deep-halo sweeps: measured optimum at 252²/chip
 _TB_TM = 16  # stripe height; with g=8 ghosts, tuned to the ~16 MB VMEM limit
 
 
