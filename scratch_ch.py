@@ -1,0 +1,16 @@
+import time, functools
+import jax, jax.numpy as jnp
+import rocm_mpi_tpu.ops.pallas_kernels as pk
+from rocm_mpi_tpu.config import DiffusionConfig
+from rocm_mpi_tpu.models import HeatDiffusion
+from rocm_mpi_tpu.utils.metrics import force
+
+for chunk in (256, 512, 1024):
+    cfg = DiffusionConfig(global_shape=(252, 252), lengths=(10.0, 10.0),
+                          nt=chunk * 8 + chunk * 4096, warmup=chunk * 8,
+                          dtype="f32", dims=(1, 1))
+    m = HeatDiffusion(cfg)
+    t0 = time.perf_counter()
+    r = m._run_single_shard(None, None, pk.fused_multi_step, chunk, "chunk")
+    total = time.perf_counter() - t0
+    print(f"chunk={chunk:5d}: {r.wtime_it*1e6:7.4f} us/step  {r.gpts:8.2f} Gpts/s  (total {total:.0f}s)")
