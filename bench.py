@@ -1,4 +1,4 @@
-"""Framework benchmark — prints ONE JSON line.
+"""Framework benchmark — prints ONE JSON line (always, even on failure).
 
 Headline metric (driver BASELINE.json): Gpts/s/chip for 2D heat diffusion at
 252² per chip — the reference's acceptance-run geometry (4 ranks × 126²
@@ -15,25 +15,182 @@ an *estimate* of the reference's fused-kernel rate on one MI50: peak HBM BW
 1024 GB/s × ~70% achievable for a memory-bound stencil ≈ 717 GB/s T_eff,
 A_eff = 24 B/point (3 f64 passes, perf.jl:55) → ≈ 29.9 Gpts/s/GPU.
 
+Robustness contract (the reference's analog is "run and check the output",
+README.md:14-19 — the run must COMPLETE): the tunneled chip is transiently
+unavailable, and backend init can either fail fast (UNAVAILABLE) or hang for
+minutes. The parent process therefore runs the measurement in a CHILD
+subprocess under a wall-clock budget (default 300 s, env BENCH_BUDGET_S):
+
+  - child hangs        → killed at the deadline, retried if time remains;
+  - child crashes      → retried with exponential backoff (fresh process, so
+                         no poisoned cached-backend state carries over);
+  - budget exhausted   → the contractual JSON line is STILL emitted, with
+                         "value": 0.0 and an explicit "error" field, rc 0.
+
+The child sizes the timed window adaptively from a short calibration run so
+compile + measurement always fit the remaining budget (no unbounded
+multi-million-step run on a slow transport), with a floor that keeps the
+~65 ms tunnel dispatch round-trip amortized to <2% of the timed window.
+
 `--suite` additionally measures the whole ladder (per-step perf/hide at
 252², temporal-blocked and per-step paths at 12288², 3D) and prints a
 human-readable table to stderr — the source of BASELINE.md's measured
-numbers. The default single-line contract is unchanged.
+numbers. It runs inline (manual/diagnostic use; no subprocess shielding).
 """
 
 import json
+import os
+import subprocess
 import sys
+import time
 
 REF_ESTIMATE_GPTS = 29.9  # estimated MI50 fused-kernel rate (see docstring)
+DEFAULT_BUDGET_S = 300.0
+METRIC = "Gpts/s/chip (2D diffusion, 252²/chip)"
+
+# Child exit codes (anything else = unexpected crash, retried).
+RC_OK = 0
+RC_NO_TPU = 3  # backend came up but is not an accelerator
+
+
+def emit(value: float, vs_baseline: float, error: str | None = None) -> None:
+    """The one contractual stdout line."""
+    line = {
+        "metric": METRIC,
+        "value": round(value, 4),
+        "unit": "Gpts/s",
+        "vs_baseline": round(vs_baseline, 4),
+    }
+    if error:
+        line["error"] = error
+    print(json.dumps(line))
+    sys.stdout.flush()
+
+
+# --------------------------------------------------------------------------
+# Child: one attempt at the real measurement (may hang/crash; parent shields)
+# --------------------------------------------------------------------------
+
+
+def _accelerated() -> bool:
+    """True when jax dispatches to an accelerator (tpu or the tunneled-chip
+    'axon' platform), False on the CPU fallback."""
+    import jax
+
+    return jax.devices()[0].platform != "cpu"
+
+
+def _apply_platform_override() -> None:
+    """Re-apply a JAX_PLATFORMS env override through jax.config.
+
+    This image pre-imports jax at interpreter startup with the platform
+    pinned, so the env var alone (e.g. cpu for local testing) is silently
+    ignored unless re-applied before first backend use.
+    """
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", plat)
+        except (RuntimeError, ValueError):
+            pass  # backend already initialized; keep whatever it picked
+
+
+def child_main(budget_s: float) -> int:
+    deadline = time.monotonic() + budget_s
+    import jax  # noqa: F401  (backend init may raise/hang — parent shields)
+
+    _apply_platform_override()
+
+    from rocm_mpi_tpu.config import DiffusionConfig
+    from rocm_mpi_tpu.models import HeatDiffusion
+
+    on_accel = _accelerated()
+
+    def model(nt, warmup):
+        cfg = DiffusionConfig(
+            global_shape=(252, 252),
+            lengths=(10.0, 10.0),
+            nt=nt,
+            warmup=warmup,
+            dtype="f32",
+            dims=(1, 1),
+        )
+        return HeatDiffusion(cfg)
+
+    if not on_accel:
+        # Interpret-mode smoke run: proves the path executes, NOT a rate.
+        print(
+            "bench.py: no accelerator backend — interpret-mode smoke run; "
+            "the reported rate is NOT the benchmark",
+            file=sys.stderr,
+        )
+        r = model(32 + 256, 32).run_vmem_resident()
+        emit(r.gpts, r.gpts / REF_ESTIMATE_GPTS,
+             error="no accelerator backend; interpret-mode smoke value")
+        return RC_NO_TPU
+
+    # Calibration: compile (one program serves all step counts — the outer
+    # trip count is dynamic) + a ~1M-step timed window to estimate the rate.
+    warmup = 32_768
+    calib_steps = 1_048_576
+    t0 = time.monotonic()
+    r = model(warmup + calib_steps, warmup).run_vmem_resident()
+    per_step = r.wtime_it
+    print(
+        f"calibration: {calib_steps} steps, {per_step * 1e6:.3f} µs/step "
+        f"(incl. dispatch), compile+run {time.monotonic() - t0:.1f} s",
+        file=sys.stderr,
+    )
+
+    # Size the real timed window: target a duration that amortizes the
+    # ~65 ms dispatch RTT (<2% ⇒ ≥ ~4 s) but fits the remaining budget —
+    # the budget wins on a degraded transport (a short window is a noisier
+    # number; a killed child is no number at all).
+    remaining = deadline - time.monotonic()
+    target_s = max(4.0, min(15.0, remaining * 0.4))
+    hard_cap_s = max(1.0, remaining - 10.0)
+    timed = int(min(target_s, hard_cap_s) / per_step)
+    timed = min(timed, 33_554_432)
+    timed -= timed % warmup  # keep both windows chunk-divisible
+    if timed < warmup:
+        # Too little budget left for a second window: report the
+        # calibration measurement rather than nothing.
+        print(
+            "bench.py: budget too tight for a full timed window; "
+            "reporting the calibration-window rate",
+            file=sys.stderr,
+        )
+        emit(r.gpts, r.gpts / REF_ESTIMATE_GPTS)
+        return RC_OK
+    print(
+        f"timed window: {timed} steps (~{timed * per_step:.1f} s target, "
+        f"{remaining:.0f} s budget left)",
+        file=sys.stderr,
+    )
+    result = model(warmup + timed, warmup).run_vmem_resident()
+    gpts = result.gpts
+    print(
+        f"252²/chip f32: {timed} timed steps, "
+        f"{result.wtime_it * 1e6:.3f} µs/step, T_eff={result.t_eff:.1f} GB/s "
+        f"(VMEM-resident; HBM-equivalent figure)",
+        file=sys.stderr,
+    )
+    emit(gpts, gpts / REF_ESTIMATE_GPTS)
+    return RC_OK
+
+
+# --------------------------------------------------------------------------
+# Suite (manual/diagnostic; inline, no shielding)
+# --------------------------------------------------------------------------
 
 
 def run_suite() -> None:
-    import jax
-
-    if jax.default_backend() != "tpu":
+    if not _accelerated():
         print(
-            "bench.py --suite requires a TPU backend (off-TPU the kernels "
-            "run in the Pallas interpreter — hours per row); skipping",
+            "bench.py --suite requires an accelerator backend (off-TPU the "
+            "kernels run in the Pallas interpreter — hours per row); skipping",
             file=sys.stderr,
         )
         return
@@ -74,61 +231,129 @@ def run_suite() -> None:
         3_208, 8)
 
 
-def main() -> int:
-    from rocm_mpi_tpu.config import DiffusionConfig
-    from rocm_mpi_tpu.models import HeatDiffusion
+# --------------------------------------------------------------------------
+# Parent: budget, retries, guaranteed JSON
+# --------------------------------------------------------------------------
 
-    import jax
 
-    if "--suite" in sys.argv:
-        run_suite()
-
-    # Step counts are large multiples of the in-kernel chunk (256): the
-    # fixed host→device dispatch latency of the one timed XLA call (~65 ms
-    # measured through the tunneled-chip transport) must be amortized to
-    # noise, or it — not the kernel — is what gets measured. At ~0.4 µs/step
-    # the 4.19M timed steps take ~1.7 s, making the dispatch overhead <4%.
-    # Off-TPU the kernel runs in the Pallas *interpreter* — millions of
-    # steps would take days — so shrink to a smoke-test step count there.
-    if jax.default_backend() == "tpu":
-        warmup, timed = 32_768, 4_194_304
-    else:
-        warmup, timed = 32, 256
+def _env_budget() -> float:
+    raw = os.environ.get("BENCH_BUDGET_S", "")
+    try:
+        return float(raw) if raw else DEFAULT_BUDGET_S
+    except ValueError:
         print(
-            "bench.py: no TPU backend — interpret-mode smoke run "
-            f"({timed} steps); the reported rate is NOT the benchmark",
+            f"bench.py: ignoring malformed BENCH_BUDGET_S={raw!r}; "
+            f"using {DEFAULT_BUDGET_S:.0f}s",
             file=sys.stderr,
         )
-    cfg = DiffusionConfig(
-        global_shape=(252, 252),
-        lengths=(10.0, 10.0),
-        nt=warmup + timed,
-        warmup=warmup,
-        dtype="f32",
-        dims=(1, 1),
-    )
-    model = HeatDiffusion(cfg)
-    # No separate warm-up run needed: run_vmem_resident's own warmup call
-    # compiles the (single, chunk-shared) program before the timer starts.
-    result = model.run_vmem_resident()
-    gpts = result.gpts
-    print(
-        f"252²/chip f32: {result.nt - result.warmup} timed steps, "
-        f"{result.wtime_it * 1e6:.3f} µs/step, T_eff={result.t_eff:.1f} GB/s "
-        f"(VMEM-resident; HBM-equivalent figure)",
-        file=sys.stderr,
-    )
-    print(
-        json.dumps(
-            {
-                "metric": "Gpts/s/chip (2D diffusion, 252²/chip)",
-                "value": round(gpts, 4),
-                "unit": "Gpts/s",
-                "vs_baseline": round(gpts / REF_ESTIMATE_GPTS, 4),
-            }
-        )
-    )
+        return DEFAULT_BUDGET_S
+
+
+def parent_main() -> int:
+    budget = _env_budget()
+    deadline = time.monotonic() + budget
+    attempt = 0
+    backoff = 5.0
+    last_err = "no attempt ran"
+    smoke_line = None  # JSON from a no-accelerator child, kept as fallback
+    no_tpu_runs = 0
+
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining < 45.0:  # not enough for compile + a meaningful window
+            break
+        if no_tpu_runs >= 2:
+            # Backend comes up CPU-only consistently: this machine simply
+            # has no accelerator; more retries can't change that.
+            break
+        attempt += 1
+        child_budget = remaining - 10.0
+        cmd = [
+            sys.executable, os.path.abspath(__file__),
+            "--child", f"--budget={child_budget:.0f}",
+        ]
+        try:
+            proc = subprocess.run(
+                cmd,
+                capture_output=True,
+                text=True,
+                timeout=child_budget,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+        except subprocess.TimeoutExpired as e:
+            stderr_tail = (e.stderr or b"")
+            if isinstance(stderr_tail, bytes):
+                stderr_tail = stderr_tail.decode(errors="replace")
+            sys.stderr.write(stderr_tail[-2000:])
+            last_err = (
+                f"attempt {attempt}: killed after {child_budget:.0f}s "
+                "(backend init hang or slow transport)"
+            )
+            print(f"bench.py: {last_err}", file=sys.stderr)
+            continue
+
+        sys.stderr.write(proc.stderr[-4000:])
+        json_line = None
+        for ln in reversed(proc.stdout.splitlines()):
+            ln = ln.strip()
+            if ln.startswith("{") and ln.endswith("}"):
+                json_line = ln
+                break
+        if proc.returncode == RC_OK and json_line:
+            print(json_line)
+            sys.stdout.flush()
+            return 0
+        if proc.returncode == RC_NO_TPU:
+            # Backend up but CPU-only: in the driver env this means the chip
+            # tunnel isn't attached yet — worth retrying; keep the smoke
+            # line as a last-resort honest fallback.
+            smoke_line = json_line or smoke_line
+            no_tpu_runs += 1
+            last_err = f"attempt {attempt}: no accelerator backend (cpu only)"
+        else:
+            tail = proc.stderr.strip().splitlines()[-1:] or ["<no stderr>"]
+            last_err = f"attempt {attempt}: rc={proc.returncode}: {tail[0][-300:]}"
+        # Only sleep/log when another attempt will actually happen.
+        if no_tpu_runs >= 2 or deadline - time.monotonic() < 45.0 + backoff:
+            print(f"bench.py: {last_err}; giving up", file=sys.stderr)
+            break
+        print(f"bench.py: {last_err}; retrying", file=sys.stderr)
+        time.sleep(backoff)
+        backoff *= 2
+
+    # Budget exhausted without a real measurement: still honor the contract.
+    if smoke_line:
+        print(smoke_line)
+        sys.stdout.flush()
+        return 0
+    emit(0.0, 0.0, error=f"benchmark did not complete within {budget:.0f}s "
+                         f"budget; last: {last_err}")
     return 0
+
+
+def main() -> int:
+    argv = sys.argv[1:]
+    if "--child" in argv:
+        budget = DEFAULT_BUDGET_S
+        for a in argv:
+            if a.startswith("--budget="):
+                budget = float(a.split("=", 1)[1])
+        return child_main(budget)
+    if "--suite" in argv:
+        # Manual/diagnostic mode: no subprocess shielding; honor the
+        # platform override BEFORE run_suite's first backend use, and keep
+        # exit code 0 (the no-TPU child code is a parent-retry signal).
+        _apply_platform_override()
+        run_suite()
+        child_main(_env_budget())
+        return 0
+    # The contract is ONE JSON line no matter what — including parent bugs
+    # or environment surprises outside the retry loop.
+    try:
+        return parent_main()
+    except Exception as e:  # noqa: BLE001
+        emit(0.0, 0.0, error=f"bench parent crashed: {type(e).__name__}: {e}")
+        return 0
 
 
 if __name__ == "__main__":

@@ -51,6 +51,8 @@ def effective_block_steps(
     import math
     import warnings
 
+    if k < 1:
+        raise ValueError(f"{label} must be >= 1, got {k}")
     eff = math.gcd(math.gcd(warmup, nt - warmup), k) or 1
     if warn and eff != k:
         warnings.warn(
@@ -251,6 +253,13 @@ class HeatDiffusion:
             if jnp.dtype(cfg.jax_dtype).itemsize <= 4
             else step_fused_padded
         )
+        if grid.nprocs == 1:
+            # No neighbors → nothing to hide; the boundary/interior strip
+            # bookkeeping is pure overhead (measured r1: 8.2 vs 6.3 µs/step
+            # at 252²). Route to the whole-block step so hide ≥ perf by
+            # construction on one device — the reference's variant (2)/(3)
+            # distinction only exists once communication exists.
+            return self._make_shard_step(pu, check_vma=pu is step_fused_padded)
         local = make_overlap_step(grid, pu, cfg.b_width)
 
         def step(T, Cp, lam, dt, spacing, grid_):
@@ -338,11 +347,15 @@ class HeatDiffusion:
         T, Cp = self.init_state()
         dt = cfg.jax_dtype(cfg.dt)
 
+        # The granularity here is framework-plumbed (not caller-requested),
+        # so an internal cap on it should stay silent.
+        kw = {key: gran}
+        if key == "chunk":
+            kw["warn_on_cap"] = False
+
         @functools.partial(jax.jit, donate_argnums=0)
         def advance(T, Cp, n):
-            return multi_step_fn(
-                T, Cp, cfg.lam, dt, cfg.spacing, n, **{key: gran}
-            )
+            return multi_step_fn(T, Cp, cfg.lam, dt, cfg.spacing, n, **kw)
 
         timer = metrics.Timer()
         T = advance(T, Cp, warmup)  # n=0 still compiles the shared program

@@ -331,7 +331,8 @@ def _multi_step_kernel(T_ref, Cm_ref, out_ref, *, inv_d2, chunk):
 DEFAULT_STEP_CHUNK = 256
 
 
-def fused_multi_step(T, Cp, lam, dt, spacing, n_steps, chunk=None, interpret=None):
+def fused_multi_step(T, Cp, lam, dt, spacing, n_steps, chunk=None, interpret=None,
+                     warn_on_cap=True):
     """Advance a *single-shard* field `n_steps` barely leaving VMEM.
 
     TPU-only optimization (no reference analog — the GPU version must round-
@@ -360,6 +361,7 @@ def fused_multi_step(T, Cp, lam, dt, spacing, n_steps, chunk=None, interpret=Non
             f"({_VMEM_BLOCK_BUDGET_BYTES}); use the per-step path"
         )
     n_static = isinstance(n_steps, int)
+    explicit_chunk = chunk is not None
     if chunk is None:
         chunk = (
             math.gcd(n_steps, DEFAULT_STEP_CHUNK) if n_static else DEFAULT_STEP_CHUNK
@@ -373,7 +375,17 @@ def fused_multi_step(T, Cp, lam, dt, spacing, n_steps, chunk=None, interpret=Non
     # docstring — the cap applies to explicit chunks too, because a
     # stalled compile is strictly worse than a shorter unroll).
     if nbytes > 256 * 1024:
-        chunk = math.gcd(chunk, 16) or 1
+        capped = math.gcd(chunk, 16) or 1
+        if explicit_chunk and warn_on_cap and capped != chunk:
+            import warnings
+
+            warnings.warn(
+                f"chunk degraded: {chunk} requested but the {nbytes}-byte "
+                f"field exceeds the 256 KB unroll-friendly class; running "
+                f"chunk={capped} (longer unrolls stall the Mosaic compiler).",
+                stacklevel=2,
+            )
+        chunk = capped
     lam, dt = float(lam), float(dt)
     inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
     # Masked update coefficient, computed ONCE per advance call (not per

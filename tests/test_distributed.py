@@ -1,0 +1,74 @@
+"""Multi-host process model (D9) — executed, not just written.
+
+The reference's process model is `srun -n N --mpi=pmix` + PMIx wiring
+(/root/reference/README.md:18). Here the test plays the launcher: it spawns
+2 real Python processes, hands each its rank via the framework's launcher
+env contract (RMT_COORDINATOR/RMT_NUM_PROCS/RMT_PROCESS_ID), and the
+workers (tests/distributed_worker.py) form a jax.distributed cluster over
+gloo, run a sharded step whose halo exchange crosses the process boundary,
+and gather to process 0 — exercising maybe_initialize_distributed,
+gather_to_host0's process_allgather branch, and metrics.force's
+non-addressable branch.
+"""
+
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_step_and_gather():
+    port = _free_port()
+    base = os.environ.copy()
+    # The workers size their own device count (2 cpu devices per process);
+    # an inherited XLA_FLAGS device-count force would conflict with it.
+    base.pop("XLA_FLAGS", None)
+    procs = []
+    for pid in range(2):
+        env = dict(
+            base,
+            JAX_PLATFORMS="cpu",
+            RMT_DISTRIBUTED="1",
+            RMT_COORDINATOR=f"127.0.0.1:{port}",
+            RMT_NUM_PROCS="2",
+            RMT_PROCESS_ID=str(pid),
+            RMT_INIT_TIMEOUT_S="60",
+            # The worker imports the package from the repo root (the spawned
+            # interpreter only gets the script's own dir on sys.path).
+            PYTHONPATH=os.pathsep.join(
+                [str(ROOT)] + ([base["PYTHONPATH"]] if "PYTHONPATH" in base else [])
+            ),
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(ROOT / "tests" / "distributed_worker.py")],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+                cwd=ROOT,
+            )
+        )
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=240))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for pid, (p, (out, err)) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, (
+            f"worker {pid} rc={p.returncode}\n--- stdout ---\n{out}"
+            f"\n--- stderr ---\n{err[-3000:]}"
+        )
+    assert "DISTRIBUTED_OK" in outs[0][0], outs[0][0]

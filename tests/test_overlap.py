@@ -136,3 +136,40 @@ class TestDeepHalo:
             warnings.simplefilter("always")
             m.run_deep(block_steps=8)
         assert any("degraded" in str(x.message) for x in w)
+
+
+def test_effective_block_steps_rejects_nonpositive():
+    from rocm_mpi_tpu.models.diffusion import effective_block_steps
+
+    with pytest.raises(ValueError, match=">= 1"):
+        effective_block_steps(24, 8, 0)
+    with pytest.raises(ValueError, match=">= 1"):
+        effective_block_steps(24, 8, -4)
+
+
+def test_hide_single_device_routes_to_whole_block_step():
+    # On a 1-device mesh there is nothing to hide: the hide variant must be
+    # bit-identical to perf (same whole-block step, no strip bookkeeping).
+    cfg = DiffusionConfig(
+        global_shape=(48, 48), nt=12, warmup=0, dims=(1, 1),
+        b_width=(4, 4), dtype="f32",
+    )
+    model = HeatDiffusion(cfg)
+    r_h = model.run(variant="hide")
+    r_p = model.run(variant="perf")
+    np.testing.assert_array_equal(np.asarray(r_h.T), np.asarray(r_p.T))
+
+
+def test_explicit_chunk_cap_warns():
+    import warnings
+
+    import jax.numpy as jnp
+
+    from rocm_mpi_tpu.ops.pallas_kernels import fused_multi_step
+
+    T = jnp.zeros((512, 512), jnp.float32)  # > 256 KB: chunk capped to 16
+    Cp = jnp.ones_like(T)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        fused_multi_step(T, Cp, 1.0, 1e-5, (0.1, 0.1), 64, chunk=64)
+    assert any("chunk degraded" in str(x.message) for x in w)
