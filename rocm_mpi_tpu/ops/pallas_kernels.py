@@ -36,6 +36,10 @@ from jax.experimental.pallas import tpu as pltpu
 # Whole-block kernels hold ~5 block-sized buffers in VMEM; stay well under
 # the ~16 MB/core budget (pallas_guide.md "Memory Hierarchy").
 _VMEM_BLOCK_BUDGET_BYTES = 2 * 1024 * 1024
+# Largest in-kernel working slab ((tm+2g) rows) the per-step striped kernel
+# may assemble: beyond this the pipeline buffers + lap temporaries blow the
+# VMEM compile boundary (measured on v5e, see masked_step's tm selection).
+_PS_SLAB_BUDGET_BYTES = 2_500_000
 
 
 def _supports_compiled(dtype) -> bool:
@@ -137,66 +141,53 @@ def _fused_kernel_striped(Ta_ref, Tb_ref, Cp_ref, out_ref, *, lam, dt, inv_d2):
     )
 
 
-def _pick_tm(n_rows: int, row_elems: int, itemsize: int) -> int:
-    """Stripe height: largest divisor of `n_rows` that keeps one stripe
-    (`row_elems` elements per padded row) within the per-buffer VMEM budget.
-    The striped kernel holds 4 block operands, each double-buffered by the
-    Pallas pipeline (~8 stripe-sized buffers live at once, against the
-    ~16 MB scoped-VMEM limit — hence budget/2 per buffer). The analog of
-    the reference's `threads=(32,8)` tile knob (perf.jl:23), chosen
-    automatically."""
+def _stripe_height(row_bytes: int) -> int:
+    """Stripe height for the striped kernels: sized so one stripe
+    (`row_bytes` bytes per padded row) fits the per-buffer VMEM budget
+    (the striped kernel holds ~4 block operands, each double-buffered by
+    the Pallas pipeline — hence budget/2 per buffer), rounded down to the
+    f32 sublane tile (8). The analog of the reference's `threads=(32,8)`
+    tile knob (perf.jl:23), chosen automatically.
+
+    No divisibility constraint on the row count: the grid is
+    ceil-divided and Pallas masks partial trailing blocks (out-of-range
+    reads feed only dropped rows; out-of-range writes are dropped) —
+    pad-to-tile without materializing any padding.
+    """
     per_buffer = _VMEM_BLOCK_BUDGET_BYTES // 2
-    target = max(8, per_buffer // max(1, row_elems * itemsize))
-    best = 1
-    for d in range(1, min(n_rows, target) + 1):
-        if n_rows % d == 0 and (d % 8 == 0 or best < 8):
-            best = max(best, d)
-    return best
+    return max(8, (per_buffer // max(1, row_bytes)) // 8 * 8)
 
 
-def _fused_step_striped(Tp, Cp, lam, dt, inv_d2, interpret):
-    core = Cp.shape  # Tp is core + 2 per axis
+def _striped_call(kernel, Tp, C, interpret):
+    """Shared launch of the 3-slot striped kernels over ceil(n1/tm) stripes.
+
+    Output stripe i (tm core rows) reads padded rows [i·tm, i·tm+tm+2),
+    assembled in-kernel from padded row-blocks i and i+1 — overlapping
+    windows built from non-overlapping BlockSpecs. `C` is the core-shaped
+    coefficient operand (Cp or Cm). Partial-stripe bookkeeping:
+      - last output stripe may be partial → Pallas drops OOB writes;
+      - block i+1 may be partly or wholly OOB on Tp → its index is clamped
+        and the garbage rows feed only dropped output rows (when the core
+        row count is ≤ tm-2 past the last full stripe, every needed padded
+        row is already inside block i; otherwise row n1+1 exists in Tp).
+    """
+    core = C.shape  # Tp is core + 2 per axis
     n1, rest = core[0], core[1:]
     rest_p = tuple(n + 2 for n in rest)
-    row_bytes = 1
+    row_bytes = C.dtype.itemsize
     for n in rest_p:
         row_bytes *= n
-    tm = _pick_tm(n1, row_bytes, Cp.dtype.itemsize)
-    if tm < 2:
-        # The stripe overlap reads two rows of the next block, so tm >= 2 is
-        # structural. A prime row count has no usable divisor: fall back to
-        # the whole-block kernel (correct; may stress VMEM on huge grids).
-        kernel = functools.partial(
-            _fused_kernel_whole, lam=lam, dt=dt, inv_d2=inv_d2
-        )
-        return pl.pallas_call(
-            kernel,
-            out_shape=_out_struct(core, Cp),
-            in_specs=[
-                pl.BlockSpec(memory_space=pltpu.VMEM),
-                pl.BlockSpec(memory_space=pltpu.VMEM),
-            ],
-            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-            interpret=interpret,
-        )(Tp, Cp)
-    grid = (n1 // tm,)
-    kernel = functools.partial(
-        _fused_kernel_striped, lam=lam, dt=dt, inv_d2=inv_d2
-    )
+    tm = _stripe_height(row_bytes)
+    grid = (-(-n1 // tm),)
     zeros = (0,) * len(rest)
     return pl.pallas_call(
         kernel,
-        out_shape=_out_struct(core, Cp),
+        out_shape=_out_struct(core, C),
         grid=grid,
         in_specs=[
-            # Padded row-block i (height tm, full padded extent elsewhere).
             pl.BlockSpec(
                 (tm,) + rest_p, lambda i: (i,) + zeros, memory_space=pltpu.VMEM
             ),
-            # Padded row-block i+1: only its first 2 rows are read. For the
-            # last stripe this block starts at padded row n1, which exists
-            # (the pad ring supplies rows n1, n1+1); its out-of-range tail
-            # is Pallas-masked and never read.
             pl.BlockSpec(
                 (tm,) + rest_p,
                 lambda i: (i + 1,) + zeros,
@@ -210,7 +201,71 @@ def _fused_step_striped(Tp, Cp, lam, dt, inv_d2, interpret):
             (tm,) + rest, lambda i: (i,) + zeros, memory_space=pltpu.VMEM
         ),
         interpret=interpret,
-    )(Tp, Tp, Cp)
+    )(Tp, Tp, C)
+
+
+def _fused_step_striped(Tp, Cp, lam, dt, inv_d2, interpret):
+    kernel = functools.partial(
+        _fused_kernel_striped, lam=lam, dt=dt, inv_d2=inv_d2
+    )
+    return _striped_call(kernel, Tp, Cp, interpret)
+
+
+# ---------------------------------------------------------------------------
+# Cm-masked per-step kernels: the Dirichlet mask and the dt·λ/Cp divide are
+# folded into a precomputed coefficient Cm (edge_masked_cm / the sharded
+# boundary-masked equivalent), computed ONCE per run instead of per step —
+# one kernel per step replaces the reference-parity path's
+# kernel + divide + where-mask op chain.
+# ---------------------------------------------------------------------------
+
+
+def _fused_kernel_whole_cm(Tp_ref, Cm_ref, out_ref, *, inv_d2):
+    Tp = Tp_ref[:]
+    core = tuple(slice(1, -1) for _ in range(Tp.ndim))
+    out_ref[:] = Tp[core] + Cm_ref[:] * _lap_from_padded(Tp, inv_d2)
+
+
+def _fused_kernel_striped_cm(Ta_ref, Tb_ref, Cm_ref, out_ref, *, inv_d2):
+    ext = jnp.concatenate([Ta_ref[:], Tb_ref[:2]], axis=0)
+    core = tuple(slice(1, -1) for _ in range(ext.ndim))
+    out_ref[:] = ext[core] + Cm_ref[:] * _lap_from_padded(ext, inv_d2)
+
+
+def fused_step_cm(Tp, Cm, spacing, interpret=None):
+    """Masked per-step core update: new = Tp[core] + Cm · ∇²(Tp).
+
+    `Tp` is the width-1-padded block (ghosts from exchange_halo); `Cm` is
+    the core-shaped masked coefficient — (dt·λ)/Cp where the cell updates,
+    exactly 0.0 where it is held fixed (global Dirichlet boundary). Because
+    the mask is data, the Dirichlet `where` of the unmasked contract
+    disappears and one Pallas program serves the whole step (the fused
+    memory-bound kernel of diffusion_2D_perf.jl:3-13, with its `ix>1 && …`
+    guard carried by Cm instead of control flow). Whole-block in VMEM when
+    the shard fits, 3-slot striped otherwise.
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    if not _supports_compiled(Tp.dtype) and not interpret:
+        raise TypeError(
+            f"Mosaic does not support {Tp.dtype}; use the jnp path or "
+            "interpret mode for f64 parity runs"
+        )
+    inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
+    nbytes = Cm.size * Cm.dtype.itemsize
+    if Tp.ndim in (2, 3) and nbytes > _VMEM_BLOCK_BUDGET_BYTES:
+        kernel = functools.partial(_fused_kernel_striped_cm, inv_d2=inv_d2)
+        return _striped_call(kernel, Tp, Cm, interpret)
+    return pl.pallas_call(
+        functools.partial(_fused_kernel_whole_cm, inv_d2=inv_d2),
+        out_shape=_out_struct(Cm.shape, Cm),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(Tp, Cm)
 
 
 # ---------------------------------------------------------------------------
@@ -455,14 +510,25 @@ def multi_step_cm(T, Cm, spacing, n_steps: int, interpret=None):
 # ---------------------------------------------------------------------------
 
 
-def _edge_masked_cm(T, Cp, lam, dt):
-    """dt·λ/Cp on the interior, exactly 0.0 on the global Dirichlet edge."""
+def edge_masked_cm(T, Cp, lam, dt):
+    """(dt·λ)/Cp on the interior, exactly 0.0 on the global Dirichlet edge.
+
+    The masked update coefficient of the Cm-contract kernels
+    (fused_step_cm / masked_step / multi_step_cm): cells with Cm == 0.0
+    stay bit-identically fixed (old + 0.0·lap == old), carrying the
+    reference's interior-only guard (perf.jl:7) as data. Unsharded form —
+    the block edge IS the global boundary; the sharded form masks via
+    parallel.halo.global_boundary_mask instead.
+    """
     mask = None
     for ax in range(T.ndim):
         idx = lax.broadcasted_iota(jnp.int32, T.shape, ax)
         m = (idx == 0) | (idx == T.shape[ax] - 1)
         mask = m if mask is None else (mask | m)
     return jnp.where(mask, jnp.zeros_like(Cp), (dt * lam) / Cp)
+
+
+_edge_masked_cm = edge_masked_cm  # internal alias (pre-r3 name)
 
 
 def _tb_kernel(Tu_ref, Tc_ref, Td_ref, Cu_ref, Cc_ref, Cd_ref, o_ref, *,
@@ -498,6 +564,30 @@ def _tb_kernel(Tu_ref, Tc_ref, Td_ref, Cu_ref, Cc_ref, Cd_ref, o_ref, *,
             lap = term if lap is None else lap + term
         T = T + Cm * lap
     o_ref[:] = T[g:g + tm]
+
+
+def _stripe_ghost_specs(tm, g, n0, rest):
+    """(core, gup, gdn) BlockSpecs shared by the ghost-block stripe
+    pipelines (_tb_kernel and _per_step_kernel): core stripe i (tm rows)
+    plus the clamped g-row ghost blocks above/below it. The domain-edge
+    clamps re-read an interior block; the kernels zero those via the
+    i==0 / i==n-1 selects."""
+    r = tm // g
+    zeros = (0,) * len(rest)
+    core = pl.BlockSpec(
+        (tm,) + rest, lambda i: (i,) + zeros, memory_space=pltpu.VMEM
+    )
+    gup = pl.BlockSpec(
+        (g,) + rest,
+        lambda i: (jnp.maximum(i * r - 1, 0),) + zeros,
+        memory_space=pltpu.VMEM,
+    )
+    gdn = pl.BlockSpec(
+        (g,) + rest,
+        lambda i: (jnp.minimum((i + 1) * r, n0 // g - 1),) + zeros,
+        memory_space=pltpu.VMEM,
+    )
+    return core, gup, gdn
 
 
 DEFAULT_TB_STEPS = 8  # HBM temporal blocking: bounded by the g=8 ghost rows
@@ -544,21 +634,7 @@ def fused_multi_step_hbm(T, Cp, lam, dt, spacing, n_steps, block_steps=None,
     lam, dt = float(lam), float(dt)
     inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
     Cm = _edge_masked_cm(T, Cp, lam, dt)
-    rest = T.shape[1:]
-    zeros = (0,) * len(rest)
-    core = pl.BlockSpec(
-        (tm,) + rest, lambda i: (i,) + zeros, memory_space=pltpu.VMEM
-    )
-    gup = pl.BlockSpec(
-        (g,) + rest,
-        lambda i: (jnp.maximum(i * (tm // g) - 1, 0),) + zeros,
-        memory_space=pltpu.VMEM,
-    )
-    gdn = pl.BlockSpec(
-        (g,) + rest,
-        lambda i: (jnp.minimum((i + 1) * (tm // g), n0 // g - 1),) + zeros,
-        memory_space=pltpu.VMEM,
-    )
+    core, gup, gdn = _stripe_ghost_specs(tm, g, n0, T.shape[1:])
     kernel = functools.partial(_tb_kernel, inv_d2=inv_d2, k=k, g=g, tm=tm)
     sweep = pl.pallas_call(
         kernel,
@@ -571,3 +647,130 @@ def fused_multi_step_hbm(T, Cp, lam, dt, spacing, n_steps, block_steps=None,
     return lax.fori_loop(
         0, n_steps // k, lambda _, x: sweep(x, x, x, Cm, Cm, Cm), T
     )
+
+
+# ---------------------------------------------------------------------------
+# Unsharded per-step sweep: one kernel per step for HBM-resident fields —
+# the reference-parity rung (one whole-field pass per step, perf.jl:47-52)
+# without the pad/divide/where op chain around it.
+# ---------------------------------------------------------------------------
+
+
+def _per_step_kernel(Tu_ref, Tc_ref, Td_ref, Cm_ref, o_ref, *, inv_d2, g, tm):
+    """Advance one axis-0 stripe by ONE step from a (g+tm+g)-row slab.
+
+    The k=1 specialization of the temporal-blocking structure (_tb_kernel):
+    because only the immediately adjacent row feeds a 1-step update, the
+    coefficient needs no ghost blocks — Cm is read core-only, cutting a
+    whole array pass per step versus the k-step slab. Domain-edge ghost
+    blocks are zeroed; their values only multiply into cells the zero-Cm
+    edge ring holds fixed. Requires the row count divisible by the stripe
+    height: a partial trailing stripe would feed Pallas-masked (undefined)
+    rows into the last valid row's neighborhood, where NaN·0.0 could leak
+    through the Cm guard — masked_step falls back to the padded-contract
+    kernel for such shapes.
+    """
+    i = pl.program_id(0)
+    n_i = pl.num_programs(0)
+    zg = jnp.zeros_like(Tu_ref[:])
+    T = jnp.concatenate(
+        [jnp.where(i == 0, zg, Tu_ref[:]), Tc_ref[:],
+         jnp.where(i == n_i - 1, zg, Td_ref[:])], 0)
+    lap = None
+    for ax in range(T.ndim):
+        term = (
+            jnp.roll(T, -1, ax) + jnp.roll(T, 1, ax) - 2.0 * T
+        ) * inv_d2[ax]
+        lap = term if lap is None else lap + term
+    o_ref[:] = Tc_ref[:] + Cm_ref[:] * lap[g:g + tm]
+
+
+def _masked_step_striped(T, Cm, inv_d2, interpret, tm, g):
+    n0, rest = T.shape[0], T.shape[1:]
+    core, gup, gdn = _stripe_ghost_specs(tm, g, n0, rest)
+    kernel = functools.partial(_per_step_kernel, inv_d2=inv_d2, g=g, tm=tm)
+    return pl.pallas_call(
+        kernel,
+        out_shape=_out_struct(T.shape, T),
+        grid=(n0 // tm,),
+        in_specs=[gup, core, gdn, core],
+        out_specs=core,
+        interpret=interpret,
+    )(T, T, T, Cm)
+
+
+def masked_step(T, Cm, spacing, interpret=None, tm=None):
+    """Unsharded per-step update with the mask folded into `Cm`: one Pallas
+    program per step.
+
+    The reference-parity per-step schedule (one whole-field sweep per step,
+    perf.jl:47-52) for a single-device grid: `Cm` (edge_masked_cm) carries
+    both (dt·λ)/Cp and the Dirichlet guard, computed once per run — so each
+    step is exactly one kernel, with no ghost-pad copy, no per-step divide,
+    and no where-mask pass. Dispatch: VMEM-resident roll kernel
+    (multi_step_cm, n=1) for fields that fit; the ghost-block striped sweep
+    for HBM-resident fields with stripe-divisible rows; zero-ghost pad +
+    the padded-contract striped kernel for everything else.
+
+    `tm` overrides the stripe height (tuning knob — the threads=(32,8)
+    analog); must be a multiple of 8.
+    """
+    if T.shape != Cm.shape:
+        raise ValueError(f"shape mismatch: T {T.shape} vs Cm {Cm.shape}")
+    if interpret is None:
+        interpret = _interpret_default()
+    if not _supports_compiled(T.dtype) and not interpret:
+        raise TypeError(f"Mosaic does not support {T.dtype}")
+    nbytes = T.size * T.dtype.itemsize
+    if nbytes <= _VMEM_BLOCK_BUDGET_BYTES:
+        return multi_step_cm(T, Cm, spacing, 1, interpret=interpret)
+    inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
+    g = 8
+    n0 = T.shape[0]
+    tm_explicit = tm is not None
+    if tm is None:
+        row_bytes = T.dtype.itemsize
+        for n in T.shape[1:]:
+            row_bytes *= n
+        base = _stripe_height(row_bytes)
+        # Taller stripes amortize the per-stripe DMA overhead (measured on
+        # v5e at 12288² f32: tm=32 ≈ 254 GB/s T_eff vs tm=16 ≈ 241) —
+        # prefer 2× the budget height when it divides the row count AND the
+        # in-kernel slab (tm+2g rows, concatenated + ~3 lap temporaries)
+        # stays under the measured Mosaic compile boundary (~2.4 MB slab:
+        # 12288²/tm=48 and 8192²/tm=64 both exceed it and fail to compile).
+        # No candidate fitting → None → the pad fallback (very wide rows,
+        # where even the base slab would blow the compile boundary).
+        tm = next(
+            (
+                c
+                for c in (2 * base, base)
+                if c >= g
+                and n0 % c == 0
+                and (c + 2 * g) * row_bytes <= _PS_SLAB_BUDGET_BYTES
+            ),
+            None,
+        )
+    strip_ok = (
+        tm is not None
+        and T.ndim in (2, 3)
+        and tm % g == 0
+        and n0 % tm == 0
+        and n0 % g == 0
+    )
+    if strip_ok:
+        return _masked_step_striped(T, Cm, inv_d2, interpret, tm, g)
+    if tm_explicit:
+        import warnings
+
+        warnings.warn(
+            f"masked_step tm={tm} ignored: the striped path needs a 2D/3D "
+            f"field with tm and the row count ({n0}) divisible by {g} and "
+            "n0 % tm == 0; running the pad + padded-contract fallback "
+            "instead.",
+            stacklevel=2,
+        )
+    # General-shape fallback: zero ghost ring + the padded-contract striped
+    # kernel (edge Cm = 0.0 makes the ghost values irrelevant).
+    Tp = jnp.pad(T, [(1, 1)] * T.ndim)
+    return fused_step_cm(Tp, Cm, spacing, interpret=interpret)

@@ -48,6 +48,103 @@ def test_multi_step_matches_stepwise():
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-12)
 
 
+def _cm_oracle(Tp, Cm, spacing):
+    """jnp oracle of the Cm contract: new core = Tp[core] + Cm·lap(Tp)."""
+    ndim = Cm.ndim
+    core = tuple(slice(1, -1) for _ in range(ndim))
+    lap = jnp.zeros_like(Cm)
+    for ax in range(ndim):
+        hi = tuple(
+            slice(2, None) if a == ax else slice(1, -1) for a in range(ndim)
+        )
+        lo = tuple(
+            slice(None, -2) if a == ax else slice(1, -1) for a in range(ndim)
+        )
+        lap = lap + (Tp[hi] - 2.0 * Tp[core] + Tp[lo]) / (
+            spacing[ax] * spacing[ax]
+        )
+    return Tp[core] + Cm * lap
+
+
+def test_fused_step_cm_whole_matches_oracle():
+    Tp = _rand((34, 30))
+    Cm = _rand((32, 28), seed=1) * 1e-4
+    got = pk.fused_step_cm(Tp, Cm, (0.1, 0.07))
+    ref = _cm_oracle(Tp, Cm, (0.1, 0.07))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-13)
+
+
+def test_fused_step_cm_striped_matches_oracle(monkeypatch):
+    monkeypatch.setattr(pk, "_VMEM_BLOCK_BUDGET_BYTES", 1024)
+    # 61 rows: NOT a multiple of the stripe height — exercises the ceil
+    # grid + Pallas-masked partial trailing blocks (no divisor hunting).
+    Tp = _rand((63, 50))
+    Cm = _rand((61, 48), seed=1) * 1e-4
+    got = pk.fused_step_cm(Tp, Cm, (0.1, 0.1))
+    ref = _cm_oracle(Tp, Cm, (0.1, 0.1))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-13)
+
+
+def test_striped_nondivisible_rows(monkeypatch):
+    # The unmasked striped kernel on a prime row count: previously fell
+    # back to whole-block; now runs striped with a partial trailing stripe.
+    monkeypatch.setattr(pk, "_VMEM_BLOCK_BUDGET_BYTES", 1024)
+    Tp = _rand((69, 50))
+    Cp = 1.0 + _rand((67, 48), seed=1)
+    args = (1.0, 2e-4, (0.1, 0.1))
+    ref = step_fused_padded(Tp, Cp, *args)
+    got = fused_step_padded(Tp, Cp, *args)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-13)
+
+
+def test_masked_step_small_matches_step_fused():
+    # VMEM-resident dispatch: masked_step(T, edge_masked_cm) == step_fused
+    # (edge cells bit-identically held: old + 0.0·lap).
+    T = _rand((32, 28))
+    Cp = 1.0 + _rand((32, 28), seed=1)
+    lam, dt, spacing = 1.3, 1e-4, (0.1, 0.07)
+    Cm = pk.edge_masked_cm(T, Cp, lam, dt)
+    got = pk.masked_step(T, Cm, spacing)
+    ref = step_fused(T, Cp, lam, dt, spacing)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-13)
+    np.testing.assert_array_equal(np.asarray(got)[0], np.asarray(T)[0])
+
+
+def test_masked_step_striped_matches_step_fused(monkeypatch):
+    monkeypatch.setattr(pk, "_VMEM_BLOCK_BUDGET_BYTES", 1024)
+    T = _rand((64, 48))
+    Cp = 1.0 + _rand((64, 48), seed=1)
+    lam, dt, spacing = 1.0, 2e-4, (0.1, 0.1)
+    Cm = pk.edge_masked_cm(T, Cp, lam, dt)
+    got = pk.masked_step(T, Cm, spacing)
+    ref = step_fused(T, Cp, lam, dt, spacing)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-13)
+
+
+def test_masked_step_pad_fallback_matches_step_fused(monkeypatch):
+    # 60 rows, stripe height 8: 60 % 8 != 0, so the garbage-safe route is
+    # the zero-ghost pad + padded-contract striped kernel.
+    monkeypatch.setattr(pk, "_VMEM_BLOCK_BUDGET_BYTES", 1024)
+    T = _rand((60, 48))
+    Cp = 1.0 + _rand((60, 48), seed=1)
+    lam, dt, spacing = 1.0, 2e-4, (0.1, 0.1)
+    Cm = pk.edge_masked_cm(T, Cp, lam, dt)
+    got = pk.masked_step(T, Cm, spacing)
+    ref = step_fused(T, Cp, lam, dt, spacing)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-13)
+
+
+def test_masked_step_3d_striped(monkeypatch):
+    monkeypatch.setattr(pk, "_VMEM_BLOCK_BUDGET_BYTES", 1024)
+    T = _rand((16, 10, 8))
+    Cp = 1.0 + _rand((16, 10, 8), seed=1)
+    lam, dt, spacing = 0.8, 5e-5, (0.3, 0.4, 0.5)
+    Cm = pk.edge_masked_cm(T, Cp, lam, dt)
+    got = pk.masked_step(T, Cm, spacing)
+    ref = step_fused(T, Cp, lam, dt, spacing)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-13)
+
+
 def test_perf_variant_matches_ap_on_mesh():
     cfg = DiffusionConfig(global_shape=(64, 64), nt=40, warmup=0, dims=(4, 2))
     model = HeatDiffusion(cfg)

@@ -1,0 +1,33 @@
+"""TPU smoke tier (VERDICT r2 ask #2): compiled-Mosaic correctness.
+
+The main `tests/` suite deliberately runs on a virtual 8-device CPU mesh
+with interpret-mode Pallas — it can't see Mosaic (TPU compiler) bugs. This
+tier compiles every kernel path on the real chip at tiny sizes and asserts
+against the jnp oracle — the hardware analog of the reference's "run it and
+check the output" acceptance step (/root/reference/README.md:14-19).
+
+Run manually on TPU hardware:  python -m pytest tests_tpu/ -q
+(the whole tier auto-skips without an accelerator backend; the log of a
+real-chip run is committed as docs/tpu_test_log_r3.txt).
+
+Unlike tests/conftest.py this file must NOT force a platform or x64 —
+the point is the real backend, f32, compiled (not interpret) Pallas.
+"""
+
+import jax
+import pytest
+
+
+def pytest_collection_modifyitems(config, items):
+    import rocm_mpi_tpu.ops.pallas_kernels as pk
+
+    if pk._interpret_default():
+        # Matches the kernels' own dispatch: any backend where
+        # interpret=None resolves to the interpreter (cpu, gpu, ...) has
+        # nothing to smoke-test here.
+        skip = pytest.mark.skip(
+            reason="TPU smoke tier needs a TPU backend "
+            "(kernels would run interpreted — not the point of this tier)"
+        )
+        for item in items:
+            item.add_marker(skip)

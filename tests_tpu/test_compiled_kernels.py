@@ -1,0 +1,214 @@
+"""Every Mosaic (compiled Pallas) path vs the jnp oracle, tiny shapes, f32.
+
+Covers the kernel inventory the CPU suite can only interpret: whole-block,
+striped (divisible + partial-stripe), kp 3-kernel, VMEM-resident multi-step,
+temporal-blocked HBM sweep (2D + 3D), deep-halo local compute, the Cm
+per-step family, the hide strip kernels, and the model-level runners.
+Tolerances are f32-scale; the arithmetic is identical up to association so
+agreement is ~1e-6 relative.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import rocm_mpi_tpu.ops.pallas_kernels as pk
+from rocm_mpi_tpu.config import DiffusionConfig
+from rocm_mpi_tpu.models import HeatDiffusion
+from rocm_mpi_tpu.ops.diffusion import step_fused, step_fused_padded
+
+RTOL, ATOL = 2e-5, 1e-6
+
+
+def _rand(shape, seed=0):
+    return jax.random.uniform(jax.random.PRNGKey(seed), shape, jnp.float32)
+
+
+def _close(got, ref):
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=RTOL, atol=ATOL
+    )
+
+
+def test_backend_is_accelerated():
+    assert jax.devices()[0].platform != "cpu"
+    # interpret=None must resolve to compiled on this backend — otherwise
+    # this whole tier silently tests the interpreter again.
+    assert not pk._interpret_default()
+
+
+def test_whole_block_compiled():
+    Tp = _rand((34, 30))
+    Cp = 1.0 + _rand((32, 28), seed=1)
+    args = (1.3, 1e-4, (0.1, 0.07))
+    _close(pk.fused_step_padded(Tp, Cp, *args), step_fused_padded(Tp, Cp, *args))
+
+
+def test_striped_compiled(monkeypatch):
+    monkeypatch.setattr(pk, "_VMEM_BLOCK_BUDGET_BYTES", 1024)
+    Tp = _rand((66, 50))
+    Cp = 1.0 + _rand((64, 48), seed=1)
+    args = (1.0, 2e-4, (0.1, 0.1))
+    _close(pk.fused_step_padded(Tp, Cp, *args), step_fused_padded(Tp, Cp, *args))
+
+
+def test_striped_partial_stripe_compiled(monkeypatch):
+    # Row count not a multiple of the stripe height: ceil grid + partial
+    # trailing blocks must behave on Mosaic as in interpret mode.
+    monkeypatch.setattr(pk, "_VMEM_BLOCK_BUDGET_BYTES", 1024)
+    Tp = _rand((69, 50))
+    Cp = 1.0 + _rand((67, 48), seed=1)
+    args = (1.0, 2e-4, (0.1, 0.1))
+    _close(pk.fused_step_padded(Tp, Cp, *args), step_fused_padded(Tp, Cp, *args))
+
+
+def test_kp_three_kernel_compiled():
+    Tp = _rand((34, 30))
+    Cp = 1.0 + _rand((32, 28), seed=1)
+    args = (1.3, 1e-4, (0.1, 0.07))
+    _close(pk.kp_step_padded(Tp, Cp, *args), step_fused_padded(Tp, Cp, *args))
+
+
+def test_vmem_multi_step_compiled():
+    T = _rand((32, 32))
+    Cp = jnp.full((32, 32), 1.5, jnp.float32)
+    args = (1.0, 1e-5, (0.1, 0.1))
+    ref = T
+    for _ in range(32):
+        ref = step_fused(ref, Cp, *args)
+    _close(pk.fused_multi_step(T, Cp, *args, n_steps=32, chunk=16), ref)
+
+
+def test_temporal_blocked_compiled():
+    T = _rand((48, 48))
+    Cp = 1.0 + _rand((48, 48), seed=1)
+    args = (1.0, 1e-4, (0.5, 0.5))
+    ref = T
+    for _ in range(16):
+        ref = step_fused(ref, Cp, *args)
+    _close(pk.fused_multi_step_hbm(T, Cp, *args, 16, block_steps=8), ref)
+
+
+def test_temporal_blocked_3d_compiled():
+    T = _rand((32, 16, 128))
+    Cp = 1.0 + _rand((32, 16, 128), seed=2)
+    args = (0.8, 5e-5, (0.3, 0.4, 0.5))
+    ref = T
+    for _ in range(8):
+        ref = step_fused(ref, Cp, *args)
+    _close(pk.fused_multi_step_hbm(T, Cp, *args, 8, block_steps=4), ref)
+
+
+def test_multi_step_cm_compiled():
+    T = _rand((32, 32))
+    Cp = 1.0 + _rand((32, 32), seed=1)
+    lam, dt, spacing = 1.0, 1e-4, (0.1, 0.1)
+    Cm = pk.edge_masked_cm(T, Cp, lam, dt)
+    ref = T
+    for _ in range(4):
+        ref = step_fused(ref, Cp, lam, dt, spacing)
+    _close(pk.multi_step_cm(T, Cm, spacing, 4), ref)
+
+
+def test_fused_step_cm_whole_compiled():
+    T = _rand((32, 28))
+    Cp = 1.0 + _rand((32, 28), seed=1)
+    lam, dt, spacing = 1.3, 1e-4, (0.1, 0.07)
+    Cm = pk.edge_masked_cm(T, Cp, lam, dt)
+    Tp = jnp.pad(T, ((1, 1), (1, 1)))
+    _close(pk.fused_step_cm(Tp, Cm, spacing), step_fused(T, Cp, lam, dt, spacing))
+
+
+def test_fused_step_cm_striped_compiled(monkeypatch):
+    monkeypatch.setattr(pk, "_VMEM_BLOCK_BUDGET_BYTES", 1024)
+    T = _rand((61, 48))
+    Cp = 1.0 + _rand((61, 48), seed=1)
+    lam, dt, spacing = 1.0, 2e-4, (0.1, 0.1)
+    Cm = pk.edge_masked_cm(T, Cp, lam, dt)
+    Tp = jnp.pad(T, ((1, 1), (1, 1)))
+    _close(pk.fused_step_cm(Tp, Cm, spacing), step_fused(T, Cp, lam, dt, spacing))
+
+
+@pytest.mark.parametrize("shape", [(64, 48), (16, 10, 8)])
+def test_masked_step_striped_compiled(shape, monkeypatch):
+    monkeypatch.setattr(pk, "_VMEM_BLOCK_BUDGET_BYTES", 1024)
+    T = _rand(shape)
+    Cp = 1.0 + _rand(shape, seed=1)
+    lam, dt = 1.0, 2e-4
+    spacing = (0.1,) * len(shape)
+    Cm = pk.edge_masked_cm(T, Cp, lam, dt)
+    _close(pk.masked_step(T, Cm, spacing), step_fused(T, Cp, lam, dt, spacing))
+
+
+def test_masked_step_real_stripes_compiled():
+    # Real dispatch (no budget shrink): 1024² f32 = 4 MB > the 2 MB budget
+    # → the ghost-block striped per-step kernel at its production stripe
+    # height, compiled.
+    T = _rand((1024, 1024))
+    Cp = 1.0 + _rand((1024, 1024), seed=1)
+    lam, dt, spacing = 1.0, 1e-4, (0.01, 0.01)
+    Cm = pk.edge_masked_cm(T, Cp, lam, dt)
+    _close(pk.masked_step(T, Cm, spacing), step_fused(T, Cp, lam, dt, spacing))
+
+
+def test_hide_strip_kernels_compiled():
+    # The hide variant's Pallas strip kernels (boundary slabs + interior)
+    # under shard_map on a 1-device mesh — compiles the strip shapes even
+    # though multi-chip hardware isn't available here.
+    from jax import shard_map
+
+    from rocm_mpi_tpu.parallel.mesh import init_global_grid
+    from rocm_mpi_tpu.parallel.overlap import make_overlap_step
+
+    grid = init_global_grid(48, 48, dims=(1, 1), devices=jax.devices()[:1])
+    local = make_overlap_step(grid, pk.fused_step_padded, (8, 8))
+    lam, dt, spacing = 1.0, 1e-4, grid.spacing
+    T = _rand((48, 48))
+    Cp = 1.0 + _rand((48, 48), seed=1)
+
+    @jax.jit
+    def step(T, Cp):
+        return shard_map(
+            lambda Tl, Cpl: local(Tl, Cpl, lam, dt, spacing),
+            mesh=grid.mesh,
+            in_specs=(grid.spec, grid.spec),
+            out_specs=grid.spec,
+            check_vma=False,
+        )(T, Cp)
+
+    _close(step(T, Cp), step_fused(T, Cp, lam, dt, spacing))
+
+
+def test_deep_halo_sweep_compiled():
+    from rocm_mpi_tpu.parallel.deep_halo import make_deep_sweep
+    from rocm_mpi_tpu.parallel.mesh import init_global_grid
+
+    grid = init_global_grid(64, 64, dims=(1, 1), devices=jax.devices()[:1])
+    lam, dt = 1.0, jnp.float32(1e-4)
+    sweep = jax.jit(make_deep_sweep(grid, 4, lam, dt, grid.spacing))
+    T = _rand((64, 64))
+    Cp = 1.0 + _rand((64, 64), seed=1)
+    ref = T
+    for _ in range(4):
+        ref = step_fused(ref, Cp, lam, dt, grid.spacing)
+    _close(sweep(T, Cp), ref)
+
+
+def test_model_runners_compiled():
+    # The model-level fast paths end-to-end on the chip at tiny sizes.
+    cfg = DiffusionConfig(
+        global_shape=(64, 64), lengths=(10.0, 10.0), nt=32, warmup=8,
+        dtype="f32", dims=(1, 1),
+    )
+    model = HeatDiffusion(cfg)
+    r_perf = model.run(variant="perf")
+    r_hide = model.run(variant="hide")
+    r_vmem = model.run_vmem_resident()
+    r_deep = model.run_deep(block_steps=8)
+    r_tb = model.run_hbm_blocked(block_steps=8)
+    np.testing.assert_array_equal(np.asarray(r_hide.T), np.asarray(r_perf.T))
+    for r in (r_vmem, r_deep, r_tb):
+        _close(r.T, r_perf.T)
