@@ -60,12 +60,18 @@ def make_overlap_step(
     grid: GlobalGrid,
     padded_update: Callable,
     b_width: tuple[int, ...],
+    mask_boundary: bool = True,
 ):
     """Build the shard-local overlap step (any ndim).
 
-    `padded_update(Tp, Cp, lam, dt, spacing)` is any core-update kernel with
+    `padded_update(Tp, C, lam, dt, spacing)` is any core-update kernel with
     the padded contract (jnp or Pallas). Returns
-    `local_step(Tl, Cpl, lam, dt, spacing) -> Tl_new`.
+    `local_step(Tl, Cl, lam, dt, spacing) -> Tl_new`.
+
+    `mask_boundary=False` drops the final Dirichlet `where`: for the Cm
+    contract (C = the boundary-masked coefficient, models.diffusion
+    `_make_masked_step`), held cells already come back unchanged from the
+    region update, so the extra whole-shard select would be dead work.
 
     The shard is decomposed axis-by-axis into boundary slabs and one
     interior box: axis 0 contributes the first/last `b` rows (full extent
@@ -106,6 +112,8 @@ def make_overlap_step(
             return jnp.concatenate(parts, axis=axis)
 
         new = build(0, [])
+        if not mask_boundary:
+            return new
         # (4) Dirichlet: global-domain edge cells never change.
         return jnp.where(global_boundary_mask(grid), Tl, new)
 

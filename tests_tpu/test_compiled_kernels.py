@@ -155,31 +155,64 @@ def test_masked_step_real_stripes_compiled():
 
 
 def test_hide_strip_kernels_compiled():
-    # The hide variant's Pallas strip kernels (boundary slabs + interior)
-    # under shard_map on a 1-device mesh — compiles the strip shapes even
-    # though multi-chip hardware isn't available here.
+    # The hide variant's production strip combination — fused_step_cm per
+    # region with mask_boundary=False (models.diffusion._make_hide_step's
+    # compiled-dtype sharded branch) — under shard_map on a 1-device mesh:
+    # compiles the Cm strip kernels on the slab shapes even though
+    # multi-chip hardware isn't available here.
     from jax import shard_map
 
     from rocm_mpi_tpu.parallel.mesh import init_global_grid
     from rocm_mpi_tpu.parallel.overlap import make_overlap_step
 
     grid = init_global_grid(48, 48, dims=(1, 1), devices=jax.devices()[:1])
-    local = make_overlap_step(grid, pk.fused_step_padded, (8, 8))
+    pu = lambda tp, cm, lam, dt, spacing: pk.fused_step_cm(tp, cm, spacing)
+    local = make_overlap_step(grid, pu, (8, 8), mask_boundary=False)
     lam, dt, spacing = 1.0, 1e-4, grid.spacing
     T = _rand((48, 48))
     Cp = 1.0 + _rand((48, 48), seed=1)
+    Cm = pk.edge_masked_cm(T, Cp, lam, dt)
 
     @jax.jit
-    def step(T, Cp):
+    def step(T, Cm):
         return shard_map(
-            lambda Tl, Cpl: local(Tl, Cpl, lam, dt, spacing),
+            lambda Tl, Cml: local(Tl, Cml, lam, dt, spacing),
             mesh=grid.mesh,
             in_specs=(grid.spec, grid.spec),
             out_specs=grid.spec,
             check_vma=False,
-        )(T, Cp)
+        )(T, Cm)
 
-    _close(step(T, Cp), step_fused(T, Cp, lam, dt, spacing))
+    _close(step(T, Cm), step_fused(T, Cp, lam, dt, spacing))
+
+
+def test_hide_strip_kernels_narrow_slabs_compiled():
+    # b_width=1 boundary slabs: 1-row/1-column region blocks are the
+    # nastiest shapes Mosaic sees from the overlap ladder.
+    from jax import shard_map
+
+    from rocm_mpi_tpu.parallel.mesh import init_global_grid
+    from rocm_mpi_tpu.parallel.overlap import make_overlap_step
+
+    grid = init_global_grid(32, 32, dims=(1, 1), devices=jax.devices()[:1])
+    pu = lambda tp, cm, lam, dt, spacing: pk.fused_step_cm(tp, cm, spacing)
+    local = make_overlap_step(grid, pu, (1, 1), mask_boundary=False)
+    lam, dt, spacing = 1.0, 1e-4, grid.spacing
+    T = _rand((32, 32))
+    Cp = 1.0 + _rand((32, 32), seed=1)
+    Cm = pk.edge_masked_cm(T, Cp, lam, dt)
+
+    @jax.jit
+    def step(T, Cm):
+        return shard_map(
+            lambda Tl, Cml: local(Tl, Cml, lam, dt, spacing),
+            mesh=grid.mesh,
+            in_specs=(grid.spec, grid.spec),
+            out_specs=grid.spec,
+            check_vma=False,
+        )(T, Cm)
+
+    _close(step(T, Cm), step_fused(T, Cp, lam, dt, spacing))
 
 
 def test_deep_halo_sweep_compiled():
