@@ -767,20 +767,24 @@ def masked_step(T, Cm, spacing, interpret=None, tm=None):
             row_bytes *= n
         base = _stripe_height(row_bytes)
         # Taller stripes amortize the per-stripe DMA overhead (measured on
-        # v5e at 12288² f32: tm=32 ≈ 254 GB/s T_eff vs tm=16 ≈ 241) —
-        # prefer 2× the budget height when it divides the row count AND the
-        # in-kernel slab (tm+2g rows, concatenated + ~3 lap temporaries)
-        # stays under the measured Mosaic compile boundary (~2.4 MB slab:
-        # 12288²/tm=48 and 8192²/tm=64 both exceed it and fail to compile).
+        # v5e at 12288² f32: tm=32 ≈ 254 GB/s T_eff vs tm=16 ≈ 241): take
+        # the tallest multiple of g up to 2× the budget height that divides
+        # the row count AND whose in-kernel slab (tm+2g rows, concatenated
+        # + ~3 lap temporaries, computed at ≥f32 width even for bf16
+        # inputs) stays under the measured Mosaic compile boundary
+        # (~2.4 MB f32-equivalent slab: f32 12288²/tm=48, 8192²/tm=64 and
+        # bf16 12288²/tm=64 all fail to compile beyond it).
         # No candidate fitting → None → the pad fallback (very wide rows,
         # where even the base slab would blow the compile boundary).
+        slab_unit = (row_bytes // T.dtype.itemsize) * max(
+            T.dtype.itemsize, 4
+        )
         tm = next(
             (
                 c
-                for c in (2 * base, base)
-                if c >= g
-                and n0 % c == 0
-                and (c + 2 * g) * row_bytes <= _PS_SLAB_BUDGET_BYTES
+                for c in range(2 * base, g - 1, -g)
+                if n0 % c == 0
+                and (c + 2 * g) * slab_unit <= _PS_SLAB_BUDGET_BYTES
             ),
             None,
         )

@@ -154,6 +154,25 @@ def test_masked_step_real_stripes_compiled():
     _close(pk.masked_step(T, Cm, spacing), step_fused(T, Cp, lam, dt, spacing))
 
 
+def test_masked_step_bf16_stripes_compiled():
+    # The bf16 precision-trade path: g=8 ghost blocks on (16,128)-tiled
+    # bf16 must still compile and agree with the f32 oracle to bf16
+    # precision (~0.4 % single-step). dt must respect the CFL bound
+    # (min(d²)·Cp/λ/4.1 ≈ 2.4e-5 here): an unstable step amplifies the
+    # bf16 rounding of the Laplacian beyond any fixed tolerance.
+    T32 = _rand((2048, 2048))
+    Cp = 1.0 + _rand((2048, 2048), seed=1)
+    lam, dt, spacing = 1.0, 1e-5, (0.01, 0.01)
+    Cm32 = pk.edge_masked_cm(T32, Cp, lam, dt)
+    got = pk.masked_step(
+        T32.astype(jnp.bfloat16), Cm32.astype(jnp.bfloat16), spacing
+    )
+    ref = step_fused(T32, Cp, lam, dt, spacing)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref), rtol=2e-2, atol=1e-2
+    )
+
+
 def test_hide_strip_kernels_compiled():
     # The hide variant's production strip combination — fused_step_cm per
     # region with mask_boundary=False (models.diffusion._make_hide_step's
