@@ -225,6 +225,8 @@ def run_suite() -> None:
         32_768 + 1_048_576, 32_768)
     row("12288² temporal-blocked (k=8)", (12288, 12288), "run_hbm_blocked",
         328, 8)
+    row("12288² deep-halo sweeps (k=8)", (12288, 12288), "run_deep",
+        168, 8)
     row("12288² per-step perf", (12288, 12288), "run", 110, 10,
         variant="perf")
     # Labeled precision-trade fast path (--dtype bf16): halves the memory

@@ -531,6 +531,19 @@ class HeatDiffusion:
             # Default depth, clamped so small shards keep working (explicit
             # depths keep make_deep_sweep's strict shard-extent validation).
             k = min(DEFAULT_DEEP_STEPS, min(self.grid.local_shape))
+            # HBM-resident shards route to the temporal-blocked local sweep
+            # whose stripe ghosts bound the depth at 8 (multi_step_cm_hbm).
+            from rocm_mpi_tpu.ops.pallas_kernels import (
+                _VMEM_BLOCK_BUDGET_BYTES,
+                DEFAULT_TB_STEPS,
+            )
+
+            shard_bytes = 1
+            for ln in self.grid.local_shape:
+                shard_bytes *= ln + 2 * k
+            shard_bytes *= jnp.dtype(cfg.jax_dtype).itemsize
+            if shard_bytes > _VMEM_BLOCK_BUDGET_BYTES:
+                k = min(k, DEFAULT_TB_STEPS)
         else:
             k = block_steps
         k = effective_block_steps(

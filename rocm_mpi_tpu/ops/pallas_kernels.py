@@ -518,9 +518,9 @@ def multi_step_cm(T, Cm, spacing, n_steps: int, interpret=None):
     if nbytes > _VMEM_BLOCK_BUDGET_BYTES:
         raise ValueError(
             f"padded block of {nbytes} bytes exceeds the VMEM-resident "
-            f"budget ({_VMEM_BLOCK_BUDGET_BYTES}); deep-halo sweeps need "
-            "per-device shards that fit VMEM — shard the grid finer or "
-            "use the per-step variants / run_hbm_blocked for large shards"
+            f"budget ({_VMEM_BLOCK_BUDGET_BYTES}); for HBM-resident "
+            "blocks use multi_step_cm_hbm (the deep-halo sweep routes "
+            "there automatically) or the per-step variants"
         )
     inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
     kernel = functools.partial(
@@ -667,19 +667,59 @@ def fused_multi_step_hbm(T, Cp, lam, dt, spacing, n_steps, block_steps=None,
     lam, dt = float(lam), float(dt)
     inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
     Cm = _edge_masked_cm(T, Cp, lam, dt)
-    core, gup, gdn = _stripe_ghost_specs(tm, g, n0, T.shape[1:])
+    sweep = _make_tb_sweep(T, inv_d2, k, g, tm, interpret)
+    return lax.fori_loop(0, n_steps // k, lambda _, x: sweep(x, Cm), T)
+
+
+def _make_tb_sweep(T, inv_d2, k, g, tm, interpret):
+    """Build sweep(T, Cm) -> T advanced k steps, one temporal-blocked
+    memory pass (the pallas_call shared by fused_multi_step_hbm and
+    multi_step_cm_hbm). Caller guarantees the shape constraints."""
+    core, gup, gdn = _stripe_ghost_specs(tm, g, T.shape[0], T.shape[1:])
     kernel = functools.partial(_tb_kernel, inv_d2=inv_d2, k=k, g=g, tm=tm)
-    sweep = pl.pallas_call(
+    call = pl.pallas_call(
         kernel,
         out_shape=_out_struct(T.shape, T),
-        grid=(n0 // tm,),
+        grid=(T.shape[0] // tm,),
         in_specs=[gup, core, gdn, gup, core, gdn],
         out_specs=core,
         interpret=interpret,
     )
-    return lax.fori_loop(
-        0, n_steps // k, lambda _, x: sweep(x, x, x, Cm, Cm, Cm), T
-    )
+    return lambda T, Cm: call(T, T, T, Cm, Cm, Cm)
+
+
+def multi_step_cm_hbm(T, Cm, spacing, n_steps: int, interpret=None):
+    """One temporal-blocked sweep of `n_steps` steps on an *HBM-resident*
+    block with a caller-supplied masked coefficient — the large-shard form
+    of multi_step_cm (same contract: Cm is dt·λ/Cp where the cell updates,
+    exactly 0.0 where held; the caller crops sweep-edge staleness).
+
+    This is the local compute of deep-halo sweeps on shards too big for
+    VMEM (parallel.deep_halo): the k-wide exchanged ghost ring bounds the
+    block-edge staleness exactly as the VMEM kernel's roll wraparound
+    does, and the in-sweep stripe ghosts (g rows) bound the stripe-level
+    staleness, so `n_steps` ≤ g and ≤ ghost width keeps the crop exact.
+    Requires axis-0 length divisible by the stripe height (16).
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    if not _supports_compiled(T.dtype) and not interpret:
+        raise TypeError(f"Mosaic does not support {T.dtype}")
+    if T.shape != Cm.shape:
+        raise ValueError(f"shape mismatch: T {T.shape} vs Cm {Cm.shape}")
+    g, tm = 8, _TB_TM
+    if not 1 <= n_steps <= g:
+        raise ValueError(
+            f"n_steps must be in [1, {g}] per HBM sweep, got {n_steps} "
+            "(the g-row stripe ghosts bound the in-sweep light cone)"
+        )
+    n0 = T.shape[0]
+    if n0 % tm != 0 or (n0 // tm) < 2 or n0 % g != 0:
+        raise ValueError(
+            f"axis-0 length {n0} must be a multiple of {tm} (>= 2 stripes)"
+        )
+    inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
+    return _make_tb_sweep(T, inv_d2, int(n_steps), g, tm, interpret)(T, Cm)
 
 
 # ---------------------------------------------------------------------------

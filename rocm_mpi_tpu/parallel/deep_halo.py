@@ -68,6 +68,9 @@ def make_deep_sweep(grid: GlobalGrid, k: int, lam, dt, spacing):
     as the single-chip VMEM-resident path (ops.pallas_kernels.multi_step_cm)
     — the deep-halo design makes every chip's inner loop identical to the
     fastest single-chip loop, with communication only at sweep boundaries.
+    Shards too large for VMEM route to the temporal-blocked HBM sweep
+    (multi_step_cm_hbm, k ≤ 8): the same schedule at every scale —
+    exchange once, advance k steps locally, crop.
     """
     if k < 1:
         raise ValueError(f"sweep depth k must be >= 1, got {k}")
@@ -76,15 +79,49 @@ def make_deep_sweep(grid: GlobalGrid, k: int, lam, dt, spacing):
             f"sweep depth {k} exceeds a local shard extent "
             f"{grid.local_shape}; ghost slices need width <= shard"
         )
-    from rocm_mpi_tpu.ops.pallas_kernels import multi_step_cm
+    from rocm_mpi_tpu.ops.pallas_kernels import (
+        _VMEM_BLOCK_BUDGET_BYTES,
+        multi_step_cm,
+        multi_step_cm_hbm,
+    )
 
     core = tuple(slice(k, -k) for _ in range(grid.ndim))
+
+    inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
+
+    def jnp_k_steps(Tp, Cm):
+        # Any-shape/any-k fallback: the same roll+Cm semantics as the
+        # Pallas kernels, XLA-fused. Slower (no temporal blocking) but
+        # never shape-constrained — the HBM kernel's stripe divisibility
+        # and k <= 8 bound do not always survive run_deep's depth
+        # degradation (effective_block_steps), and a crashed sweep is
+        # strictly worse than a slower one.
+        for _ in range(k):
+            lap = None
+            for ax in range(Tp.ndim):
+                term = (
+                    jnp.roll(Tp, -1, ax) + jnp.roll(Tp, 1, ax) - 2.0 * Tp
+                ) * inv_d2[ax]
+                lap = term if lap is None else lap + term
+            Tp = Tp + Cm * lap
+        return Tp
 
     def local_sweep(Tl, Cpl):
         Tp = exchange_halo(Tl, grid, width=k)
         Cpp = exchange_halo(Cpl, grid, width=k)
         Cm = padded_update_coefficient(Cpp, grid, k, lam, dt)
-        Tp = multi_step_cm(Tp, Cm, spacing, k)
+        n0p = Tp.shape[0]
+        if Tp.size * Tp.dtype.itemsize <= _VMEM_BLOCK_BUDGET_BYTES:
+            Tp = multi_step_cm(Tp, Cm, spacing, k)
+        elif (
+            Tp.ndim in (2, 3)
+            and k <= 8
+            and n0p % 16 == 0
+            and (n0p // 16) >= 2
+        ):
+            Tp = multi_step_cm_hbm(Tp, Cm, spacing, k)
+        else:
+            Tp = jnp_k_steps(Tp, Cm)
         return Tp[core]
 
     def sweep(T, Cp):

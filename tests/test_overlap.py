@@ -119,6 +119,38 @@ class TestDeepHalo:
             np.asarray(r_deep.T), np.asarray(r_ref.T), rtol=2e-5, atol=1e-6
         )
 
+    def test_hbm_shard_branch_matches_per_step(self, monkeypatch):
+        # Shards too big for the (shrunk) VMEM budget route the local
+        # compute to the temporal-blocked HBM sweep (multi_step_cm_hbm);
+        # the schedule must still agree with the per-step path.
+        import numpy as np
+
+        import rocm_mpi_tpu.ops.pallas_kernels as pk
+
+        monkeypatch.setattr(pk, "_VMEM_BLOCK_BUDGET_BYTES", 1024)
+        m = self._model(shape=(56, 48), dims=(2, 2), nt=8, warmup=4)
+        r_deep = m.run_deep(block_steps=2)  # padded shard (32,28): 32%16==0
+        r_ref = m.run(variant="perf")
+        np.testing.assert_allclose(
+            np.asarray(r_deep.T), np.asarray(r_ref.T), rtol=2e-5, atol=1e-6
+        )
+
+    def test_hbm_branch_shape_fallback_matches_per_step(self, monkeypatch):
+        # k=3 on a (28,24) shard pads to 34 rows — not a multiple of the
+        # HBM sweep's stripe height — so the deep sweep must route to the
+        # any-shape jnp fallback instead of crashing, and still agree.
+        import numpy as np
+
+        import rocm_mpi_tpu.ops.pallas_kernels as pk
+
+        monkeypatch.setattr(pk, "_VMEM_BLOCK_BUDGET_BYTES", 1024)
+        m = self._model(shape=(56, 48), dims=(2, 2), nt=9, warmup=3)
+        r_deep = m.run_deep(block_steps=3)
+        r_ref = m.run(variant="perf")
+        np.testing.assert_allclose(
+            np.asarray(r_deep.T), np.asarray(r_ref.T), rtol=2e-5, atol=1e-6
+        )
+
     def test_depth_exceeding_shard_raises(self):
         import pytest
 

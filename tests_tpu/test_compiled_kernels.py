@@ -249,6 +249,24 @@ def test_deep_halo_sweep_compiled():
     _close(sweep(T, Cp), ref)
 
 
+def test_deep_halo_hbm_shard_compiled():
+    # Real dispatch: a 736² f32 shard pads to 752² = 2.26 MB > the VMEM
+    # budget → the deep sweep's local compute is the temporal-blocked HBM
+    # sweep (multi_step_cm_hbm), compiled.
+    from rocm_mpi_tpu.parallel.deep_halo import make_deep_sweep
+    from rocm_mpi_tpu.parallel.mesh import init_global_grid
+
+    grid = init_global_grid(736, 736, dims=(1, 1), devices=jax.devices()[:1])
+    lam, dt = 1.0, jnp.float32(1e-5)
+    sweep = jax.jit(make_deep_sweep(grid, 8, lam, dt, grid.spacing))
+    T = _rand((736, 736))
+    Cp = 1.0 + _rand((736, 736), seed=1)
+    ref = T
+    for _ in range(8):
+        ref = step_fused(ref, Cp, lam, dt, grid.spacing)
+    _close(sweep(T, Cp), ref)
+
+
 def test_model_runners_compiled():
     # The model-level fast paths end-to-end on the chip at tiny sizes.
     cfg = DiffusionConfig(
