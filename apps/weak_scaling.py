@@ -34,7 +34,12 @@ def main(argv=None) -> int:
     p.add_argument("--nt", type=int, default=2000)
     p.add_argument("--warmup", type=int, default=200)
     p.add_argument("--variant", default="hide",
-                   choices=["ap", "fused", "shard", "perf", "kp", "hide"])
+                   choices=["ap", "fused", "shard", "perf", "kp", "hide",
+                            "deep"],
+                   help="step schedule; 'deep' = deep-halo sweeps "
+                   "(run_deep, the flagship multi-chip schedule)")
+    p.add_argument("--deep-k", type=int, default=None, metavar="K",
+                   help="deep-halo sweep depth (default: run_deep's auto)")
     p.add_argument("--dtype", default="f32")
     p.add_argument("--cpu-devices", type=int, default=0, metavar="N")
     p.add_argument("--counts", default=None,
@@ -84,7 +89,10 @@ def main(argv=None) -> int:
             dims=dims,
         )
         model = HeatDiffusion(cfg, devices=jax.devices()[:n])
-        r = model.run(variant=args.variant)
+        if args.variant == "deep":
+            r = model.run_deep(block_steps=args.deep_k)
+        else:
+            r = model.run(variant=args.variant)
         per_dev = r.gpts / n
         if base_per_dev is None:
             # The efficiency baseline is the smallest count actually run;

@@ -236,6 +236,8 @@ def run_suite() -> None:
         dtype="bf16", variant="perf")
     row("128³ 3D temporal-blocked (k=8)", (128, 128, 128), "run_hbm_blocked",
         3_208, 8)
+    row("128³ 3D per-step perf", (128, 128, 128), "run", 1_100, 100,
+        variant="perf")
 
 
 # --------------------------------------------------------------------------

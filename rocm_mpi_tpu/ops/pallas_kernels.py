@@ -623,9 +623,11 @@ def _stripe_ghost_specs(tm, g, n0, rest):
     return core, gup, gdn
 
 
-DEFAULT_TB_STEPS = 8  # HBM temporal blocking: bounded by the g=8 ghost rows
+DEFAULT_TB_STEPS = 8  # HBM temporal blocking: bounded by the ghost rows
 DEFAULT_DEEP_STEPS = 16  # deep-halo sweeps: measured optimum at 252²/chip
-_TB_TM = 16  # stripe height; with g=8 ghosts, tuned to the ~16 MB VMEM limit
+_TB_G = 8  # tb-sweep ghost-block rows (the TPU sublane tile) = max k/sweep
+_TB_TM = 16  # stripe height; with _TB_G ghosts, tuned to the VMEM limit
+assert _TB_TM % _TB_G == 0  # _stripe_ghost_specs' index maps require it
 
 
 def fused_multi_step_hbm(T, Cp, lam, dt, spacing, n_steps, block_steps=None,
@@ -653,12 +655,13 @@ def fused_multi_step_hbm(T, Cp, lam, dt, spacing, n_steps, block_steps=None,
     if not _supports_compiled(T.dtype) and not interpret:
         raise TypeError(f"Mosaic does not support {T.dtype}")
     k = DEFAULT_TB_STEPS if block_steps is None else block_steps
-    g = 8  # ghost-block rows: the TPU sublane tile; also the max k
-    tm = _TB_TM
+    g, tm = _TB_G, _TB_TM  # ghost rows (also the max k) and stripe height
     if not 1 <= k <= g:
         raise ValueError(f"block_steps must be in [1, {g}], got {k}")
     n0 = T.shape[0]
-    if n0 % tm != 0 or (n0 // tm) < 2 or n0 % g != 0:
+    # n0 % tm == 0 with tm a multiple of g (asserted above) also gives the
+    # ghost-block alignment the stripe specs need.
+    if n0 % tm != 0 or (n0 // tm) < 2:
         raise ValueError(
             f"axis-0 length {n0} must be a multiple of {tm} (>= 2 stripes)"
         )
@@ -707,14 +710,14 @@ def multi_step_cm_hbm(T, Cm, spacing, n_steps: int, interpret=None):
         raise TypeError(f"Mosaic does not support {T.dtype}")
     if T.shape != Cm.shape:
         raise ValueError(f"shape mismatch: T {T.shape} vs Cm {Cm.shape}")
-    g, tm = 8, _TB_TM
+    g, tm = _TB_G, _TB_TM
     if not 1 <= n_steps <= g:
         raise ValueError(
             f"n_steps must be in [1, {g}] per HBM sweep, got {n_steps} "
             "(the g-row stripe ghosts bound the in-sweep light cone)"
         )
     n0 = T.shape[0]
-    if n0 % tm != 0 or (n0 // tm) < 2 or n0 % g != 0:
+    if n0 % tm != 0 or (n0 // tm) < 2:
         raise ValueError(
             f"axis-0 length {n0} must be a multiple of {tm} (>= 2 stripes)"
         )

@@ -80,6 +80,8 @@ def make_deep_sweep(grid: GlobalGrid, k: int, lam, dt, spacing):
             f"{grid.local_shape}; ghost slices need width <= shard"
         )
     from rocm_mpi_tpu.ops.pallas_kernels import (
+        _TB_G,
+        _TB_TM,
         _VMEM_BLOCK_BUDGET_BYTES,
         multi_step_cm,
         multi_step_cm_hbm,
@@ -115,9 +117,9 @@ def make_deep_sweep(grid: GlobalGrid, k: int, lam, dt, spacing):
             Tp = multi_step_cm(Tp, Cm, spacing, k)
         elif (
             Tp.ndim in (2, 3)
-            and k <= 8
-            and n0p % 16 == 0
-            and (n0p // 16) >= 2
+            and k <= _TB_G
+            and n0p % _TB_TM == 0
+            and (n0p // _TB_TM) >= 2
         ):
             Tp = multi_step_cm_hbm(Tp, Cm, spacing, k)
         else:
