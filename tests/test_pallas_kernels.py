@@ -134,6 +134,32 @@ def test_masked_step_pad_fallback_matches_step_fused(monkeypatch):
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-13)
 
 
+@pytest.mark.parametrize("budget", [512, 2048, 2 * 1024 * 1024])
+def test_masked_step_dispatch_sweep(budget, monkeypatch):
+    # The dispatcher's three branches (VMEM-resident roll kernel, ghost-
+    # block striped, pad + padded-contract fallback) are shape- and
+    # budget-dependent; sweep awkward shapes at several budgets and demand
+    # every route agrees with step_fused. Covers: divisible and
+    # non-divisible row counts, single-stripe fields, odd widths, 3D.
+    monkeypatch.setattr(pk, "_VMEM_BLOCK_BUDGET_BYTES", budget)
+    lam, dt = 1.1, 5e-5
+    shapes = [
+        (8, 8), (9, 13), (16, 24), (24, 17), (31, 8), (40, 48),
+        (57, 50), (64, 8), (12, 10, 8),
+    ]
+    for shape in shapes:
+        spacing = (0.2,) * len(shape)
+        T = _rand(shape, seed=sum(shape))
+        Cp = 1.0 + _rand(shape, seed=sum(shape) + 1)
+        Cm = pk.edge_masked_cm(T, Cp, lam, dt)
+        got = pk.masked_step(T, Cm, spacing)
+        ref = step_fused(T, Cp, lam, dt, spacing)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=1e-13, atol=1e-15,
+            err_msg=f"shape={shape} budget={budget}",
+        )
+
+
 def test_masked_step_3d_striped(monkeypatch):
     monkeypatch.setattr(pk, "_VMEM_BLOCK_BUDGET_BYTES", 1024)
     T = _rand((16, 10, 8))
