@@ -221,7 +221,7 @@ def run_suite() -> None:
         220_000, 20_000, variant="perf")
     row("252² per-step hide (overlap)", (252, 252), "run",
         220_000, 20_000, variant="hide")
-    row("252² deep-halo sweeps (k=16)", (252, 252), "run_deep",
+    row("252² deep-halo sweeps (k=32)", (252, 252), "run_deep",
         32_768 + 1_048_576, 32_768)
     row("12288² temporal-blocked (k=8)", (12288, 12288), "run_hbm_blocked",
         328, 8)

@@ -64,6 +64,36 @@ def effective_block_steps(
     return eff
 
 
+def default_deep_depth(local_shape, itemsize: int) -> int:
+    """run_deep's automatic sweep depth for a given per-device shard.
+
+    Start from DEFAULT_DEEP_STEPS clamped to the shard extent, then halve
+    while the k-padded shard exceeds the VMEM budget but a shallower sweep
+    would fit — mid-size shards prefer a shallower VMEM-resident sweep
+    over the HBM local sweep (e.g. a 672² f32 shard fits VMEM at k=16 but
+    not k=32). Shards that fit at no depth run the temporal-blocked HBM
+    local sweep, whose stripe ghosts cap the depth at DEFAULT_TB_STEPS.
+    """
+    from rocm_mpi_tpu.ops.pallas_kernels import (
+        _VMEM_BLOCK_BUDGET_BYTES,
+        DEFAULT_DEEP_STEPS,
+        DEFAULT_TB_STEPS,
+    )
+
+    def padded_bytes(kk):
+        b = itemsize
+        for ln in local_shape:
+            b *= ln + 2 * kk
+        return b
+
+    k = min(DEFAULT_DEEP_STEPS, min(local_shape))
+    while k > DEFAULT_TB_STEPS and padded_bytes(k) > _VMEM_BLOCK_BUDGET_BYTES:
+        k //= 2
+    if padded_bytes(k) > _VMEM_BLOCK_BUDGET_BYTES:
+        k = min(k, DEFAULT_TB_STEPS)
+    return max(1, k)
+
+
 def warn_host_transport_ignored(variant: str, stacklevel: int = 3) -> None:
     """The one warning for halo_transport='host' on a variant that keeps its
     device-side communication (only 'shard' routes to the host-staged
@@ -512,12 +542,13 @@ class HeatDiffusion:
         width-k ghost exchange per k steps, the multi-chip form of temporal
         blocking. Works on any mesh (including 1 device, where it reduces
         to the VMEM-resident loop plus crop overhead). f32/bf16 only on
-        real TPUs (the local kernel is Pallas). Default depth 16 — the
-        measured single-chip optimum at 252² (k=8: 1.25 µs/step, k=16:
-        1.02, k=32: 1.01 with 2× the compile time); on a pod slice larger
-        k also divides the message count further.
+        real TPUs (the local kernel is Pallas). Default depth 32 — the
+        measured single-chip optimum at 252² with the A/c kernel (r3:
+        k=8 1.02 µs/step, k=16 0.889, k=32 0.848); on a pod slice larger
+        k also divides the message count further. Mid-size shards prefer
+        the deepest VMEM-fitting depth; HBM-resident shards cap the
+        default at 8 (default_deep_depth).
         """
-        from rocm_mpi_tpu.ops.pallas_kernels import DEFAULT_DEEP_STEPS
         from rocm_mpi_tpu.parallel.deep_halo import make_deep_sweep
 
         cfg = self.config
@@ -530,20 +561,9 @@ class HeatDiffusion:
         if block_steps is None:
             # Default depth, clamped so small shards keep working (explicit
             # depths keep make_deep_sweep's strict shard-extent validation).
-            k = min(DEFAULT_DEEP_STEPS, min(self.grid.local_shape))
-            # HBM-resident shards route to the temporal-blocked local sweep
-            # whose stripe ghosts bound the depth at 8 (multi_step_cm_hbm).
-            from rocm_mpi_tpu.ops.pallas_kernels import (
-                _VMEM_BLOCK_BUDGET_BYTES,
-                DEFAULT_TB_STEPS,
+            k = default_deep_depth(
+                self.grid.local_shape, jnp.dtype(cfg.jax_dtype).itemsize
             )
-
-            shard_bytes = 1
-            for ln in self.grid.local_shape:
-                shard_bytes *= ln + 2 * k
-            shard_bytes *= jnp.dtype(cfg.jax_dtype).itemsize
-            if shard_bytes > _VMEM_BLOCK_BUDGET_BYTES:
-                k = min(k, DEFAULT_TB_STEPS)
         else:
             k = block_steps
         k = effective_block_steps(

@@ -624,7 +624,11 @@ def _stripe_ghost_specs(tm, g, n0, rest):
 
 
 DEFAULT_TB_STEPS = 8  # HBM temporal blocking: bounded by the ghost rows
-DEFAULT_DEEP_STEPS = 16  # deep-halo sweeps: measured optimum at 252²/chip
+# Deep-halo sweep depth: single-chip optimum at 252² re-measured with the
+# A/c kernel form (r3: k=8 1.02 µs, k=16 0.889, k=32 0.848 — the prologue
+# amortizes further with depth); on a pod slice larger k also divides the
+# message count. HBM-resident shards cap at DEFAULT_TB_STEPS regardless.
+DEFAULT_DEEP_STEPS = 32
 _TB_G = 8  # tb-sweep ghost-block rows (the TPU sublane tile) = max k/sweep
 _TB_TM = 16  # stripe height; with _TB_G ghosts, tuned to the VMEM limit
 assert _TB_TM % _TB_G == 0  # _stripe_ghost_specs' index maps require it

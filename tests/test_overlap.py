@@ -151,6 +151,18 @@ class TestDeepHalo:
             np.asarray(r_deep.T), np.asarray(r_ref.T), rtol=2e-5, atol=1e-6
         )
 
+    def test_default_depth_selection(self):
+        from rocm_mpi_tpu.models.diffusion import default_deep_depth
+
+        # Small shard: full default, clamped by shard extent.
+        assert default_deep_depth((252, 252), 4) == 32
+        assert default_deep_depth((16, 16), 4) == 16
+        # Mid-size shard: 672² f32 fits VMEM at k=16 but not k=32 —
+        # prefer the shallower VMEM-resident sweep over the HBM route.
+        assert default_deep_depth((672, 672), 4) == 16
+        # Genuinely HBM-resident shard: capped at the tb sweep's bound.
+        assert default_deep_depth((12288, 12288), 4) == 8
+
     def test_depth_exceeding_shard_raises(self):
         import pytest
 
