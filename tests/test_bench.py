@@ -1,0 +1,55 @@
+"""bench.py contract: ONE JSON line with the driver-required keys, rc 0 —
+no matter what (the scored artifact must never be empty or malformed).
+
+Run off-TPU these exercise the no-accelerator smoke path end-to-end
+through the real parent/child subprocess shielding.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _run_bench(env_extra, timeout=240):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(env_extra)
+    return subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        capture_output=True, text=True, timeout=timeout, cwd=str(REPO),
+        env=env,
+    )
+
+
+def _contract_line(stdout: str) -> dict:
+    lines = [ln for ln in stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"expected exactly one stdout line, got {lines}"
+    obj = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in obj, f"missing contract key {key!r}: {obj}"
+    assert obj["unit"] == "Gpts/s"
+    assert isinstance(obj["value"], (int, float))
+    return obj
+
+
+def test_bench_contract_no_accelerator():
+    proc = _run_bench({"BENCH_BUDGET_S": "120"})
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    obj = _contract_line(proc.stdout)
+    # Off-TPU the honest fallback is the labeled interpret-mode smoke value.
+    assert "error" in obj and "no accelerator" in obj["error"]
+    assert obj["value"] > 0  # the smoke run really executed the kernel
+
+
+def test_bench_contract_malformed_budget():
+    # The malformed value falls back to the 300 s default budget, so the
+    # subprocess timeout must exceed it (two smoke-child attempts can
+    # legitimately run before the parent gives up on a cold machine).
+    proc = _run_bench({"BENCH_BUDGET_S": "not-a-number"}, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    _contract_line(proc.stdout)
+    assert "ignoring malformed BENCH_BUDGET_S" in proc.stderr
