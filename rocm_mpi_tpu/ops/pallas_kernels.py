@@ -372,13 +372,17 @@ def _multi_step_kernel(T_ref, Cm_ref, out_ref, *, inv_d2, chunk):
     T' = A∘T + Σ_ax c_ax∘(roll(T,-1,ax)+roll(T,+1,ax)) with A = 1−2Σc_ax
     and c_ax = Cm·inv_d2[ax] hoisted into a once-per-launch prologue —
     one fewer VPU op per axis per step, measured 8 % faster at 252² f32
-    (425→390 ns/step, docs/perstep_bounds_r3.txt protocol). The Dirichlet
-    hold stays exact: Cm==0 ⇒ c_ax==0, A==1.0 ⇒ T'==T bitwise. Short
-    chunks keep the direct form (the prologue would not amortize), and so
-    do fields beyond _AC_FORM_MAX_BYTES: the prologue keeps ndim+1 extra
-    field-sized arrays live across the unrolled loop, which near the 2 MB
-    admission budget would blow the VMEM footprint the old form was
-    validated under.
+    (425→390 ns/step, docs/perstep_bounds_r3.txt protocol). When the
+    spacing is equal on every axis (true of the benchmark geometry) the
+    per-axis coefficients collapse to ONE array c = Cm·inv with
+    A = 1−2·ndim·c and the roll pairs sum before the single multiply —
+    one fewer VPU multiply per step again (within-run A/B:
+    scripts/bench_kernel_forms.py). The Dirichlet hold stays exact in both
+    forms: Cm==0 ⇒ c==0, A==1.0 ⇒ T'==T bitwise. Short chunks keep the
+    direct form (the prologue would not amortize), and so do fields beyond
+    _AC_FORM_MAX_BYTES: the prologue keeps up to ndim+1 extra field-sized
+    arrays live across the unrolled loop, which near the 2 MB admission
+    budget would blow the VMEM footprint the old form was validated under.
     """
     ndim = len(T_ref.shape)
     nbytes = jnp.dtype(T_ref.dtype).itemsize
@@ -387,16 +391,34 @@ def _multi_step_kernel(T_ref, Cm_ref, out_ref, *, inv_d2, chunk):
     Cm = Cm_ref[:]
 
     if chunk >= 4 and nbytes <= _AC_FORM_MAX_BYTES:
-        cs = [Cm * inv for inv in inv_d2]
-        A = 1.0 - 2.0 * functools.reduce(lambda a, b: a + b, cs)
+        if all(inv == inv_d2[0] for inv in inv_d2):
+            # Equal-spacing specialization: the per-axis coefficients
+            # collapse to ONE array, c = Cm·inv, A = 1 − 2·ndim·c, and the
+            # roll pairs sum BEFORE the multiply —
+            # T' = A∘T + c∘Σ_ax(roll pair): one fewer VPU multiply per
+            # step than the general A/c form. Same Dirichlet argument:
+            # Cm==0 ⇒ c==0, A==1 ⇒ T'==T bitwise.
+            c = Cm * inv_d2[0]
+            A = 1.0 - (2.0 * ndim) * c
 
-        def body(_, T):
-            acc = A * T
-            for ax in range(ndim):
-                acc = acc + cs[ax] * (
-                    jnp.roll(T, -1, ax) + jnp.roll(T, 1, ax)
-                )
-            return acc
+            def body(_, T):
+                s = None
+                for ax in range(ndim):
+                    r = jnp.roll(T, -1, ax) + jnp.roll(T, 1, ax)
+                    s = r if s is None else s + r
+                return A * T + c * s
+
+        else:
+            cs = [Cm * inv for inv in inv_d2]
+            A = 1.0 - 2.0 * functools.reduce(lambda a, b: a + b, cs)
+
+            def body(_, T):
+                acc = A * T
+                for ax in range(ndim):
+                    acc = acc + cs[ax] * (
+                        jnp.roll(T, -1, ax) + jnp.roll(T, 1, ax)
+                    )
+                return acc
 
     else:
 

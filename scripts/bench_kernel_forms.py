@@ -1,0 +1,163 @@
+"""Kernel-form A/B for the VMEM-resident multi-step kernel (the scored path).
+
+Times candidate bodies of ops.pallas_kernels._multi_step_kernel at the
+benchmark geometry (252² f32, chunk=256) within ONE process, so tunnel
+run-to-run variance (~10-20 %) cancels and the comparison is the within-run
+protocol of docs/perstep_bounds_r3.txt. The baseline form is measured first
+AND last to expose drift.
+
+Candidates:
+
+  ac       — the production A/c form: T' = A∘T + Σ_ax c_ax∘(roll pair),
+             prologue-hoisted coefficients (ops/pallas_kernels.py).
+  eqc      — equal-spacing specialization (dx == dy, true of the benchmark
+             geometry): the per-axis coefficients collapse to ONE array c,
+             T' = A∘T + c∘(r₋x + r₊x + r₋y + r₊y) — one fewer VPU multiply
+             per step.
+  pad_ac   — the ac form on a 256²-padded layout: every vreg tile is full
+             and the ±1 rolls are aligned power-of-two shifts. The pad ring
+             carries Cm = 0, so pad cells never update and the interior is
+             bit-identical to the 252² program (roll wraparound only ever
+             reaches Cm==0 cells — same argument as the production kernel's
+             Dirichlet ring).
+  pad_eqc  — both.
+
+Each candidate is cross-checked against the production form (256 steps,
+allclose) before timing. Run on the chip:
+
+    python scripts/bench_kernel_forms.py [timed_steps]
+
+Output appended to stdout; the winning form gets productized in
+ops/pallas_kernels.py with the measured numbers in its docstring.
+"""
+
+import functools
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from rocm_mpi_tpu.ops.pallas_kernels import edge_masked_cm
+from rocm_mpi_tpu.utils import metrics
+
+N = 252
+PAD = 256
+CHUNK = 256
+WARMUP = 32_768
+LAM, CP0 = 1.0, 1.0
+
+
+def _body_ac(T, cs, A):
+    acc = A * T
+    for ax in range(T.ndim):
+        acc = acc + cs[ax] * (jnp.roll(T, -1, ax) + jnp.roll(T, 1, ax))
+    return acc
+
+
+def _body_eqc(T, c, A):
+    s = None
+    for ax in range(T.ndim):
+        r = jnp.roll(T, -1, ax) + jnp.roll(T, 1, ax)
+        s = r if s is None else s + r
+    return A * T + c * s
+
+
+def _kernel(T_ref, Cm_ref, out_ref, *, inv_d2, form):
+    Cm = Cm_ref[:]
+    if form == "ac":
+        cs = [Cm * inv for inv in inv_d2]
+        A = 1.0 - 2.0 * functools.reduce(lambda a, b: a + b, cs)
+        body = lambda _, T: _body_ac(T, cs, A)
+    else:  # eqc
+        assert all(inv == inv_d2[0] for inv in inv_d2)
+        c = Cm * inv_d2[0]
+        A = 1.0 - 2.0 * len(inv_d2) * c
+        body = lambda _, T: _body_eqc(T, c, A)
+    out_ref[:] = lax.fori_loop(0, CHUNK, body, T_ref[:], unroll=True)
+
+
+def make_advance(shape, inv_d2, form):
+    call = pl.pallas_call(
+        functools.partial(_kernel, inv_d2=inv_d2, form=form),
+        out_shape=jax.ShapeDtypeStruct(shape, jnp.float32),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+    )
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def advance(T, Cm, n):
+        return lax.fori_loop(0, n // CHUNK, lambda _, x: call(x, Cm), T)
+
+    return advance
+
+
+def main():
+    timed = int(sys.argv[1]) if len(sys.argv) > 1 else 8_388_608
+    timed -= timed % CHUNK
+    dev = jax.devices()[0]
+    print(f"device: {dev} | {N}² f32 chunk={CHUNK} | warmup {WARMUP} | "
+          f"timed {timed}")
+
+    spacing = 10.0 / N
+    inv = 1.0 / (spacing * spacing)
+    key = jax.random.PRNGKey(0)
+    T0 = jax.random.uniform(key, (N, N), jnp.float32)
+    Cp = jnp.full((N, N), CP0, jnp.float32)
+    # dt small enough to stay stable over millions of steps
+    dt = spacing * spacing * CP0 / LAM / 4.1
+    Cm = edge_masked_cm(T0, Cp, LAM, dt)
+
+    pad = ((0, PAD - N), (0, PAD - N))
+    T0p = jnp.pad(T0, pad)
+    Cmp = jnp.pad(Cm, pad)
+
+    cases = {
+        "ac": ((N, N), (inv, inv), "ac", T0, Cm, None),
+        "eqc": ((N, N), (inv, inv), "eqc", T0, Cm, None),
+        "pad_ac": ((PAD, PAD), (inv, inv), "ac", T0p, Cmp, (N, N)),
+        "pad_eqc": ((PAD, PAD), (inv, inv), "eqc", T0p, Cmp, (N, N)),
+    }
+
+    # Correctness referee: the production form, 256 steps.
+    ref_adv = make_advance((N, N), (inv, inv), "ac")
+    ref = np.asarray(ref_adv(jnp.copy(T0), Cm, CHUNK))
+
+    order = ["ac", "eqc", "pad_ac", "pad_eqc", "ac"]
+    results = {}
+    for i, name in enumerate(order):
+        shape, inv_d2, form, T_init, Cm_case, crop = cases[name]
+        adv = make_advance(shape, inv_d2, form)
+        out = np.asarray(adv(jnp.copy(T_init), Cm_case, CHUNK))
+        if crop:
+            out = out[: crop[0], : crop[1]]
+        np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-7,
+                                   err_msg=f"form {name} diverges")
+        T = adv(jnp.copy(T_init), Cm_case, WARMUP)
+        timer = metrics.Timer()
+        timer.tic(T)
+        T = adv(T, Cm_case, timed)
+        w = timer.toc(T)
+        ns = w / timed * 1e9
+        gpts = N * N / (w / timed) / 1e9
+        tag = f"{name}[{i}]"
+        results.setdefault(name, []).append(ns)
+        print(f"{tag:12s} {ns:8.2f} ns/step   {gpts:8.2f} Gpts/s (252² pts)")
+
+    base = min(results["ac"])
+    for name in ("eqc", "pad_ac", "pad_eqc"):
+        ns = min(results[name])
+        print(f"{name:8s} vs ac: {base / ns:.3f}x  ({base:.1f} -> {ns:.1f} ns)")
+
+
+if __name__ == "__main__":
+    main()

@@ -37,7 +37,11 @@ def _contract_line(stdout: str) -> dict:
 
 
 def test_bench_contract_no_accelerator():
-    proc = _run_bench({"BENCH_BUDGET_S": "120"})
+    # Generous budget: the smoke child (~30 s here) must finish within the
+    # parent's derived child timeout even on a much slower machine, or the
+    # parent honestly reports "did not complete" and this test would read
+    # as a contract violation instead of a timing flake.
+    proc = _run_bench({"BENCH_BUDGET_S": "360"}, timeout=400)
     assert proc.returncode == 0, proc.stderr[-1000:]
     obj = _contract_line(proc.stdout)
     # Off-TPU the honest fallback is the labeled interpret-mode smoke value.
@@ -45,11 +49,18 @@ def test_bench_contract_no_accelerator():
     assert obj["value"] > 0  # the smoke run really executed the kernel
 
 
-def test_bench_contract_malformed_budget():
-    # The malformed value falls back to the 300 s default budget, so the
-    # subprocess timeout must exceed it (two smoke-child attempts can
-    # legitimately run before the parent gives up on a cold machine).
-    proc = _run_bench({"BENCH_BUDGET_S": "not-a-number"}, timeout=420)
-    assert proc.returncode == 0, proc.stderr[-1000:]
-    _contract_line(proc.stdout)
-    assert "ignoring malformed BENCH_BUDGET_S" in proc.stderr
+def test_env_budget_malformed(monkeypatch, capsys):
+    # The malformed-budget fallback is a pure function; unit-test it
+    # instead of paying two full smoke-child subprocess runs.
+    sys.path.insert(0, str(REPO))
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    monkeypatch.setenv("BENCH_BUDGET_S", "not-a-number")
+    assert bench._env_budget() == bench.DEFAULT_BUDGET_S
+    assert "ignoring malformed BENCH_BUDGET_S" in capsys.readouterr().err
+    monkeypatch.setenv("BENCH_BUDGET_S", "42.5")
+    assert bench._env_budget() == 42.5
+    monkeypatch.delenv("BENCH_BUDGET_S")
+    assert bench._env_budget() == bench.DEFAULT_BUDGET_S

@@ -48,6 +48,23 @@ def test_multi_step_matches_stepwise():
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-12)
 
 
+@pytest.mark.parametrize("spacing", [(0.1, 0.1), (0.1, 0.07)])
+def test_multi_step_chunk4_ac_forms_match_stepwise(spacing):
+    # n_steps=50 above gets chunk gcd(50,256)=2, i.e. the direct form only.
+    # chunk=8 enters the prologue-hoisted A/c branch — the form the scored
+    # benchmark geometry executes: equal spacing takes the single-c (eqc)
+    # specialization, unequal spacing the per-axis general form. Tight
+    # tolerance against the per-step jnp oracle.
+    T = _rand((32, 32))
+    Cp = 1.0 + _rand((32, 32), seed=1)
+    args = (1.0, 1e-5, spacing)
+    got = fused_multi_step(T, Cp, *args, n_steps=16, chunk=8)
+    ref = T
+    for _ in range(16):
+        ref = step_fused(ref, Cp, *args)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-12)
+
+
 def _cm_oracle(Tp, Cm, spacing):
     """jnp oracle of the Cm contract: new core = Tp[core] + Cm·lap(Tp)."""
     ndim = Cm.ndim
