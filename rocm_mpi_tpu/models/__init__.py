@@ -1,3 +1,5 @@
-"""Physics models: the diffusion workloads at each performance level."""
+"""Physics models: the diffusion flagship at each performance level, plus
+the acoustic-wave workload (the framework-generality demo)."""
 
 from rocm_mpi_tpu.models.diffusion import HeatDiffusion, RunResult  # noqa: F401
+from rocm_mpi_tpu.models.wave import AcousticWave, WaveConfig  # noqa: F401

@@ -1,0 +1,237 @@
+"""Acoustic wave equation — the framework's second workload.
+
+Purpose: demonstrate that the framework layers the diffusion flagship is
+built from — cartesian mesh (parallel.mesh), ppermute halo exchange
+(parallel.halo), Pallas padded-block kernels (ops.*), fetch-forced timers
+(utils.metrics) — are workload-agnostic. This is what a *user* adding their
+own stencil model to the framework writes; the reference has no analog (it
+ships exactly one physics model), so this module is additive, not parity.
+
+Physics: u_tt = c² ∇²u with Dirichlet boundaries (edge cells held at their
+initial values — the same boundary design as the diffusion model, reusing
+the zero-ghost halo convention). Leapfrog (central-difference) time
+stepping over the state pair (U, U_prev):
+
+    U⁺ = 2U − U⁻ + dt²·c²·∇²U
+
+which is second-order accurate and exactly time-reversible — the
+reversibility test in tests/test_wave.py runs the trajectory backward to
+its initial state at rounding-level tolerance, a correctness check the
+dissipative diffusion model cannot offer.
+
+Variants mirror the flagship's ladder where it transfers:
+  "ap"   — global-array jnp ops; GSPMD partitions and inserts comms.
+  "perf" — shard_map + exchange_halo + whole-block Pallas kernel
+           (ops.wave_kernels), explicit Dirichlet mask.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+
+from rocm_mpi_tpu.config import DTYPES
+from rocm_mpi_tpu.ops.diffusion import gaussian_ic
+from rocm_mpi_tpu.ops.stencil import inn
+from rocm_mpi_tpu.parallel.halo import exchange_halo, global_boundary_mask
+from rocm_mpi_tpu.parallel.mesh import GlobalGrid, init_global_grid
+from rocm_mpi_tpu.utils import metrics
+
+
+@dataclasses.dataclass(frozen=True)
+class WaveConfig:
+    """Knobs of a wave run (same shape-vocabulary as DiffusionConfig)."""
+
+    global_shape: tuple[int, ...] = (128, 128)
+    lengths: tuple[float, ...] = (10.0, 10.0)
+    c0: float = 1.0  # wave speed
+    cfl: float = 0.5  # Courant number, < 1/√ndim for leapfrog stability
+    nt: int = 1000
+    warmup: int = 10
+    dtype: str = "f64"
+    dims: tuple[int, ...] | None = None
+
+    def __post_init__(self):
+        if len(self.lengths) != len(self.global_shape):
+            raise ValueError("lengths rank must match global_shape rank")
+        if self.dtype not in DTYPES:
+            raise ValueError(f"dtype must be one of {sorted(DTYPES)}")
+
+    @property
+    def ndim(self) -> int:
+        return len(self.global_shape)
+
+    @property
+    def jax_dtype(self):
+        return DTYPES[self.dtype]
+
+    @property
+    def spacing(self) -> tuple[float, ...]:
+        return tuple(l / n for l, n in zip(self.lengths, self.global_shape))
+
+    @property
+    def dt(self) -> float:
+        """CFL-stable leapfrog step: cfl·min(d)/(c0·√ndim)."""
+        return (
+            self.cfl * min(self.spacing) / (self.c0 * math.sqrt(self.ndim))
+        )
+
+
+def wave_step_padded(Up, Uprev, C2, dt, spacing):
+    """Candidate leapfrog update for every core cell of the padded block.
+
+    `Up` is width-1-padded displacement; `Uprev`/`C2` are core-shaped. Same
+    contract as ops.diffusion.step_fused_padded: the caller supplies ghosts
+    and masks global-boundary cells. Shares the padded-Laplacian helper
+    with the Pallas kernels (one stencil definition, two backends).
+    """
+    from rocm_mpi_tpu.ops.pallas_kernels import _lap_from_padded
+
+    inv_d2 = tuple(1.0 / (d * d) for d in spacing)
+    core = tuple(slice(1, -1) for _ in range(C2.ndim))
+    return 2.0 * Up[core] - Uprev + (dt * dt) * C2 * _lap_from_padded(
+        Up, inv_d2
+    )
+
+
+def wave_step_fused(U, Uprev, C2, dt, spacing):
+    """Global-array leapfrog step; edge cells pass through unchanged."""
+    core = tuple(slice(1, -1) for _ in range(U.ndim))
+    return U.at[core].set(
+        wave_step_padded(U, inn(Uprev), inn(C2), dt, spacing)
+    )
+
+
+@dataclasses.dataclass
+class WaveRunResult:
+    U: jax.Array
+    wtime: float
+    nt: int
+    warmup: int
+    config: WaveConfig
+
+    @property
+    def wtime_it(self) -> float:
+        return metrics.wtime_per_it(self.wtime, self.nt, self.warmup)
+
+    @property
+    def t_eff(self) -> float:
+        # 4 whole-array passes per step: read U, U_prev, C2; write U⁺.
+        return metrics.t_eff_gbs(
+            self.U.shape, self.U.dtype.itemsize, self.wtime_it, n_passes=4
+        )
+
+    @property
+    def gpts(self) -> float:
+        return metrics.gpts_per_s(self.U.shape, self.wtime_it)
+
+
+class AcousticWave:
+    """Leapfrog acoustic wave on a sharded global grid."""
+
+    def __init__(
+        self,
+        config: WaveConfig,
+        grid: GlobalGrid | None = None,
+        devices=None,
+    ):
+        self.config = config
+        if grid is None:
+            grid = init_global_grid(
+                *config.global_shape,
+                lengths=config.lengths,
+                dims=config.dims,
+                devices=devices,
+            )
+        self.grid = grid
+
+    def init_state(self):
+        """(U, U_prev, C2): Gaussian displacement at rest, uniform c²."""
+        cfg, grid = self.config, self.grid
+        dtype = cfg.jax_dtype
+
+        @functools.partial(jax.jit, out_shardings=grid.sharding)
+        def make_U():
+            return gaussian_ic(
+                grid.coord_mesh(dtype=dtype), cfg.lengths, dtype=dtype
+            )
+
+        @functools.partial(jax.jit, out_shardings=grid.sharding)
+        def make_C2():
+            return jnp.full(
+                grid.global_shape, cfg.c0 * cfg.c0, dtype=dtype
+            )
+
+        U = make_U()
+        return U, jnp.copy(U), make_C2()
+
+    def _step(self, variant: str):
+        """(U, Uprev, C2) -> (U⁺, U)."""
+        cfg, grid = self.config, self.grid
+        dt = cfg.jax_dtype(cfg.dt)
+
+        if variant == "ap":
+
+            def step(U, Uprev, C2):
+                return wave_step_fused(U, Uprev, C2, dt, cfg.spacing), U
+
+            return step
+        if variant == "perf":
+            from rocm_mpi_tpu.ops.wave_kernels import wave_step_padded_pallas
+
+            def step(U, Uprev, C2):
+                def local(Ul, Upl, C2l):
+                    pad = exchange_halo(Ul, grid)
+                    new = wave_step_padded_pallas(
+                        pad, Upl, C2l, dt, cfg.spacing
+                    )
+                    return jnp.where(global_boundary_mask(grid), Ul, new)
+
+                new = shard_map(
+                    local,
+                    mesh=grid.mesh,
+                    in_specs=(grid.spec,) * 3,
+                    out_specs=grid.spec,
+                    check_vma=False,
+                )(U, Uprev, C2)
+                return new, U
+
+            return step
+        raise ValueError(f"unknown wave variant {variant!r} (ap, perf)")
+
+    def advance_fn(self, variant: str = "perf"):
+        """jitted (U, Uprev, C2, n) -> (U after n steps, U after n-1)."""
+        step = self._step(variant)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def advance(U, Uprev, C2, n):
+            return lax.fori_loop(
+                0, n, lambda _, s: step(s[0], s[1], C2), (U, Uprev)
+            )
+
+        return advance
+
+    def run(
+        self, variant: str = "perf",
+        nt: int | None = None, warmup: int | None = None,
+    ) -> WaveRunResult:
+        cfg = self.config
+        nt = cfg.nt if nt is None else nt
+        warmup = cfg.warmup if warmup is None else warmup
+        if not 0 <= warmup < nt:
+            raise ValueError(f"need 0 <= warmup < nt, got {warmup}, {nt}")
+        U, Uprev, C2 = self.init_state()
+        advance = self.advance_fn(variant)
+        timer = metrics.Timer()
+        U, Uprev = advance(U, Uprev, C2, warmup)
+        timer.tic(U)
+        U, Uprev = advance(U, Uprev, C2, nt - warmup)
+        wtime = timer.toc(U)
+        return WaveRunResult(
+            U=U, wtime=wtime, nt=nt, warmup=warmup, config=cfg
+        )

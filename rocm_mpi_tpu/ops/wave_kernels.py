@@ -1,0 +1,69 @@
+"""Pallas TPU kernel for the acoustic-wave workload (framework-generality
+demo — no reference analog; the reference ships exactly one physics model).
+
+The leapfrog update U⁺ = 2U − U⁻ + dt²·c²·∇²U is a 3-operand stencil: the
+same padded-block contract as the diffusion kernels
+(ops.pallas_kernels.fused_step_padded), with a second state array read
+core-only. Note the Dirichlet guard CANNOT ride a zeroed coefficient here
+(c²==0 gives U⁺ = 2U − U⁻ ≠ U), so the caller masks boundary cells
+explicitly — the same structure as the diffusion 'shard' variant
+(models.diffusion._make_shard_step).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from rocm_mpi_tpu.ops.pallas_kernels import (
+    _VMEM_BLOCK_BUDGET_BYTES,
+    _interpret_default,
+    _lap_from_padded,
+    _out_struct,
+    _supports_compiled,
+)
+
+
+def _wave_kernel_whole(Up_ref, Uprev_ref, C2_ref, out_ref, *, dt2, inv_d2):
+    Up = Up_ref[:]
+    core = tuple(slice(1, -1) for _ in range(Up.ndim))
+    out_ref[:] = (
+        2.0 * Up[core]
+        - Uprev_ref[:]
+        + dt2 * C2_ref[:] * _lap_from_padded(Up, inv_d2)
+    )
+
+
+def wave_step_padded_pallas(Up, Uprev, C2, dt, spacing, interpret=None):
+    """Candidate leapfrog update for every core cell of a padded block.
+
+    `Up` is the width-1-padded displacement (ghosts from exchange_halo);
+    `Uprev` and `C2` (squared wave speed) are core-shaped. Whole-block VMEM
+    kernel; blocks beyond the VMEM budget fall back to the jnp padded form
+    (the wave workload is the layering demo, not the tuned flagship — the
+    diffusion kernels carry the striped/temporal-blocked machinery).
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    nbytes = C2.size * C2.dtype.itemsize
+    dt2 = float(dt) * float(dt)
+    inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
+    if (not _supports_compiled(Up.dtype) and not interpret) or (
+        nbytes > _VMEM_BLOCK_BUDGET_BYTES
+    ):
+        core = tuple(slice(1, -1) for _ in range(Up.ndim))
+        return (
+            2.0 * Up[core] - Uprev + dt2 * C2 * _lap_from_padded(Up, inv_d2)
+        )
+    kernel = functools.partial(_wave_kernel_whole, dt2=dt2, inv_d2=inv_d2)
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        kernel,
+        out_shape=_out_struct(C2.shape, C2),
+        in_specs=[vmem, vmem, vmem],
+        out_specs=vmem,
+        interpret=interpret,
+    )(Up, Uprev, C2)

@@ -72,13 +72,16 @@ def main():
 
     T0, Cm, inv_d2 = state(N)
     order = CASES + [CASES[0]]
+    advances = {}  # one compile + one referee check per case; repeats reuse
     for i, (tm, g, k) in enumerate(order):
         label = f"tm={tm} g={g} k={k}"
         try:
-            chk = make_advance(Tc, tm, g, k, invc)
-            out = np.asarray(chk(jnp.copy(Tc), Cmc, 32 // k))
-            np.testing.assert_allclose(out, ref, rtol=2e-6, atol=1e-7)
-            adv = make_advance(T0, tm, g, k, inv_d2)
+            if (tm, g, k) not in advances:
+                chk = make_advance(Tc, tm, g, k, invc)
+                out = np.asarray(chk(jnp.copy(Tc), Cmc, 32 // k))
+                np.testing.assert_allclose(out, ref, rtol=2e-6, atol=1e-7)
+                advances[(tm, g, k)] = make_advance(T0, tm, g, k, inv_d2)
+            adv = advances[(tm, g, k)]
             nsw = timed // k
             T = adv(jnp.copy(T0), Cm, max(1, 16 // k))  # warmup/compile
             timer = metrics.Timer()
@@ -91,7 +94,8 @@ def main():
             print(f"[{i}] {label:18s} {us:9.3f} us/step  {gpts:7.2f} Gpts/s  "
                   f"T_eff(equiv)={eq_gbs:7.1f} GB/s")
         except Exception as e:  # compile/VMEM failures are data, not crashes
-            msg = str(e).splitlines()[0][:120] if str(e) else type(e).__name__
+            lines = [ln for ln in str(e).splitlines() if ln.strip()]
+            msg = lines[0][:120] if lines else type(e).__name__
             print(f"[{i}] {label:18s} FAILED: {msg}")
 
 

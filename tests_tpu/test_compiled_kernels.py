@@ -82,6 +82,18 @@ def test_vmem_multi_step_compiled():
     _close(pk.fused_multi_step(T, Cp, *args, n_steps=32, chunk=16), ref)
 
 
+def test_vmem_multi_step_unequal_spacing_compiled():
+    # chunk >= 4 with unequal spacing: the general per-axis A/c branch
+    # (equal spacing above takes the single-c specialization instead).
+    T = _rand((32, 32))
+    Cp = 1.0 + _rand((32, 32), seed=1)
+    args = (1.0, 1e-5, (0.1, 0.07))
+    ref = T
+    for _ in range(16):
+        ref = step_fused(ref, Cp, *args)
+    _close(pk.fused_multi_step(T, Cp, *args, n_steps=16, chunk=8), ref)
+
+
 def test_temporal_blocked_compiled():
     T = _rand((48, 48))
     Cp = 1.0 + _rand((48, 48), seed=1)
