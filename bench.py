@@ -208,6 +208,13 @@ def run_suite() -> None:
     from rocm_mpi_tpu.config import DiffusionConfig
     from rocm_mpi_tpu.models import HeatDiffusion
 
+    def report(label, r):
+        print(
+            f"{label:34s} {r.wtime_it * 1e6:12.3f} us/step  "
+            f"T_eff={r.t_eff:8.1f} GB/s  {r.gpts:8.3f} Gpts/s",
+            file=sys.stderr,
+        )
+
     def row(label, shape, runner, nt, warmup, dtype="f32", **kw):
         cfg = DiffusionConfig(
             global_shape=shape,
@@ -218,12 +225,7 @@ def run_suite() -> None:
             dims=(1,) * len(shape),
         )
         model = HeatDiffusion(cfg)
-        r = getattr(model, runner)(**kw)
-        print(
-            f"{label:34s} {r.wtime_it * 1e6:12.3f} us/step  "
-            f"T_eff={r.t_eff:8.1f} GB/s  {r.gpts:8.3f} Gpts/s",
-            file=sys.stderr,
-        )
+        report(label, getattr(model, runner)(**kw))
 
     row("252² VMEM-resident loop", (252, 252), "run_vmem_resident",
         32_768 + 1_048_576, 32_768)
@@ -248,6 +250,16 @@ def run_suite() -> None:
         3_208, 8)
     row("128³ 3D per-step perf", (128, 128, 128), "run", 1_100, 100,
         variant="perf")
+
+    # Second workload (models.wave): per-step leapfrog through the same
+    # layers — 4 passes/step (read U, U_prev, C2; write U⁺).
+    from rocm_mpi_tpu.models.wave import AcousticWave, WaveConfig
+
+    wcfg = WaveConfig(
+        global_shape=(252, 252), lengths=(10.0, 10.0), nt=220_000,
+        warmup=20_000, dtype="f32", dims=(1, 1),
+    )
+    report("252² wave per-step perf", AcousticWave(wcfg).run(variant="perf"))
 
 
 # --------------------------------------------------------------------------

@@ -38,6 +38,7 @@ from jax import lax, shard_map
 from rocm_mpi_tpu.config import DTYPES
 from rocm_mpi_tpu.ops.diffusion import gaussian_ic
 from rocm_mpi_tpu.ops.stencil import inn
+from rocm_mpi_tpu.ops.wave_kernels import wave_step_padded  # noqa: F401  (re-export)
 from rocm_mpi_tpu.parallel.halo import exchange_halo, global_boundary_mask
 from rocm_mpi_tpu.parallel.mesh import GlobalGrid, init_global_grid
 from rocm_mpi_tpu.utils import metrics
@@ -50,7 +51,7 @@ class WaveConfig:
     global_shape: tuple[int, ...] = (128, 128)
     lengths: tuple[float, ...] = (10.0, 10.0)
     c0: float = 1.0  # wave speed
-    cfl: float = 0.5  # Courant number, < 1/√ndim for leapfrog stability
+    cfl: float = 0.5  # Courant number, < 1 (dt already has the 1/√ndim factor)
     nt: int = 1000
     warmup: int = 10
     dtype: str = "f64"
@@ -80,23 +81,6 @@ class WaveConfig:
         return (
             self.cfl * min(self.spacing) / (self.c0 * math.sqrt(self.ndim))
         )
-
-
-def wave_step_padded(Up, Uprev, C2, dt, spacing):
-    """Candidate leapfrog update for every core cell of the padded block.
-
-    `Up` is width-1-padded displacement; `Uprev`/`C2` are core-shaped. Same
-    contract as ops.diffusion.step_fused_padded: the caller supplies ghosts
-    and masks global-boundary cells. Shares the padded-Laplacian helper
-    with the Pallas kernels (one stencil definition, two backends).
-    """
-    from rocm_mpi_tpu.ops.pallas_kernels import _lap_from_padded
-
-    inv_d2 = tuple(1.0 / (d * d) for d in spacing)
-    core = tuple(slice(1, -1) for _ in range(C2.ndim))
-    return 2.0 * Up[core] - Uprev + (dt * dt) * C2 * _lap_from_padded(
-        Up, inv_d2
-    )
 
 
 def wave_step_fused(U, Uprev, C2, dt, spacing):

@@ -27,6 +27,21 @@ from rocm_mpi_tpu.ops.pallas_kernels import (
 )
 
 
+def wave_step_padded(Up, Uprev, C2, dt, spacing):
+    """Candidate leapfrog update for every core cell of the padded block
+    (pure jnp). `Up` is width-1-padded displacement; `Uprev`/`C2` are
+    core-shaped. Same contract as ops.diffusion.step_fused_padded: the
+    caller supplies ghosts and masks global-boundary cells. The one
+    stencil definition — the Pallas kernel below computes the same
+    expression in VMEM, and the VMEM-overflow fallback calls this.
+    """
+    inv_d2 = tuple(1.0 / (d * d) for d in spacing)
+    core = tuple(slice(1, -1) for _ in range(C2.ndim))
+    return 2.0 * Up[core] - Uprev + (dt * dt) * C2 * _lap_from_padded(
+        Up, inv_d2
+    )
+
+
 def _wave_kernel_whole(Up_ref, Uprev_ref, C2_ref, out_ref, *, dt2, inv_d2):
     Up = Up_ref[:]
     core = tuple(slice(1, -1) for _ in range(Up.ndim))
@@ -49,15 +64,12 @@ def wave_step_padded_pallas(Up, Uprev, C2, dt, spacing, interpret=None):
     if interpret is None:
         interpret = _interpret_default()
     nbytes = C2.size * C2.dtype.itemsize
-    dt2 = float(dt) * float(dt)
-    inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
     if (not _supports_compiled(Up.dtype) and not interpret) or (
         nbytes > _VMEM_BLOCK_BUDGET_BYTES
     ):
-        core = tuple(slice(1, -1) for _ in range(Up.ndim))
-        return (
-            2.0 * Up[core] - Uprev + dt2 * C2 * _lap_from_padded(Up, inv_d2)
-        )
+        return wave_step_padded(Up, Uprev, C2, dt, spacing)
+    dt2 = float(dt) * float(dt)
+    inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
     kernel = functools.partial(_wave_kernel_whole, dt2=dt2, inv_d2=inv_d2)
     vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
     return pl.pallas_call(
