@@ -38,6 +38,7 @@ human-readable table to stderr — the source of BASELINE.md's measured
 numbers. It runs inline (manual/diagnostic use; no subprocess shielding).
 """
 
+import dataclasses
 import json
 import os
 import subprocess
@@ -260,6 +261,11 @@ def run_suite() -> None:
         warmup=20_000, dtype="f32", dims=(1, 1),
     )
     report("252² wave per-step perf", AcousticWave(wcfg).run(variant="perf"))
+    wcfg_v = dataclasses.replace(wcfg, nt=32_768 + 1_048_576, warmup=32_768)
+    report(
+        "252² wave VMEM-resident loop",
+        AcousticWave(wcfg_v).run_vmem_resident(),
+    )
 
 
 # --------------------------------------------------------------------------

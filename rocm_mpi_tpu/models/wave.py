@@ -219,3 +219,43 @@ class AcousticWave:
         return WaveRunResult(
             U=U, wtime=wtime, nt=nt, warmup=warmup, config=cfg
         )
+
+    def run_vmem_resident(
+        self, nt: int | None = None, warmup: int | None = None
+    ) -> WaveRunResult:
+        """Single-shard fast path: the whole leapfrog loop inside one
+        Pallas kernel, state pair VMEM-resident
+        (ops.wave_kernels.wave_multi_step) — the wave edition of the
+        diffusion flagship's schedule (HeatDiffusion.run_vmem_resident).
+        """
+        from rocm_mpi_tpu.models.diffusion import effective_block_steps
+        from rocm_mpi_tpu.ops.pallas_kernels import DEFAULT_STEP_CHUNK
+        from rocm_mpi_tpu.ops.wave_kernels import wave_multi_step
+
+        cfg = self.config
+        nt = cfg.nt if nt is None else nt
+        warmup = cfg.warmup if warmup is None else warmup
+        if not 0 <= warmup < nt:
+            raise ValueError(f"need 0 <= warmup < nt, got {warmup}, {nt}")
+        if self.grid.nprocs != 1:
+            raise ValueError("the VMEM-resident path requires an unsharded grid")
+        chunk = effective_block_steps(
+            nt, warmup, DEFAULT_STEP_CHUNK, warn=False
+        )
+        dt = cfg.jax_dtype(cfg.dt)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def advance(U, Uprev, C2, n):
+            return wave_multi_step(
+                U, Uprev, C2, dt, cfg.spacing, n, chunk=chunk
+            )
+
+        U, Uprev, C2 = self.init_state()
+        timer = metrics.Timer()
+        U, Uprev = advance(U, Uprev, C2, warmup)
+        timer.tic(U)
+        U, Uprev = advance(U, Uprev, C2, nt - warmup)
+        wtime = timer.toc(U)
+        return WaveRunResult(
+            U=U, wtime=wtime, nt=nt, warmup=warmup, config=cfg
+        )

@@ -441,6 +441,43 @@ DEFAULT_STEP_CHUNK = 256
 _AC_FORM_MAX_BYTES = 512 * 1024
 
 
+def resolve_step_chunk(n_steps, chunk, nbytes, warn_on_cap=True):
+    """The one chunk policy of the VMEM-resident multi-step kernels
+    (fused_multi_step and ops.wave_kernels.wave_multi_step): default
+    gcd(n_steps, DEFAULT_STEP_CHUNK) for static step counts; an explicit
+    chunk must divide a static n_steps; and fields beyond the 256 KB
+    unroll-friendly class cap the chunk at gcd(chunk, 16) — Mosaic compile
+    time grows superlinearly in unrolled-steps × field size (252² compiles
+    chunk=256 in tens of seconds; 512² at chunk=64 exceeded 9 minutes,
+    measured) — warning when that degrades an explicitly requested chunk.
+    """
+    import math
+
+    n_static = isinstance(n_steps, int)
+    explicit = chunk is not None
+    if chunk is None:
+        chunk = (
+            math.gcd(n_steps, DEFAULT_STEP_CHUNK)
+            if n_static
+            else DEFAULT_STEP_CHUNK
+        )
+    if n_static and n_steps % chunk != 0:
+        raise ValueError(f"chunk {chunk} must divide n_steps {n_steps}")
+    if nbytes > 256 * 1024:
+        capped = math.gcd(chunk, 16) or 1
+        if explicit and warn_on_cap and capped != chunk:
+            import warnings
+
+            warnings.warn(
+                f"chunk degraded: {chunk} requested but the {nbytes}-byte "
+                f"field exceeds the 256 KB unroll-friendly class; running "
+                f"chunk={capped} (longer unrolls stall the Mosaic compiler).",
+                stacklevel=3,
+            )
+        chunk = capped
+    return chunk
+
+
 def fused_multi_step(T, Cp, lam, dt, spacing, n_steps, chunk=None, interpret=None,
                      warn_on_cap=True):
     """Advance a *single-shard* field `n_steps` barely leaving VMEM.
@@ -458,8 +495,6 @@ def fused_multi_step(T, Cp, lam, dt, spacing, n_steps, chunk=None, interpret=Non
     `n_steps` with the same chunk. Global
     boundary = block boundary (Dirichlet).
     """
-    import math
-
     if interpret is None:
         interpret = _interpret_default()
     if not _supports_compiled(T.dtype) and not interpret:
@@ -470,32 +505,7 @@ def fused_multi_step(T, Cp, lam, dt, spacing, n_steps, chunk=None, interpret=Non
             f"field of {nbytes} bytes exceeds the VMEM-resident budget "
             f"({_VMEM_BLOCK_BUDGET_BYTES}); use the per-step path"
         )
-    n_static = isinstance(n_steps, int)
-    explicit_chunk = chunk is not None
-    if chunk is None:
-        chunk = (
-            math.gcd(n_steps, DEFAULT_STEP_CHUNK) if n_static else DEFAULT_STEP_CHUNK
-        )
-    if n_static and n_steps % chunk != 0:
-        raise ValueError(f"chunk {chunk} must divide n_steps {n_steps}")
-    # Mosaic compile time grows superlinearly in unrolled-steps × field
-    # size: 252² (64 vregs) compiles chunk=256 in tens of seconds, but
-    # 512² at chunk=64 exceeded 9 minutes (measured). For fields beyond
-    # the 252²-class, cap the chunk (gcd keeps divisibility; see the
-    # docstring — the cap applies to explicit chunks too, because a
-    # stalled compile is strictly worse than a shorter unroll).
-    if nbytes > 256 * 1024:
-        capped = math.gcd(chunk, 16) or 1
-        if explicit_chunk and warn_on_cap and capped != chunk:
-            import warnings
-
-            warnings.warn(
-                f"chunk degraded: {chunk} requested but the {nbytes}-byte "
-                f"field exceeds the 256 KB unroll-friendly class; running "
-                f"chunk={capped} (longer unrolls stall the Mosaic compiler).",
-                stacklevel=2,
-            )
-        chunk = capped
+    chunk = resolve_step_chunk(n_steps, chunk, nbytes, warn_on_cap)
     lam, dt = float(lam), float(dt)
     inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
     # Masked update coefficient, computed ONCE per advance call (not per

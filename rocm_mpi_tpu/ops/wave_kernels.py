@@ -1,13 +1,18 @@
-"""Pallas TPU kernel for the acoustic-wave workload (framework-generality
+"""Pallas TPU kernels for the acoustic-wave workload (framework-generality
 demo — no reference analog; the reference ships exactly one physics model).
 
 The leapfrog update U⁺ = 2U − U⁻ + dt²·c²·∇²U is a 3-operand stencil: the
 same padded-block contract as the diffusion kernels
 (ops.pallas_kernels.fused_step_padded), with a second state array read
-core-only. Note the Dirichlet guard CANNOT ride a zeroed coefficient here
-(c²==0 gives U⁺ = 2U − U⁻ ≠ U), so the caller masks boundary cells
-explicitly — the same structure as the diffusion 'shard' variant
-(models.diffusion._make_shard_step).
+core-only. Note the Dirichlet guard CANNOT ride a zeroed coefficient alone
+(c²==0 gives U⁺ = 2U − U⁻ ≠ U): the per-step path masks explicitly in the
+caller (the diffusion 'shard' variant structure), and the VMEM-resident
+multi-step kernel rewrites the update as
+
+    U⁺ = U + M∘(U − U⁻) + Cw∘∇²U,   M = interior mask, Cw = dt²·c²·M
+
+which holds edge cells bitwise (M==0 and Cw==0 ⇒ U⁺==U) — the wave
+edition of the diffusion kernels' mask-as-data contract.
 """
 
 from __future__ import annotations
@@ -15,6 +20,7 @@ from __future__ import annotations
 import functools
 
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -79,3 +85,95 @@ def wave_step_padded_pallas(Up, Uprev, C2, dt, spacing, interpret=None):
         out_specs=vmem,
         interpret=interpret,
     )(Up, Uprev, C2)
+
+
+# ---------------------------------------------------------------------------
+# Whole-loop-in-VMEM leapfrog: the wave edition of the diffusion flagship's
+# fused_multi_step schedule (one HBM round-trip per `chunk` steps).
+# ---------------------------------------------------------------------------
+
+
+def _wave_multi_step_kernel(
+    U_ref, Uprev_ref, M_ref, Cw_ref, oU_ref, oUprev_ref, *, inv_d2, chunk
+):
+    """`chunk` leapfrog steps with the state pair VMEM-resident.
+
+    Neighbors come from `jnp.roll` with the same wraparound argument as the
+    diffusion kernel (_multi_step_kernel): wrapped values only ever feed
+    edge cells, which M==0 / Cw==0 hold bitwise fixed.
+    """
+    M, Cw = M_ref[:], Cw_ref[:]
+    ndim = M.ndim
+
+    def body(_, s):
+        U, Uprev = s
+        lap = None
+        for ax in range(ndim):
+            term = (
+                jnp.roll(U, -1, ax) + jnp.roll(U, 1, ax) - 2.0 * U
+            ) * inv_d2[ax]
+            lap = term if lap is None else lap + term
+        return U + M * (U - Uprev) + Cw * lap, U
+
+    U, Uprev = lax.fori_loop(
+        0, chunk, body, (U_ref[:], Uprev_ref[:]), unroll=True
+    )
+    oU_ref[:] = U
+    oUprev_ref[:] = Uprev
+
+
+def interior_mask(shape, dtype):
+    """1.0 on interior cells, exactly 0.0 on the global Dirichlet edge."""
+    mask = None
+    for ax in range(len(shape)):
+        idx = lax.broadcasted_iota(jnp.int32, shape, ax)
+        m = (idx == 0) | (idx == shape[ax] - 1)
+        mask = m if mask is None else (mask | m)
+    return jnp.where(mask, jnp.zeros(shape, dtype), jnp.ones(shape, dtype))
+
+
+def wave_multi_step(
+    U, Uprev, C2, dt, spacing, n_steps, chunk=None, interpret=None
+):
+    """Advance a *single-shard* leapfrog state `n_steps` barely leaving
+    VMEM — the wave edition of ops.pallas_kernels.fused_multi_step (same
+    schedule, chunk, and compile-time constraints; see its docstring).
+    Returns the advanced (U, U_prev) pair. `chunk` must divide `n_steps`
+    when both are static; the outer trip count is dynamic. The kernel
+    holds 4 field-sized arrays (U, U⁻, M, Cw), so admission is gated on
+    half the diffusion kernel's VMEM budget.
+    """
+    from rocm_mpi_tpu.ops.pallas_kernels import resolve_step_chunk
+
+    if interpret is None:
+        interpret = _interpret_default()
+    if not _supports_compiled(U.dtype) and not interpret:
+        raise TypeError(f"Mosaic does not support {U.dtype}")
+    nbytes = U.size * U.dtype.itemsize
+    if nbytes > _VMEM_BLOCK_BUDGET_BYTES // 2:
+        raise ValueError(
+            f"field of {nbytes} bytes exceeds the wave VMEM-resident "
+            f"budget ({_VMEM_BLOCK_BUDGET_BYTES // 2}); use the per-step "
+            "path"
+        )
+    chunk = resolve_step_chunk(n_steps, chunk, nbytes)
+    inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
+    M = interior_mask(U.shape, U.dtype)
+    Cw = (float(dt) * float(dt)) * C2 * M
+    kernel = functools.partial(
+        _wave_multi_step_kernel, inv_d2=inv_d2, chunk=chunk
+    )
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    run_chunk = pl.pallas_call(
+        kernel,
+        out_shape=(_out_struct(U.shape, U), _out_struct(U.shape, U)),
+        in_specs=[vmem, vmem, vmem, vmem],
+        out_specs=(vmem, vmem),
+        interpret=interpret,
+    )
+    return lax.fori_loop(
+        0,
+        n_steps // chunk,
+        lambda _, s: run_chunk(s[0], s[1], M, Cw),
+        (U, Uprev),
+    )

@@ -279,6 +279,23 @@ def test_deep_halo_hbm_shard_compiled():
     _close(sweep(T, Cp), ref)
 
 
+def test_wave_kernel_compiled():
+    # Second workload's Pallas kernel (ops.wave_kernels) vs its jnp twin.
+    from rocm_mpi_tpu.ops.wave_kernels import (
+        wave_step_padded,
+        wave_step_padded_pallas,
+    )
+
+    Up = _rand((34, 30))
+    Uprev = _rand((32, 28), seed=1)
+    C2 = 1.0 + _rand((32, 28), seed=2)
+    dt, spacing = 1e-3, (0.1, 0.07)
+    _close(
+        wave_step_padded_pallas(Up, Uprev, C2, dt, spacing),
+        wave_step_padded(Up, Uprev, C2, dt, spacing),
+    )
+
+
 def test_model_runners_compiled():
     # The model-level fast paths end-to-end on the chip at tiny sizes.
     cfg = DiffusionConfig(
