@@ -200,17 +200,17 @@ class AcousticWave:
 
         return advance
 
-    def run(
-        self, variant: str = "perf",
-        nt: int | None = None, warmup: int | None = None,
-    ) -> WaveRunResult:
+    def _run_timed(self, advance, nt, warmup) -> WaveRunResult:
+        """Shared run scaffold: validate the windows, init, then
+        warmup-advance / tic / advance / toc (the same protocol as the
+        diffusion runners; `advance(U, Uprev, C2, n) -> (U, Uprev)` must
+        serve both windows with one compiled program)."""
         cfg = self.config
         nt = cfg.nt if nt is None else nt
         warmup = cfg.warmup if warmup is None else warmup
         if not 0 <= warmup < nt:
             raise ValueError(f"need 0 <= warmup < nt, got {warmup}, {nt}")
         U, Uprev, C2 = self.init_state()
-        advance = self.advance_fn(variant)
         timer = metrics.Timer()
         U, Uprev = advance(U, Uprev, C2, warmup)
         timer.tic(U)
@@ -219,6 +219,12 @@ class AcousticWave:
         return WaveRunResult(
             U=U, wtime=wtime, nt=nt, warmup=warmup, config=cfg
         )
+
+    def run(
+        self, variant: str = "perf",
+        nt: int | None = None, warmup: int | None = None,
+    ) -> WaveRunResult:
+        return self._run_timed(self.advance_fn(variant), nt, warmup)
 
     def run_vmem_resident(
         self, nt: int | None = None, warmup: int | None = None
@@ -233,29 +239,23 @@ class AcousticWave:
         from rocm_mpi_tpu.ops.wave_kernels import wave_multi_step
 
         cfg = self.config
-        nt = cfg.nt if nt is None else nt
-        warmup = cfg.warmup if warmup is None else warmup
-        if not 0 <= warmup < nt:
-            raise ValueError(f"need 0 <= warmup < nt, got {warmup}, {nt}")
         if self.grid.nprocs != 1:
             raise ValueError("the VMEM-resident path requires an unsharded grid")
         chunk = effective_block_steps(
-            nt, warmup, DEFAULT_STEP_CHUNK, warn=False
+            cfg.nt if nt is None else nt,
+            cfg.warmup if warmup is None else warmup,
+            DEFAULT_STEP_CHUNK,
+            warn=False,
         )
         dt = cfg.jax_dtype(cfg.dt)
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def advance(U, Uprev, C2, n):
+            # warn_on_cap=False: the chunk is framework-plumbed here, not
+            # caller-requested (same policy as diffusion _run_single_shard).
             return wave_multi_step(
-                U, Uprev, C2, dt, cfg.spacing, n, chunk=chunk
+                U, Uprev, C2, dt, cfg.spacing, n, chunk=chunk,
+                warn_on_cap=False,
             )
 
-        U, Uprev, C2 = self.init_state()
-        timer = metrics.Timer()
-        U, Uprev = advance(U, Uprev, C2, warmup)
-        timer.tic(U)
-        U, Uprev = advance(U, Uprev, C2, nt - warmup)
-        wtime = timer.toc(U)
-        return WaveRunResult(
-            U=U, wtime=wtime, nt=nt, warmup=warmup, config=cfg
-        )
+        return self._run_timed(advance, nt, warmup)

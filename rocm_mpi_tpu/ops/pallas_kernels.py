@@ -575,6 +575,19 @@ def multi_step_cm(T, Cm, spacing, n_steps: int, interpret=None):
 # ---------------------------------------------------------------------------
 
 
+def edge_mask(shape):
+    """Boolean mask: True on the global Dirichlet edge of an unsharded
+    block (every axis's first/last cell). The one edge-detection used by
+    both mask-as-data contracts (diffusion's edge_masked_cm, the wave
+    workload's interior_mask)."""
+    mask = None
+    for ax in range(len(shape)):
+        idx = lax.broadcasted_iota(jnp.int32, shape, ax)
+        m = (idx == 0) | (idx == shape[ax] - 1)
+        mask = m if mask is None else (mask | m)
+    return mask
+
+
 def edge_masked_cm(T, Cp, lam, dt):
     """(dt·λ)/Cp on the interior, exactly 0.0 on the global Dirichlet edge.
 
@@ -585,12 +598,7 @@ def edge_masked_cm(T, Cp, lam, dt):
     the block edge IS the global boundary; the sharded form masks via
     parallel.halo.global_boundary_mask instead.
     """
-    mask = None
-    for ax in range(T.ndim):
-        idx = lax.broadcasted_iota(jnp.int32, T.shape, ax)
-        m = (idx == 0) | (idx == T.shape[ax] - 1)
-        mask = m if mask is None else (mask | m)
-    return jnp.where(mask, jnp.zeros_like(Cp), (dt * lam) / Cp)
+    return jnp.where(edge_mask(T.shape), jnp.zeros_like(Cp), (dt * lam) / Cp)
 
 
 _edge_masked_cm = edge_masked_cm  # internal alias (pre-r3 name)

@@ -123,17 +123,18 @@ def _wave_multi_step_kernel(
 
 
 def interior_mask(shape, dtype):
-    """1.0 on interior cells, exactly 0.0 on the global Dirichlet edge."""
-    mask = None
-    for ax in range(len(shape)):
-        idx = lax.broadcasted_iota(jnp.int32, shape, ax)
-        m = (idx == 0) | (idx == shape[ax] - 1)
-        mask = m if mask is None else (mask | m)
-    return jnp.where(mask, jnp.zeros(shape, dtype), jnp.ones(shape, dtype))
+    """1.0 on interior cells, exactly 0.0 on the global Dirichlet edge
+    (the shared edge detection of ops.pallas_kernels.edge_mask)."""
+    from rocm_mpi_tpu.ops.pallas_kernels import edge_mask
+
+    return jnp.where(
+        edge_mask(shape), jnp.zeros(shape, dtype), jnp.ones(shape, dtype)
+    )
 
 
 def wave_multi_step(
-    U, Uprev, C2, dt, spacing, n_steps, chunk=None, interpret=None
+    U, Uprev, C2, dt, spacing, n_steps, chunk=None, interpret=None,
+    warn_on_cap=True,
 ):
     """Advance a *single-shard* leapfrog state `n_steps` barely leaving
     VMEM — the wave edition of ops.pallas_kernels.fused_multi_step (same
@@ -156,7 +157,7 @@ def wave_multi_step(
             f"budget ({_VMEM_BLOCK_BUDGET_BYTES // 2}); use the per-step "
             "path"
         )
-    chunk = resolve_step_chunk(n_steps, chunk, nbytes)
+    chunk = resolve_step_chunk(n_steps, chunk, nbytes, warn_on_cap)
     inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
     M = interior_mask(U.shape, U.dtype)
     Cw = (float(dt) * float(dt)) * C2 * M
