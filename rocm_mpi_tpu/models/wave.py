@@ -259,3 +259,38 @@ class AcousticWave:
             )
 
         return self._run_timed(advance, nt, warmup)
+
+    def run_deep(
+        self,
+        nt: int | None = None,
+        warmup: int | None = None,
+        block_steps: int = 8,
+    ) -> WaveRunResult:
+        """Sharded fast path: deep-halo sweeps for the wave — one width-k
+        ghost exchange of the leapfrog state pair per k steps
+        (parallel.deep_halo.make_wave_deep_sweep), the second workload on
+        the flagship multi-chip schedule (HeatDiffusion.run_deep).
+        """
+        from rocm_mpi_tpu.models.diffusion import effective_block_steps
+        from rocm_mpi_tpu.parallel.deep_halo import make_wave_deep_sweep
+
+        cfg = self.config
+        k = effective_block_steps(
+            cfg.nt if nt is None else nt,
+            cfg.warmup if warmup is None else warmup,
+            # Clamp to the smallest shard extent (ghost slices need
+            # width <= shard), as diffusion's default_deep_depth does.
+            min(block_steps, min(self.grid.local_shape)),
+            label="wave deep-halo sweep depth",
+            stacklevel=2,
+        )
+        dt = cfg.jax_dtype(cfg.dt)
+        sweep = make_wave_deep_sweep(self.grid, k, dt, cfg.spacing)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def advance(U, Uprev, C2, n):
+            return lax.fori_loop(
+                0, n // k, lambda _, s: sweep(s[0], s[1], C2), (U, Uprev)
+            )
+
+        return self._run_timed(advance, nt, warmup)

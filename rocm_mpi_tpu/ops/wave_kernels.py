@@ -132,6 +132,45 @@ def interior_mask(shape, dtype):
     )
 
 
+def wave_multi_step_masked(U, Uprev, M, Cw, spacing, n_steps: int,
+                           interpret=None):
+    """`n_steps` unrolled leapfrog steps on a VMEM-resident state pair with
+    caller-supplied interior mask `M` and masked coefficient `Cw` (dt²·c²
+    where the cell updates, exactly 0.0 where held) — the wave analog of
+    ops.pallas_kernels.multi_step_cm, and the local compute of wave deep-
+    halo sweeps (parallel.deep_halo.make_wave_deep_sweep): the caller pads
+    the blocks and zeroes M/Cw on ghost/Dirichlet cells; `n_steps` must
+    not exceed the ghost width. Returns the advanced (U, U_prev) pair.
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    if not _supports_compiled(U.dtype) and not interpret:
+        raise TypeError(f"Mosaic does not support {U.dtype}")
+    if not (U.shape == Uprev.shape == M.shape == Cw.shape):
+        raise ValueError(
+            f"shape mismatch: U {U.shape}, Uprev {Uprev.shape}, "
+            f"M {M.shape}, Cw {Cw.shape}"
+        )
+    nbytes = U.size * U.dtype.itemsize
+    if nbytes > _VMEM_BLOCK_BUDGET_BYTES // 2:
+        raise ValueError(
+            f"block of {nbytes} bytes exceeds the wave VMEM-resident "
+            f"budget ({_VMEM_BLOCK_BUDGET_BYTES // 2})"
+        )
+    inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
+    kernel = functools.partial(
+        _wave_multi_step_kernel, inv_d2=inv_d2, chunk=int(n_steps)
+    )
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        kernel,
+        out_shape=(_out_struct(U.shape, U), _out_struct(U.shape, U)),
+        in_specs=[vmem, vmem, vmem, vmem],
+        out_specs=(vmem, vmem),
+        interpret=interpret,
+    )(U, Uprev, M, Cw)
+
+
 def wave_multi_step(
     U, Uprev, C2, dt, spacing, n_steps, chunk=None, interpret=None,
     warn_on_cap=True,
@@ -158,23 +197,13 @@ def wave_multi_step(
             "path"
         )
     chunk = resolve_step_chunk(n_steps, chunk, nbytes, warn_on_cap)
-    inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
     M = interior_mask(U.shape, U.dtype)
     Cw = (float(dt) * float(dt)) * C2 * M
-    kernel = functools.partial(
-        _wave_multi_step_kernel, inv_d2=inv_d2, chunk=chunk
-    )
-    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
-    run_chunk = pl.pallas_call(
-        kernel,
-        out_shape=(_out_struct(U.shape, U), _out_struct(U.shape, U)),
-        in_specs=[vmem, vmem, vmem, vmem],
-        out_specs=(vmem, vmem),
-        interpret=interpret,
-    )
     return lax.fori_loop(
         0,
         n_steps // chunk,
-        lambda _, s: run_chunk(s[0], s[1], M, Cw),
+        lambda _, s: wave_multi_step_masked(
+            s[0], s[1], M, Cw, spacing, chunk, interpret=interpret
+        ),
         (U, Uprev),
     )

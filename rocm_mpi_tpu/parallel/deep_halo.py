@@ -37,15 +37,10 @@ from rocm_mpi_tpu.parallel.halo import exchange_halo
 from rocm_mpi_tpu.parallel.mesh import GlobalGrid
 
 
-def padded_update_coefficient(Cp_padded, grid: GlobalGrid, width: int,
-                              lam, dt):
-    """Masked dt·λ/Cp for a width-`width` padded block (inside shard_map).
-
-    Zero where the cell must not update: global Dirichlet boundary cells,
-    and off-domain ghost cells (where the exchanged `Cp_padded` is itself
-    zero — guarded so the division cannot produce inf).
-    """
-    shape = Cp_padded.shape
+def padded_hold_mask(shape, grid: GlobalGrid, width: int):
+    """Boolean mask over a width-`width` padded block (inside shard_map):
+    True where the cell must NOT update — global Dirichlet boundary cells
+    and off-domain ghost cells, located by global index."""
     mask = None
     for ax, name in enumerate(grid.axis_names):
         ln = grid.local_shape[ax]
@@ -57,6 +52,18 @@ def padded_update_coefficient(Cp_padded, grid: GlobalGrid, width: int,
         )
         m = (gidx <= 0) | (gidx >= n_g - 1)
         mask = m if mask is None else (mask | m)
+    return mask
+
+
+def padded_update_coefficient(Cp_padded, grid: GlobalGrid, width: int,
+                              lam, dt):
+    """Masked dt·λ/Cp for a width-`width` padded block (inside shard_map).
+
+    Zero where the cell must not update: global Dirichlet boundary cells,
+    and off-domain ghost cells (where the exchanged `Cp_padded` is itself
+    zero — guarded so the division cannot produce inf).
+    """
+    mask = padded_hold_mask(Cp_padded.shape, grid, width)
     safe = jnp.where(Cp_padded == 0, jnp.ones_like(Cp_padded), Cp_padded)
     return jnp.where(mask, jnp.zeros_like(Cp_padded), (dt * lam) / safe)
 
@@ -134,5 +141,69 @@ def make_deep_sweep(grid: GlobalGrid, k: int, lam, dt, spacing):
             out_specs=grid.spec,
             check_vma=False,
         )(T, Cp)
+
+    return sweep
+
+
+def make_wave_deep_sweep(grid: GlobalGrid, k: int, dt, spacing):
+    """Deep-halo sweeps for the acoustic-wave workload: build
+    sweep(U, Uprev, C2) -> (U, Uprev) advanced k steps with ONE width-k
+    ghost exchange — the second workload on the flagship multi-chip
+    schedule (same light-cone argument as make_deep_sweep; the leapfrog
+    state pair is exchanged together and both outputs cropped).
+
+    Local compute: the VMEM-resident masked leapfrog kernel
+    (ops.wave_kernels.wave_multi_step_masked) when the padded block fits,
+    else an XLA-fused jnp fallback with identical semantics (the wave
+    workload is the layering demo — it has no HBM temporal-blocked rung).
+    """
+    if k < 1:
+        raise ValueError(f"sweep depth k must be >= 1, got {k}")
+    if any(k > ln for ln in grid.local_shape):
+        raise ValueError(
+            f"sweep depth {k} exceeds a local shard extent "
+            f"{grid.local_shape}; ghost slices need width <= shard"
+        )
+    from rocm_mpi_tpu.ops.pallas_kernels import _VMEM_BLOCK_BUDGET_BYTES
+    from rocm_mpi_tpu.ops.wave_kernels import wave_multi_step_masked
+
+    core = tuple(slice(k, -k) for _ in range(grid.ndim))
+    inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
+    dt2 = float(dt) * float(dt)
+
+    def jnp_k_steps(U, Uprev, M, Cw):
+        for _ in range(k):
+            lap = None
+            for ax in range(U.ndim):
+                term = (
+                    jnp.roll(U, -1, ax) + jnp.roll(U, 1, ax) - 2.0 * U
+                ) * inv_d2[ax]
+                lap = term if lap is None else lap + term
+            U, Uprev = U + M * (U - Uprev) + Cw * lap, U
+        return U, Uprev
+
+    def local_sweep(Ul, Upl, C2l):
+        Up_ = exchange_halo(Ul, grid, width=k)
+        Upp = exchange_halo(Upl, grid, width=k)
+        C2p = exchange_halo(C2l, grid, width=k)
+        hold = padded_hold_mask(Up_.shape, grid, k)
+        M = jnp.where(
+            hold, jnp.zeros_like(Up_), jnp.ones_like(Up_)
+        )
+        Cw = dt2 * C2p * M
+        if 2 * Up_.size * Up_.dtype.itemsize <= _VMEM_BLOCK_BUDGET_BYTES:
+            U2, Up2 = wave_multi_step_masked(Up_, Upp, M, Cw, spacing, k)
+        else:
+            U2, Up2 = jnp_k_steps(Up_, Upp, M, Cw)
+        return U2[core], Up2[core]
+
+    def sweep(U, Uprev, C2):
+        return shard_map(
+            local_sweep,
+            mesh=grid.mesh,
+            in_specs=(grid.spec,) * 3,
+            out_specs=(grid.spec, grid.spec),
+            check_vma=False,
+        )(U, Uprev, C2)
 
     return sweep

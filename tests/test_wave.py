@@ -156,6 +156,36 @@ def test_wave_run_vmem_resident():
     )
 
 
+def test_wave_deep_sweep_matches_ap_sharded():
+    # The deep-halo schedule, wave edition: 2 sweeps of k=4 on a 2x2 mesh
+    # must land on the same state pair as 8 per-step ap steps.
+    from rocm_mpi_tpu.parallel.deep_halo import make_wave_deep_sweep
+
+    cfg = _cfg(dims=(2, 2))
+    model = AcousticWave(cfg)
+    U, Uprev, C2 = model.init_state()
+    ref, ref_prev = model.advance_fn("ap")(
+        jnp.copy(U), jnp.copy(Uprev), C2, 8
+    )
+    sweep = jax.jit(
+        make_wave_deep_sweep(model.grid, 4, cfg.dt, cfg.spacing)
+    )
+    got, got_prev = sweep(*sweep(U, Uprev, C2), C2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-12)
+    np.testing.assert_allclose(
+        np.asarray(got_prev), np.asarray(ref_prev), rtol=1e-12
+    )
+
+
+def test_wave_run_deep_matches_per_step_run():
+    cfg = _cfg(dims=(2, 2), nt=48, warmup=16)
+    r = AcousticWave(cfg).run_deep(block_steps=8)
+    r_ref = AcousticWave(cfg).run(variant="ap")
+    np.testing.assert_allclose(
+        np.asarray(r.U), np.asarray(r_ref.U), rtol=1e-12
+    )
+
+
 def test_wave_run_reports_metrics():
     cfg = _cfg(nt=24, warmup=8)
     model = AcousticWave(cfg, devices=jax.devices()[:1])
