@@ -93,30 +93,34 @@ def wave_step_padded_pallas(Up, Uprev, C2, dt, spacing, interpret=None):
 # ---------------------------------------------------------------------------
 
 
+def masked_leapfrog_step(U, Uprev, M, Cw, inv_d2):
+    """One roll-based masked leapfrog step (plain jnp ops): the ONE
+    definition of the update used by the Pallas kernel body below and by
+    the deep-halo jnp fallback (parallel.deep_halo.make_wave_deep_sweep).
+    Roll wraparound only ever feeds edge cells, which M==0 / Cw==0 hold
+    bitwise fixed. Returns the advanced (U, U_prev) pair.
+    """
+    lap = None
+    for ax in range(U.ndim):
+        term = (
+            jnp.roll(U, -1, ax) + jnp.roll(U, 1, ax) - 2.0 * U
+        ) * inv_d2[ax]
+        lap = term if lap is None else lap + term
+    return U + M * (U - Uprev) + Cw * lap, U
+
+
 def _wave_multi_step_kernel(
     U_ref, Uprev_ref, M_ref, Cw_ref, oU_ref, oUprev_ref, *, inv_d2, chunk
 ):
-    """`chunk` leapfrog steps with the state pair VMEM-resident.
-
-    Neighbors come from `jnp.roll` with the same wraparound argument as the
-    diffusion kernel (_multi_step_kernel): wrapped values only ever feed
-    edge cells, which M==0 / Cw==0 hold bitwise fixed.
-    """
+    """`chunk` leapfrog steps with the state pair VMEM-resident."""
     M, Cw = M_ref[:], Cw_ref[:]
-    ndim = M.ndim
-
-    def body(_, s):
-        U, Uprev = s
-        lap = None
-        for ax in range(ndim):
-            term = (
-                jnp.roll(U, -1, ax) + jnp.roll(U, 1, ax) - 2.0 * U
-            ) * inv_d2[ax]
-            lap = term if lap is None else lap + term
-        return U + M * (U - Uprev) + Cw * lap, U
 
     U, Uprev = lax.fori_loop(
-        0, chunk, body, (U_ref[:], Uprev_ref[:]), unroll=True
+        0,
+        chunk,
+        lambda _, s: masked_leapfrog_step(s[0], s[1], M, Cw, inv_d2),
+        (U_ref[:], Uprev_ref[:]),
+        unroll=True,
     )
     oU_ref[:] = U
     oUprev_ref[:] = Uprev

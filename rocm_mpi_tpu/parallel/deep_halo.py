@@ -165,7 +165,10 @@ def make_wave_deep_sweep(grid: GlobalGrid, k: int, dt, spacing):
             f"{grid.local_shape}; ghost slices need width <= shard"
         )
     from rocm_mpi_tpu.ops.pallas_kernels import _VMEM_BLOCK_BUDGET_BYTES
-    from rocm_mpi_tpu.ops.wave_kernels import wave_multi_step_masked
+    from rocm_mpi_tpu.ops.wave_kernels import (
+        masked_leapfrog_step,
+        wave_multi_step_masked,
+    )
 
     core = tuple(slice(k, -k) for _ in range(grid.ndim))
     inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
@@ -173,13 +176,7 @@ def make_wave_deep_sweep(grid: GlobalGrid, k: int, dt, spacing):
 
     def jnp_k_steps(U, Uprev, M, Cw):
         for _ in range(k):
-            lap = None
-            for ax in range(U.ndim):
-                term = (
-                    jnp.roll(U, -1, ax) + jnp.roll(U, 1, ax) - 2.0 * U
-                ) * inv_d2[ax]
-                lap = term if lap is None else lap + term
-            U, Uprev = U + M * (U - Uprev) + Cw * lap, U
+            U, Uprev = masked_leapfrog_step(U, Uprev, M, Cw, inv_d2)
         return U, Uprev
 
     def local_sweep(Ul, Upl, C2l):

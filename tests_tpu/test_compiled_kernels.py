@@ -296,6 +296,45 @@ def test_wave_kernel_compiled():
     )
 
 
+def test_wave_vmem_multi_step_compiled():
+    # The whole-loop-in-VMEM leapfrog, compiled, vs the jnp per-step form.
+    from rocm_mpi_tpu.models.wave import wave_step_fused
+    from rocm_mpi_tpu.ops.wave_kernels import wave_multi_step
+
+    U0 = _rand((32, 32))
+    C2 = 1.0 + _rand((32, 32), seed=1)
+    dt, spacing = 2e-3, (0.1, 0.1)
+    ref, ref_prev = U0, jnp.copy(U0)
+    for _ in range(16):
+        ref, ref_prev = wave_step_fused(ref, ref_prev, C2, dt, spacing), ref
+    got, got_prev = wave_multi_step(
+        U0, jnp.copy(U0), C2, dt, spacing, 16, chunk=8
+    )
+    _close(got, ref)
+    _close(got_prev, ref_prev)
+
+
+def test_wave_deep_sweep_compiled():
+    # The wave deep-halo sweep's masked VMEM kernel on a 1-device mesh.
+    from rocm_mpi_tpu.models.wave import AcousticWave, WaveConfig
+    from rocm_mpi_tpu.parallel.deep_halo import make_wave_deep_sweep
+
+    cfg = WaveConfig(
+        global_shape=(64, 64), lengths=(10.0, 10.0), nt=8, warmup=0,
+        dtype="f32", dims=(1, 1),
+    )
+    model = AcousticWave(cfg, devices=jax.devices()[:1])
+    U, Uprev, C2 = model.init_state()
+    ref, _ = model.advance_fn("ap")(jnp.copy(U), jnp.copy(Uprev), C2, 8)
+    sweep = jax.jit(
+        make_wave_deep_sweep(
+            model.grid, 4, cfg.jax_dtype(cfg.dt), cfg.spacing
+        )
+    )
+    got, _ = sweep(*sweep(U, Uprev, C2), C2)
+    _close(got, ref)
+
+
 def test_model_runners_compiled():
     # The model-level fast paths end-to-end on the chip at tiny sizes.
     cfg = DiffusionConfig(
