@@ -26,6 +26,16 @@ def make_parser():
     p.add_argument("--dims", default=None, help="process grid, e.g. 2,2")
     p.add_argument("--cpu-devices", type=int, default=0, metavar="N")
     p.add_argument("--variant", default="perf", choices=["ap", "perf"])
+    sched = p.add_mutually_exclusive_group()
+    sched.add_argument(
+        "--deep", type=int, default=0, metavar="K",
+        help="deep-halo sweeps: exchange the width-K state-pair ghosts "
+        "once per K steps instead of width-1 every step",
+    )
+    sched.add_argument(
+        "--vmem", action="store_true",
+        help="whole-loop-in-VMEM fast path (single device only)",
+    )
     p.add_argument("--vis", action="store_true")
     return p
 
@@ -54,8 +64,36 @@ def main(argv=None) -> int:
         f"Process {grid.me} grid {grid.global_shape} over mesh {grid.dims} "
         f"({grid.nprocs} device(s): {jax.devices()[0].device_kind} …)"
     )
+    # Label the schedule that actually runs (the _common.py convention:
+    # artifacts must identify their schedule, --variant is ignored by the
+    # schedule overrides).
+    if args.deep:
+        from rocm_mpi_tpu.models.diffusion import effective_block_steps
+
+        k_eff = effective_block_steps(
+            cfg.nt, cfg.warmup, min(args.deep, min(grid.local_shape)),
+            warn=False,
+        )
+        label = f"deep{k_eff}"
+        log0(f"--deep: running deep-halo sweeps (k={k_eff}) instead of "
+             "the per-step variant")
+    elif args.vmem:
+        if grid.nprocs != 1:
+            log0("--vmem requires a single-device grid (the whole-loop-in-"
+                 f"VMEM path is unsharded); mesh is {grid.dims}")
+            return 2
+        label = "vmem"
+        log0("--vmem: running the whole-loop-in-VMEM fast path instead of "
+             "the per-step variant")
+    else:
+        label = args.variant
     log0("Starting the time loop 🚀...", end="")
-    result = model.run(variant=args.variant)
+    if args.deep:
+        result = model.run_deep(block_steps=args.deep)
+    elif args.vmem:
+        result = model.run_vmem_resident()
+    else:
+        result = model.run(variant=args.variant)
     log0("done")
     log0(
         f"Executed {result.nt} steps in = {result.wtime:.3e} sec "
@@ -66,11 +104,11 @@ def main(argv=None) -> int:
         U_v = gather_to_host0(result.U)
         if U_v is not None:
             path = OUTPUT_DIR / viz.artifact_name(
-                f"wave_{args.variant}", grid.nprocs, grid.global_shape
+                f"wave_{label}", grid.nprocs, grid.global_shape
             )
             viz.save_heatmap(
                 U_v, path,
-                title=f"wave {args.variant} nt={result.nt} mesh={grid.dims}",
+                title=f"wave {label} nt={result.nt} mesh={grid.dims}",
             )
             log0(f"wrote {path}")
     else:

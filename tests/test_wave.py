@@ -212,3 +212,23 @@ def test_wave_app_runs():
          "--dims", "2,2", "--variant", "perf"]
     )
     assert rc == 0
+    rc = app.main(
+        ["--nx", "24", "--ny", "20", "--nt", "12", "--warmup", "4",
+         "--dims", "2,2", "--deep", "4"]
+    )
+    assert rc == 0
+    rc = app.main(
+        ["--nx", "24", "--ny", "20", "--nt", "12", "--warmup", "4",
+         "--dims", "1,1", "--vmem"]
+    )
+    assert rc == 0
+    # argparse rejects the combination before any backend work
+    with pytest.raises(SystemExit) as exc:
+        app.main(["--deep", "4", "--vmem"])
+    assert exc.value.code == 2
+    # --vmem on a sharded mesh: clean diagnostic, not a traceback
+    rc = app.main(
+        ["--nx", "24", "--ny", "20", "--nt", "12", "--warmup", "4",
+         "--dims", "2,2", "--vmem"]
+    )
+    assert rc == 2
