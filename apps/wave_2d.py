@@ -17,9 +17,26 @@ from _common import OUTPUT_DIR, setup_jax  # noqa: E402
 def make_parser():
     import argparse
 
-    p = argparse.ArgumentParser(description="2D acoustic wave — leapfrog")
+    def positive_int(v):
+        i = int(v)
+        if i < 1:
+            raise argparse.ArgumentTypeError(f"must be >= 1, got {v}")
+        return i
+
+    def nonneg_int(v):
+        i = int(v)
+        if i < 0:
+            raise argparse.ArgumentTypeError(f"must be >= 0, got {v}")
+        return i
+
+    p = argparse.ArgumentParser(description="2D/3D acoustic wave — leapfrog")
     p.add_argument("--nx", type=int, default=252)
     p.add_argument("--ny", type=int, default=252)
+    p.add_argument(
+        "--nz", type=nonneg_int, default=0,
+        help="z grid points (0 or 1 = 2D, matching init_global_grid's "
+        "squeeze of trailing size-1 axes)",
+    )
     p.add_argument("--nt", type=int, default=1000)
     p.add_argument("--warmup", type=int, default=10)
     p.add_argument("--dtype", default="f64", choices=["f32", "f64", "bf16"])
@@ -28,7 +45,7 @@ def make_parser():
     p.add_argument("--variant", default="perf", choices=["ap", "perf"])
     sched = p.add_mutually_exclusive_group()
     sched.add_argument(
-        "--deep", type=int, default=0, metavar="K",
+        "--deep", type=positive_int, default=0, metavar="K",
         help="deep-halo sweeps: exchange the width-K state-pair ghosts "
         "once per K steps instead of width-1 every step",
     )
@@ -50,9 +67,10 @@ def main(argv=None) -> int:
     from rocm_mpi_tpu.utils.logging import log0
 
     dims = tuple(int(d) for d in args.dims.split(",")) if args.dims else None
+    shape = (args.nx, args.ny) + ((args.nz,) if args.nz > 1 else ())
     cfg = WaveConfig(
-        global_shape=(args.nx, args.ny),
-        lengths=(10.0, 10.0),
+        global_shape=shape,
+        lengths=(10.0,) * len(shape),
         nt=args.nt,
         warmup=args.warmup,
         dtype=args.dtype,
@@ -64,9 +82,11 @@ def main(argv=None) -> int:
         f"Process {grid.me} grid {grid.global_shape} over mesh {grid.dims} "
         f"({grid.nprocs} device(s): {jax.devices()[0].device_kind} …)"
     )
-    # Label the schedule that actually runs (the _common.py convention:
-    # artifacts must identify their schedule, --variant is ignored by the
-    # schedule overrides).
+    # One chain decides label AND runner together (the _common.py
+    # convention: artifacts must identify the schedule that actually ran;
+    # --variant is ignored by the schedule overrides). For --deep, the
+    # effective depth is computed once here and passed explicitly, so the
+    # label cannot drift from the k run_deep executes.
     if args.deep:
         from rocm_mpi_tpu.models.diffusion import effective_block_steps
 
@@ -77,6 +97,7 @@ def main(argv=None) -> int:
         label = f"deep{k_eff}"
         log0(f"--deep: running deep-halo sweeps (k={k_eff}) instead of "
              "the per-step variant")
+        runner = lambda: model.run_deep(block_steps=k_eff)
     elif args.vmem:
         if grid.nprocs != 1:
             log0("--vmem requires a single-device grid (the whole-loop-in-"
@@ -85,21 +106,21 @@ def main(argv=None) -> int:
         label = "vmem"
         log0("--vmem: running the whole-loop-in-VMEM fast path instead of "
              "the per-step variant")
+        runner = model.run_vmem_resident
     else:
         label = args.variant
+        runner = lambda: model.run(variant=args.variant)
     log0("Starting the time loop 🚀...", end="")
-    if args.deep:
-        result = model.run_deep(block_steps=args.deep)
-    elif args.vmem:
-        result = model.run_vmem_resident()
-    else:
-        result = model.run(variant=args.variant)
+    result = runner()
     log0("done")
     log0(
         f"Executed {result.nt} steps in = {result.wtime:.3e} sec "
         f"(@ T_eff = {result.t_eff:.2f} GB/s aggregate, "
         f"{result.gpts:.4f} Gpts/s)"
     )
+    if args.vis and len(shape) != 2:
+        log0("--vis is 2D-only (heatmap); skipping the artifact")
+        args.vis = False
     if args.vis:
         U_v = gather_to_host0(result.U)
         if U_v is not None:

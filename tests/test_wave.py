@@ -222,6 +222,11 @@ def test_wave_app_runs():
          "--dims", "1,1", "--vmem"]
     )
     assert rc == 0
+    rc = app.main(
+        ["--nx", "12", "--ny", "10", "--nz", "8", "--nt", "12",
+         "--warmup", "4", "--dims", "2,2,2"]
+    )
+    assert rc == 0
     # argparse rejects the combination before any backend work
     with pytest.raises(SystemExit) as exc:
         app.main(["--deep", "4", "--vmem"])
