@@ -152,12 +152,10 @@ def run_app(variant: str, args) -> int:
         # The deep-halo schedule replaces the variant's own step entirely
         # (variant-specific knobs like --b-width are unused); label the
         # run and its artifacts with the depth that will actually execute
-        # (run_deep degrades k when the step counts aren't divisible).
-        from rocm_mpi_tpu.models.diffusion import effective_block_steps
-
-        k_eff = effective_block_steps(
-            cfg.nt, cfg.warmup, args.deep, warn=False
-        )
+        # — the model's own accounting, so label and executed k cannot
+        # drift (run_deep degrades k when the step counts aren't
+        # divisible).
+        k_eff = model.effective_deep_depth(block_steps=args.deep, warn=False)
         variant = f"deep{k_eff}"
         log0(f"--deep: running deep-halo sweeps (k={k_eff}"
              + (f", degraded from {args.deep}" if k_eff != args.deep else "")

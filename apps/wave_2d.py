@@ -88,11 +88,8 @@ def main(argv=None) -> int:
     # effective depth is computed once here and passed explicitly, so the
     # label cannot drift from the k run_deep executes.
     if args.deep:
-        from rocm_mpi_tpu.models.diffusion import effective_block_steps
-
-        k_eff = effective_block_steps(
-            cfg.nt, cfg.warmup, min(args.deep, min(grid.local_shape)),
-            warn=False,
+        k_eff = model.effective_deep_depth(
+            block_steps=args.deep, warn=False
         )
         label = f"deep{k_eff}"
         log0(f"--deep: running deep-halo sweeps (k={k_eff}) instead of "

@@ -532,6 +532,37 @@ class HeatDiffusion:
             nt, warmup, fused_multi_step_hbm, k, "block_steps"
         )
 
+    def effective_deep_depth(
+        self,
+        nt: int | None = None,
+        warmup: int | None = None,
+        block_steps: int | None = None,
+        warn: bool = True,
+    ) -> int:
+        """The sweep depth run_deep will actually execute for these
+        arguments — THE source of truth for callers labeling artifacts by
+        depth (apps/_common.py), so label and executed k cannot drift.
+        Policy: defaults route through default_deep_depth (VMEM-aware,
+        shard-clamped); explicit depths keep make_deep_sweep's strict
+        shard-extent validation; either is then gcd'd against both timing
+        windows.
+        """
+        cfg = self.config
+        if block_steps is None:
+            k = default_deep_depth(
+                self.grid.local_shape, jnp.dtype(cfg.jax_dtype).itemsize
+            )
+        else:
+            k = block_steps
+        return effective_block_steps(
+            cfg.nt if nt is None else nt,
+            cfg.warmup if warmup is None else warmup,
+            k,
+            label="deep-halo sweep depth",
+            warn=warn,
+            stacklevel=3,
+        )
+
     def run_deep(
         self,
         nt: int | None = None,
@@ -558,17 +589,7 @@ class HeatDiffusion:
             raise ValueError(f"need 0 <= warmup < nt, got {warmup}, {nt}")
         if cfg.halo_transport == "host":
             warn_host_transport_ignored("deep", stacklevel=2)
-        if block_steps is None:
-            # Default depth, clamped so small shards keep working (explicit
-            # depths keep make_deep_sweep's strict shard-extent validation).
-            k = default_deep_depth(
-                self.grid.local_shape, jnp.dtype(cfg.jax_dtype).itemsize
-            )
-        else:
-            k = block_steps
-        k = effective_block_steps(
-            nt, warmup, k, label="deep-halo sweep depth", stacklevel=2
-        )
+        k = self.effective_deep_depth(nt, warmup, block_steps)
         dt = cfg.jax_dtype(cfg.dt)
         sweep = make_deep_sweep(self.grid, k, cfg.lam, dt, cfg.spacing)
 

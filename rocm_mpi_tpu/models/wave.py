@@ -260,6 +260,31 @@ class AcousticWave:
 
         return self._run_timed(advance, nt, warmup)
 
+    def effective_deep_depth(
+        self,
+        nt: int | None = None,
+        warmup: int | None = None,
+        block_steps: int = 8,
+        warn: bool = True,
+    ) -> int:
+        """The sweep depth run_deep will actually execute for these
+        arguments — THE source of truth for callers labeling artifacts by
+        depth (apps/wave_2d.py), so label and executed k cannot drift.
+        Policy: clamp to the smallest shard extent (ghost slices need
+        width <= shard), then gcd against both timing windows.
+        """
+        from rocm_mpi_tpu.models.diffusion import effective_block_steps
+
+        cfg = self.config
+        return effective_block_steps(
+            cfg.nt if nt is None else nt,
+            cfg.warmup if warmup is None else warmup,
+            min(block_steps, min(self.grid.local_shape)),
+            label="wave deep-halo sweep depth",
+            warn=warn,
+            stacklevel=3,
+        )
+
     def run_deep(
         self,
         nt: int | None = None,
@@ -271,19 +296,10 @@ class AcousticWave:
         (parallel.deep_halo.make_wave_deep_sweep), the second workload on
         the flagship multi-chip schedule (HeatDiffusion.run_deep).
         """
-        from rocm_mpi_tpu.models.diffusion import effective_block_steps
         from rocm_mpi_tpu.parallel.deep_halo import make_wave_deep_sweep
 
         cfg = self.config
-        k = effective_block_steps(
-            cfg.nt if nt is None else nt,
-            cfg.warmup if warmup is None else warmup,
-            # Clamp to the smallest shard extent (ghost slices need
-            # width <= shard), as diffusion's default_deep_depth does.
-            min(block_steps, min(self.grid.local_shape)),
-            label="wave deep-halo sweep depth",
-            stacklevel=2,
-        )
+        k = self.effective_deep_depth(nt, warmup, block_steps)
         dt = cfg.jax_dtype(cfg.dt)
         sweep = make_wave_deep_sweep(self.grid, k, dt, cfg.spacing)
 
