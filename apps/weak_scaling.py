@@ -33,11 +33,16 @@ def main(argv=None) -> int:
                    help="per-device shard edge (target geometry: 252)")
     p.add_argument("--nt", type=int, default=2000)
     p.add_argument("--warmup", type=int, default=200)
-    p.add_argument("--variant", default="hide",
+    p.add_argument("--variant", default=None,
                    choices=["ap", "fused", "shard", "perf", "kp", "hide",
                             "deep"],
                    help="step schedule; 'deep' = deep-halo sweeps "
-                   "(run_deep, the flagship multi-chip schedule)")
+                   "(run_deep, the flagship multi-chip schedule). "
+                   "Default: hide (diffusion) / perf (wave)")
+    p.add_argument("--workload", default="diffusion",
+                   choices=["diffusion", "wave"],
+                   help="physics model: the diffusion flagship or the "
+                   "acoustic-wave second workload (variants ap/perf/deep)")
     p.add_argument("--deep-k", type=int, default=None, metavar="K",
                    help="deep-halo sweep depth (default: run_deep's auto)")
     p.add_argument("--dtype", default="f32")
@@ -54,8 +59,15 @@ def main(argv=None) -> int:
     jax = setup_jax(args)  # distributed init + --cpu-devices + x64, shared
     from rocm_mpi_tpu.config import DiffusionConfig
     from rocm_mpi_tpu.utils.logging import log0
-    from rocm_mpi_tpu.models import HeatDiffusion
+    from rocm_mpi_tpu.models import AcousticWave, HeatDiffusion, WaveConfig
     from rocm_mpi_tpu.parallel.mesh import suggest_dims
+
+    if args.variant is None:
+        args.variant = "hide" if args.workload == "diffusion" else "perf"
+    if args.workload == "wave" and args.variant not in ("ap", "perf", "deep"):
+        log0(f"--workload wave supports variants ap/perf/deep, "
+             f"not {args.variant!r}")
+        return 2
 
     n_avail = len(jax.devices())
     if args.counts:
@@ -80,7 +92,7 @@ def main(argv=None) -> int:
             continue
         dims = suggest_dims(n, 2)
         shape = (args.local * dims[0], args.local * dims[1])
-        cfg = DiffusionConfig(
+        common = dict(
             global_shape=shape,
             lengths=(10.0 * dims[0], 10.0 * dims[1]),
             nt=args.nt,
@@ -88,8 +100,15 @@ def main(argv=None) -> int:
             dtype=args.dtype,
             dims=dims,
         )
-        model = HeatDiffusion(cfg, devices=jax.devices()[:n])
+        model_cls, cfg_cls = (
+            (AcousticWave, WaveConfig)
+            if args.workload == "wave"
+            else (HeatDiffusion, DiffusionConfig)
+        )
+        model = model_cls(cfg_cls(**common), devices=jax.devices()[:n])
         if args.variant == "deep":
+            # Both models default None to their own depth policy and
+            # reject explicit invalid depths loudly.
             r = model.run_deep(block_steps=args.deep_k)
         else:
             r = model.run(variant=args.variant)
@@ -106,8 +125,10 @@ def main(argv=None) -> int:
             f"({per_dev:7.4f}/dev)  efficiency={eff:6.1%} vs n={base_n}"
         )
         if args.json and jax.process_index() == 0:
+            wl = "" if args.workload == "diffusion" else f"{args.workload} "
             print(json.dumps({
-                "metric": f"weak-scaling {args.variant} {args.local}²/dev",
+                "metric": f"weak-scaling {wl}{args.variant} "
+                          f"{args.local}²/dev",
                 "devices": n, "dims": dims, "gpts": round(r.gpts, 4),
                 "gpts_per_device": round(per_dev, 4),
                 "efficiency": round(eff, 4),

@@ -260,22 +260,27 @@ class AcousticWave:
 
         return self._run_timed(advance, nt, warmup)
 
+    DEFAULT_DEEP_STEPS = 8
+
     def effective_deep_depth(
         self,
         nt: int | None = None,
         warmup: int | None = None,
-        block_steps: int = 8,
+        block_steps: int | None = None,
         warn: bool = True,
     ) -> int:
         """The sweep depth run_deep will actually execute for these
         arguments — THE source of truth for callers labeling artifacts by
         depth (apps/wave_2d.py), so label and executed k cannot drift.
-        Policy: clamp to the smallest shard extent (ghost slices need
-        width <= shard), then gcd against both timing windows.
+        Policy: None defaults to DEFAULT_DEEP_STEPS; clamp to the smallest
+        shard extent (ghost slices need width <= shard), then gcd against
+        both timing windows. Explicit depths < 1 raise, as diffusion's do.
         """
         from rocm_mpi_tpu.models.diffusion import effective_block_steps
 
         cfg = self.config
+        if block_steps is None:
+            block_steps = self.DEFAULT_DEEP_STEPS
         return effective_block_steps(
             cfg.nt if nt is None else nt,
             cfg.warmup if warmup is None else warmup,
@@ -289,7 +294,7 @@ class AcousticWave:
         self,
         nt: int | None = None,
         warmup: int | None = None,
-        block_steps: int = 8,
+        block_steps: int | None = None,
     ) -> WaveRunResult:
         """Sharded fast path: deep-halo sweeps for the wave — one width-k
         ghost exchange of the leapfrog state pair per k steps

@@ -93,6 +93,17 @@ def test_weak_scaling_app():
     assert '"devices": 4' in out
 
 
+def test_weak_scaling_app_wave_workload():
+    out = run_app(
+        "weak_scaling.py",
+        "--cpu-devices", "4", "--local", "16", "--nt", "16", "--warmup", "4",
+        "--workload", "wave", "--variant", "deep", "--deep-k", "4", "--json",
+    )
+    assert "efficiency=100.0%" in out
+    assert '"metric": "weak-scaling wave deep' in out
+    assert '"devices": 4' in out
+
+
 def test_prof_app_writes_report(tmp_path):
     report = tmp_path / "prof.txt"
     trace = tmp_path / "trace"

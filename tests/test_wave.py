@@ -186,49 +186,6 @@ def test_wave_run_deep_matches_per_step_run():
     )
 
 
-try:
-    from hypothesis import given, settings, strategies as st
-
-    _HAVE_HYPOTHESIS = True
-except ImportError:  # pragma: no cover
-    _HAVE_HYPOTHESIS = False
-
-
-if _HAVE_HYPOTHESIS:
-
-    @st.composite
-    def _wave_cases(draw):
-        ndim = draw(st.integers(2, 3))
-        dims, shape = [], []
-        budget = 8  # device budget (conftest provides 8)
-        for _ in range(ndim):
-            d = draw(st.sampled_from([1, 2, 4]))
-            while d > 1 and d * int(np.prod(dims or [1])) > budget:
-                d //= 2
-            local = draw(st.integers(3, 6))
-            dims.append(d)
-            shape.append(d * local)
-        n_steps = draw(st.integers(1, 12))
-        return tuple(shape), tuple(dims), n_steps
-
-    @given(_wave_cases())
-    @settings(max_examples=20, deadline=None)
-    def test_wave_perf_matches_oracle_property(case):
-        # The sharded (shard_map + halo + Pallas) wave path vs the numpy
-        # oracle across the shape/dims/steps space — the machine-checked
-        # form of the hand-picked equivalence cases above (the same
-        # §5.2-analog strategy as tests/test_halo_properties.py).
-        shape, dims, n_steps = case
-        cfg = _cfg(shape=shape, dims=dims, nt=max(n_steps, 2) + 1,
-                   warmup=0)
-        model = AcousticWave(cfg)
-        U, Uprev, C2 = model.init_state()
-        ref = _numpy_leapfrog(U, Uprev, C2, cfg.dt, cfg.spacing, n_steps)
-        got, _ = model.advance_fn("perf")(U, Uprev, C2, n_steps)
-        np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-11,
-                                   atol=1e-13)
-
-
 def test_wave_run_reports_metrics():
     cfg = _cfg(nt=24, warmup=8)
     model = AcousticWave(cfg, devices=jax.devices()[:1])

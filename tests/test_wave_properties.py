@@ -1,0 +1,49 @@
+"""Property-based test of the wave workload's sharded path (hypothesis):
+for arbitrary shapes, mesh dims, and step counts, the shard_map + halo +
+Pallas 'perf' path must reproduce the transparent numpy leapfrog oracle —
+the machine-checked generalization of test_wave.py's hand-picked cases
+(the same §5.2-analog strategy as tests/test_halo_properties.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="property tests need the hypothesis package"
+)
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from rocm_mpi_tpu.models.wave import AcousticWave  # noqa: E402
+
+# Sibling test module (tests/ has no __init__; pytest's default
+# prepend-import puts this directory on sys.path during collection).
+from test_wave import _cfg, _numpy_leapfrog  # noqa: E402
+
+
+@st.composite
+def wave_cases(draw):
+    ndim = draw(st.integers(2, 3))
+    dims, shape = [], []
+    budget = 8  # device budget (conftest provides 8)
+    for _ in range(ndim):
+        d = draw(st.sampled_from([1, 2, 4]))
+        while d > 1 and d * int(np.prod(dims or [1])) > budget:
+            d //= 2
+        local = draw(st.integers(3, 6))
+        dims.append(d)
+        shape.append(d * local)
+    n_steps = draw(st.integers(1, 12))
+    return tuple(shape), tuple(dims), n_steps
+
+
+@given(wave_cases())
+@settings(max_examples=20, deadline=None)
+def test_wave_perf_matches_oracle_property(case):
+    shape, dims, n_steps = case
+    cfg = _cfg(shape=shape, dims=dims, nt=max(n_steps, 2) + 1, warmup=0)
+    model = AcousticWave(cfg)
+    U, Uprev, C2 = model.init_state()
+    ref = _numpy_leapfrog(U, Uprev, C2, cfg.dt, cfg.spacing, n_steps)
+    got, _ = model.advance_fn("perf")(U, Uprev, C2, n_steps)
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-11, atol=1e-13)
