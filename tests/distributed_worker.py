@@ -14,7 +14,9 @@ Exercises every multi-host branch VERDICT r1 flagged as dead code:
 distributed.maybe_initialize_distributed, gather.gather_to_host0's
 process_count>1 path, and metrics.force's non-addressable branch — plus
 the deep-halo sweep (width-k exchange crossing the process boundary, the
-flagship multi-chip schedule) against the same oracle.
+flagship multi-chip schedule) against the same oracle, and the wave
+workload's perf path and deep sweep (the state-pair exchange crossing the
+same boundary) against the numpy leapfrog oracle.
 """
 
 import os
@@ -82,6 +84,32 @@ def main() -> int:
     metrics.force(T_deep)
     full_deep = gather_to_host0(T_deep)
 
+    # Second workload across the same process boundary: the wave model's
+    # perf path (state-pair halo exchange) and its deep sweep.
+    import jax.numpy as jnp
+
+    from rocm_mpi_tpu.models import AcousticWave, WaveConfig
+    from rocm_mpi_tpu.parallel.deep_halo import make_wave_deep_sweep
+
+    wcfg = WaveConfig(
+        global_shape=cfg.global_shape, lengths=cfg.lengths, nt=n_steps,
+        warmup=0, dtype="f64", dims=cfg.dims,
+    )
+    wave = AcousticWave(wcfg, devices=jax.devices())
+    U, Uprev, C2 = wave.init_state()
+    U0_full = gather_to_host0(U)  # collective: both processes participate
+    Uw, _ = wave.advance_fn("perf")(jnp.copy(U), jnp.copy(Uprev), C2, n_steps)
+    metrics.force(Uw)
+    wsweep = jax.jit(
+        make_wave_deep_sweep(
+            wave.grid, n_steps, wcfg.jax_dtype(wcfg.dt), wcfg.spacing
+        )
+    )
+    Uw_deep, _ = wsweep(U, Uprev, C2)
+    metrics.force(Uw_deep)
+    full_wave = gather_to_host0(Uw)
+    full_wave_deep = gather_to_host0(Uw_deep)
+
     full = gather_to_host0(T)  # process_allgather branch
     if jax.process_index() == 0:
         assert full is not None and full.shape == cfg.global_shape
@@ -109,10 +137,26 @@ def main() -> int:
         )
         np.testing.assert_allclose(full, want, rtol=1e-12, atol=1e-13)
         np.testing.assert_allclose(full_deep, want, rtol=1e-12, atol=1e-13)
+
+        # Wave oracle: the numpy leapfrog from the gathered initial state
+        # (zero initial velocity, uniform c² = c0² = 1).
+        from test_wave import _numpy_leapfrog
+
+        want_wave = _numpy_leapfrog(
+            U0_full, U0_full, np.full(wcfg.global_shape, wcfg.c0**2),
+            wcfg.dt, wcfg.spacing, n_steps,
+        )
+        np.testing.assert_allclose(
+            full_wave, want_wave, rtol=1e-12, atol=1e-13
+        )
+        np.testing.assert_allclose(
+            full_wave_deep, want_wave, rtol=1e-12, atol=1e-13
+        )
         print("DISTRIBUTED_OK", flush=True)
     else:
         assert full is None
         assert full_deep is None
+        assert full_wave is None and full_wave_deep is None
     jax.distributed.shutdown()
     return 0
 
