@@ -5,7 +5,9 @@ of the reference ROCm-aware-MPI diffusion suite (williamfgc/ROCm-MPI):
 cartesian domain decomposition over a device mesh, halo exchange via XLA
 collectives riding the ICI, Pallas stencil kernels, and a
 communication/computation-overlap step — demonstrated on 2D/3D transient heat
-diffusion at four escalating performance levels.
+diffusion at four escalating performance levels, plus a second workload
+(models.wave: leapfrog acoustic wave) proving the layers are
+workload-agnostic.
 
 Layer map (TPU-native analog of reference SURVEY.md §1):
   L1 launch/env     -> scripts/run.sh + jax.distributed      (ref: runme.sh/setenv.sh)
