@@ -445,7 +445,8 @@ class HeatDiffusion:
         return RunResult(T=T, wtime=wtime, nt=nt, warmup=warmup, config=cfg)
 
     def _run_single_shard(
-        self, nt, warmup, multi_step_fn, granularity: int, granularity_kw: str
+        self, nt, warmup, multi_step_fn, granularity: int, granularity_kw: str,
+        explicit: bool = False,
     ) -> RunResult:
         """Shared scaffold of the single-shard fast paths: validate, pick a
         step granularity dividing both the warmup and timed windows (so one
@@ -454,6 +455,9 @@ class HeatDiffusion:
 
         `multi_step_fn(T, Cp, lam, dt, spacing, n, <granularity_kw>=g)` is
         one of ops.pallas_kernels.fused_multi_step / fused_multi_step_hbm.
+        `explicit` marks a caller-requested granularity: degradation (gcd
+        against the windows, or the large-field chunk cap) then warns
+        instead of staying silent.
         """
         cfg = self.config
         nt = cfg.nt if nt is None else nt
@@ -463,16 +467,16 @@ class HeatDiffusion:
         if self.grid.nprocs != 1:
             raise ValueError("single-shard fast paths require an unsharded grid")
         key = granularity_kw
-        gran = effective_block_steps(nt, warmup, granularity, warn=False)
+        gran = effective_block_steps(
+            nt, warmup, granularity, warn=explicit, label=key, stacklevel=4
+        )
 
         T, Cp = self.init_state()
         dt = cfg.jax_dtype(cfg.dt)
 
-        # The granularity here is framework-plumbed (not caller-requested),
-        # so an internal cap on it should stay silent.
         kw = {key: gran}
         if key == "chunk":
-            kw["warn_on_cap"] = False
+            kw["warn_on_cap"] = explicit
 
         @functools.partial(jax.jit, donate_argnums=0)
         def advance(T, Cp, n):
@@ -486,13 +490,21 @@ class HeatDiffusion:
         return RunResult(T=T, wtime=wtime, nt=nt, warmup=warmup, config=cfg)
 
     def run_vmem_resident(
-        self, nt: int | None = None, warmup: int | None = None
+        self,
+        nt: int | None = None,
+        warmup: int | None = None,
+        chunk: int | None = None,
     ) -> RunResult:
         """Single-shard fast path: the whole nt-step loop inside one Pallas
         kernel, field VMEM-resident (ops.pallas_kernels.fused_multi_step).
 
         TPU-only optimization with no reference analog; only valid when the
         grid is unsharded (nprocs == 1) and fits the VMEM budget.
+
+        `chunk` overrides the per-kernel step count (default
+        DEFAULT_STEP_CHUNK): Mosaic compile time scales with the unroll, so
+        a small chunk (e.g. 16) compiles in seconds where 256 takes tens —
+        bench.py's floor measurement depends on this knob.
         """
         from rocm_mpi_tpu.ops.pallas_kernels import (
             DEFAULT_STEP_CHUNK,
@@ -500,7 +512,12 @@ class HeatDiffusion:
         )
 
         return self._run_single_shard(
-            nt, warmup, fused_multi_step, DEFAULT_STEP_CHUNK, "chunk"
+            nt,
+            warmup,
+            fused_multi_step,
+            DEFAULT_STEP_CHUNK if chunk is None else chunk,
+            "chunk",
+            explicit=chunk is not None,
         )
 
     def run_hbm_blocked(

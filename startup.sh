@@ -35,6 +35,20 @@ fi
 echo "== native host-staging engine =="
 bash scripts/build_native.sh
 
+# Prime the persistent XLA compilation cache (.jax_cache/) with the bench
+# programs so a driver `bench.py` run skips the multi-ten-second Mosaic
+# compiles (VERDICT r3 #1: one cold compile burned the whole bench budget).
+# Bounded + non-fatal: a stalled chip tunnel must not wedge bootstrap.
+echo "== bench compilation cache =="
+rc=0; timeout -k 5 240 python bench.py --prime-cache || rc=$?
+if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+  echo "  cache priming timed out after 240s (chip tunnel down or slow);" \
+       "bench.py still works — its floor measurement self-primes the cache"
+elif [ "$rc" -ne 0 ]; then
+  echo "  cache priming CRASHED (rc=$rc) — investigate above before" \
+       "benching; bench.py itself still shields failures"
+fi
+
 if [ "${1:-}" != "--no-test" ]; then
   echo "== capability smoke test (ring exchange on 8 virtual devices) =="
   python apps/ici_ring_test.py --cpu-devices 8
