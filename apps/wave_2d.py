@@ -42,7 +42,9 @@ def make_parser():
     p.add_argument("--dtype", default="f64", choices=["f32", "f64", "bf16"])
     p.add_argument("--dims", default=None, help="process grid, e.g. 2,2")
     p.add_argument("--cpu-devices", type=int, default=0, metavar="N")
-    p.add_argument("--variant", default="perf", choices=["ap", "perf"])
+    p.add_argument(
+        "--variant", default="perf", choices=["ap", "perf", "hide"]
+    )
     sched = p.add_mutually_exclusive_group()
     sched.add_argument(
         "--deep", type=positive_int, default=0, metavar="K",

@@ -38,11 +38,12 @@ def main(argv=None) -> int:
                             "deep"],
                    help="step schedule; 'deep' = deep-halo sweeps "
                    "(run_deep, the flagship multi-chip schedule). "
-                   "Default: hide (diffusion) / perf (wave)")
+                   "Default: hide (both workloads)")
     p.add_argument("--workload", default="diffusion",
                    choices=["diffusion", "wave"],
                    help="physics model: the diffusion flagship or the "
-                   "acoustic-wave second workload (variants ap/perf/deep)")
+                   "acoustic-wave second workload (variants "
+                   "ap/perf/hide/deep)")
     p.add_argument("--deep-k", type=int, default=None, metavar="K",
                    help="deep-halo sweep depth (default: run_deep's auto)")
     p.add_argument("--dtype", default="f32")
@@ -63,9 +64,11 @@ def main(argv=None) -> int:
     from rocm_mpi_tpu.parallel.mesh import suggest_dims
 
     if args.variant is None:
-        args.variant = "hide" if args.workload == "diffusion" else "perf"
-    if args.workload == "wave" and args.variant not in ("ap", "perf", "deep"):
-        log0(f"--workload wave supports variants ap/perf/deep, "
+        args.variant = "hide"
+    if args.workload == "wave" and args.variant not in (
+        "ap", "perf", "hide", "deep"
+    ):
+        log0(f"--workload wave supports variants ap/perf/hide/deep, "
              f"not {args.variant!r}")
         return 2
 

@@ -23,6 +23,11 @@ Variants mirror the flagship's ladder where it transfers:
   "ap"   — global-array jnp ops; GSPMD partitions and inserts comms.
   "perf" — shard_map + exchange_halo + whole-block Pallas kernel
            (ops.wave_kernels), explicit Dirichlet mask.
+  "hide" — perf's kernel on the boundary-slab/interior overlap
+           decomposition (parallel.overlap): the U exchange is dataflow-
+           independent of the interior update, so XLA may hide it — the
+           second workload on the reference's intended variant (3)
+           schedule (hide.jl:94-101).
 """
 
 from __future__ import annotations
@@ -56,6 +61,10 @@ class WaveConfig:
     warmup: int = 10
     dtype: str = "f64"
     dims: tuple[int, ...] | None = None
+    # Boundary-frame width of the hide variant (the reference's b_width
+    # knob, hide.jl:42 — same default as DiffusionConfig; clamped per-shard
+    # by parallel.overlap.effective_b_width).
+    b_width: tuple[int, ...] = (32, 4)
 
     def __post_init__(self):
         if len(self.lengths) != len(self.global_shape):
@@ -186,7 +195,44 @@ class AcousticWave:
                 return new, U
 
             return step
-        raise ValueError(f"unknown wave variant {variant!r} (ap, perf)")
+        if variant == "hide":
+            # Comm/compute overlap for the leapfrog (VERDICT r3 #5): the
+            # same boundary-slab/interior decomposition as the diffusion
+            # flagship's hide rung (parallel.overlap, the reference's
+            # intended variant (3) semantics, hide.jl:94-101) — only U is
+            # exchanged; (U_prev, C2) ride along as core-only aux operands.
+            from rocm_mpi_tpu.ops.wave_kernels import wave_step_padded_pallas
+            from rocm_mpi_tpu.parallel.overlap import make_overlap_step
+
+            if grid.nprocs == 1:
+                # No neighbors → nothing to hide; strip bookkeeping is pure
+                # overhead. Route to perf (same policy as the diffusion
+                # model's single-device hide).
+                return self._step("perf")
+
+            def pu(tp, aux, lam, dt_, spacing):
+                del lam
+                return wave_step_padded_pallas(tp, aux[0], aux[1], dt_,
+                                               spacing)
+
+            local = make_overlap_step(grid, pu, cfg.b_width)
+
+            def step(U, Uprev, C2):
+                new = shard_map(
+                    lambda Ul, Upl, C2l: local(
+                        Ul, (Upl, C2l), None, dt, cfg.spacing
+                    ),
+                    mesh=grid.mesh,
+                    in_specs=(grid.spec,) * 3,
+                    out_specs=grid.spec,
+                    check_vma=False,
+                )(U, Uprev, C2)
+                return new, U
+
+            return step
+        raise ValueError(
+            f"unknown wave variant {variant!r} (ap, perf, hide)"
+        )
 
     def advance_fn(self, variant: str = "perf"):
         """jitted (U, Uprev, C2, n) -> (U after n steps, U after n-1)."""
@@ -272,23 +318,36 @@ class AcousticWave:
         """The sweep depth run_deep will actually execute for these
         arguments — THE source of truth for callers labeling artifacts by
         depth (apps/wave_2d.py), so label and executed k cannot drift.
-        Policy: None defaults to DEFAULT_DEEP_STEPS; clamp to the smallest
-        shard extent (ghost slices need width <= shard), then gcd against
-        both timing windows. Explicit depths < 1 raise, as diffusion's do.
+        Policy (matching HeatDiffusion.effective_deep_depth, ADVICE r3):
+        the DEFAULT depth clamps to the smallest shard extent (ghost
+        slices need width <= shard); an EXPLICIT depth is first gcd'd
+        against the windows (as diffusion's is) and raises only if the
+        EFFECTIVE depth still exceeds the shard — the strict validation
+        make_wave_deep_sweep applies, surfaced before any compile.
         """
         from rocm_mpi_tpu.models.diffusion import effective_block_steps
 
         cfg = self.config
+        explicit = block_steps is not None
         if block_steps is None:
-            block_steps = self.DEFAULT_DEEP_STEPS
-        return effective_block_steps(
+            block_steps = min(
+                self.DEFAULT_DEEP_STEPS, min(self.grid.local_shape)
+            )
+        eff = effective_block_steps(
             cfg.nt if nt is None else nt,
             cfg.warmup if warmup is None else warmup,
-            min(block_steps, min(self.grid.local_shape)),
+            block_steps,
             label="wave deep-halo sweep depth",
             warn=warn,
             stacklevel=3,
         )
+        if explicit and eff > min(self.grid.local_shape):
+            raise ValueError(
+                f"wave deep-halo sweep depth {eff} exceeds a local "
+                f"shard extent {self.grid.local_shape}; ghost slices need "
+                "width <= shard"
+            )
+        return eff
 
     def run_deep(
         self,

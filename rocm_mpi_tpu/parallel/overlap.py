@@ -30,6 +30,7 @@ from __future__ import annotations
 
 from typing import Callable
 
+import jax
 import jax.numpy as jnp
 
 from rocm_mpi_tpu.parallel.halo import exchange_halo, global_boundary_mask
@@ -68,6 +69,12 @@ def make_overlap_step(
     the padded contract (jnp or Pallas). Returns
     `local_step(Tl, Cl, lam, dt, spacing) -> Tl_new`.
 
+    `Cl` may be any pytree of core-shaped operands (a bare coefficient
+    array for the diffusion rungs; a (U_prev, C2) tuple for the wave
+    leapfrog) — each leaf is sliced to the region and the whole tree is
+    handed to `padded_update` as its second argument. Only the primary
+    field `Tl` is halo-exchanged; aux operands are read core-only.
+
     `mask_boundary=False` drops the final Dirichlet `where`: for the Cm
     contract (C = the boundary-masked coefficient, models.diffusion
     `_make_masked_step`), held cells already come back unchanged from the
@@ -92,7 +99,8 @@ def make_overlap_step(
             """Candidate update of the core box given by `bounds`
             (per-axis (lo, hi) core ranges), read from the padded field."""
             tp = Tp[tuple(slice(lo, hi + 2) for lo, hi in bounds)]
-            cp = Cpl[tuple(slice(lo, hi) for lo, hi in bounds)]
+            core_idx = tuple(slice(lo, hi) for lo, hi in bounds)
+            cp = jax.tree_util.tree_map(lambda a: a[core_idx], Cpl)
             return padded_update(tp, cp, lam, dt, spacing)
 
         def build(axis, prefix):
