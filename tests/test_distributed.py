@@ -26,7 +26,9 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_distributed_step_and_gather():
+def _spawn_two_process(argv, timeout=240):
+    """Play the launcher (srun/PMIx analog): spawn 2 ranks of `argv` wired
+    by the RMT_* env contract, return [(proc, (stdout, stderr)), ...]."""
     port = _free_port()
     base = os.environ.copy()
     # The workers size their own device count (2 cpu devices per process);
@@ -50,7 +52,7 @@ def test_two_process_distributed_step_and_gather():
         )
         procs.append(
             subprocess.Popen(
-                [sys.executable, str(ROOT / "tests" / "distributed_worker.py")],
+                [sys.executable] + argv,
                 env=env,
                 stdout=subprocess.PIPE,
                 stderr=subprocess.PIPE,
@@ -61,7 +63,7 @@ def test_two_process_distributed_step_and_gather():
     outs = []
     try:
         for p in procs:
-            outs.append(p.communicate(timeout=240))
+            outs.append(p.communicate(timeout=timeout))
     finally:
         for p in procs:
             if p.poll() is None:
@@ -71,4 +73,38 @@ def test_two_process_distributed_step_and_gather():
             f"worker {pid} rc={p.returncode}\n--- stdout ---\n{out}"
             f"\n--- stderr ---\n{err[-3000:]}"
         )
-    assert "DISTRIBUTED_OK" in outs[0][0], outs[0][0]
+    return list(zip(procs, outs))
+
+
+def test_two_process_distributed_step_and_gather():
+    results = _spawn_two_process([str(ROOT / "tests" / "distributed_worker.py")])
+    assert "DISTRIBUTED_OK" in results[0][1][0], results[0][1][0]
+
+
+def test_two_process_weak_scaling_loop():
+    """VERDICT r3 #7: the weak-scaling harness itself (apps/weak_scaling.py)
+    run under the 2-process gloo launcher, so the scaling loop — mesh
+    construction per count, the timed run, the efficiency accounting —
+    crosses a real process boundary (n=4 spans both processes' device
+    pairs; n=2 is the proc-0-only submesh rung, which the other process
+    must still participate in dispatching)."""
+    import json
+
+    results = _spawn_two_process(
+        [
+            str(ROOT / "apps" / "weak_scaling.py"),
+            "--cpu-devices", "2", "--local", "16", "--nt", "32",
+            "--warmup", "8", "--counts", "2,4", "--variant", "hide",
+            "--json",
+        ]
+    )
+    out0 = results[0][1][0]
+    rows = [
+        json.loads(ln) for ln in out0.splitlines()
+        if ln.strip().startswith("{")
+    ]
+    assert [r["devices"] for r in rows] == [2, 4], out0
+    assert rows[1]["dims"] == [2, 2]  # really spans both processes
+    assert all(r["gpts"] > 0 for r in rows)
+    # Process 0 reports; process 1 stays silent on stdout (log0-gated).
+    assert "weak scaling:" not in results[1][1][0]
