@@ -1,0 +1,108 @@
+"""bf16-vs-f32 error at run length (VERDICT r3 #4).
+
+The bf16 fast path is a *labeled precision trade* the user opts into with
+--dtype bf16 (BASELINE.md): it halves per-step memory traffic. Round 3
+documented its error at 4 steps only; this harness characterizes the
+error-vs-steps curve out to the reference's full 1000-step run
+(/root/reference/scripts/diffusion_2D_perf.jl:47 — nt=1000) at the
+acceptance geometry (252²), so the trade's cost is known at the run length
+the claim covers.
+
+Protocol: advance the SAME per-step masked program (the schedule --dtype
+selects, models.diffusion variant 'perf' → ops.pallas_kernels.masked_step)
+in f32 and in bf16 from the same Gaussian IC; at log-spaced checkpoints
+report the relative L2 error, the max pointwise error against the field
+scale, and the peak-temperature drift (the max(T) decay invariant,
+hide.jl:115). Measured finding: the error GROWS with run length — once
+per-step field changes fall below bf16's 8-bit mantissa resolution, the
+storage rounding accumulates as systematic drift (the bf16 peak decays
+slower than f32's) rather than averaging out, so the trade is priced per
+run length, not per step.
+
+Run:  python scripts/bench_bf16_error.py            # on the chip
+      JAX_PLATFORMS=cpu python scripts/bench_bf16_error.py --steps 128
+                                                    # interpret-mode CPU
+Output: one table row per checkpoint (committed as docs/bf16_error_r4.txt);
+tests/test_bf16_error.py pins the 128-step bound from the same machinery.
+"""
+
+import argparse
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+from rocm_mpi_tpu.utils.backend import apply_platform_override  # noqa: E402
+
+
+def error_curve(n=252, checkpoints=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512,
+                                    1000)):
+    """[(steps, rel_l2, rel_max, peak_f32, peak_bf16), ...] for the per-step
+    masked program at n² — shared by the chip harness and the CPU test."""
+    import jax
+    import numpy as np
+
+    from rocm_mpi_tpu.config import DiffusionConfig
+    from rocm_mpi_tpu.models import HeatDiffusion
+
+    states = {}
+    advances = {}
+    for dtype in ("f32", "bf16"):
+        cfg = DiffusionConfig(
+            global_shape=(n, n), lengths=(10.0, 10.0),
+            nt=max(checkpoints), warmup=0, dtype=dtype, dims=(1, 1),
+        )
+        model = HeatDiffusion(cfg)
+        T, Cp = model.init_state()
+        states[dtype] = (T, Cp)
+        advances[dtype] = model.advance_fn("perf")
+
+    rows = []
+    done = 0
+    for ck in checkpoints:
+        delta = ck - done
+        for dtype in ("f32", "bf16"):
+            T, Cp = states[dtype]
+            T = advances[dtype](T, Cp, delta)
+            states[dtype] = (T, Cp)
+        done = ck
+        a = np.asarray(states["f32"][0], dtype=np.float64)
+        b = np.asarray(states["bf16"][0], dtype=np.float64)
+        scale = np.abs(a).max()
+        rel_l2 = float(np.linalg.norm(b - a) / np.linalg.norm(a))
+        rel_max = float(np.abs(b - a).max() / scale)
+        rows.append((ck, rel_l2, rel_max, float(a.max()), float(b.max())))
+    return rows
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--n", type=int, default=252)
+    p.add_argument("--steps", type=int, default=1000,
+                   help="last checkpoint (smaller for interpret-mode runs)")
+    args = p.parse_args(argv)
+
+    apply_platform_override()
+    import jax
+
+    plat = jax.devices()[0].platform
+    print(f"device: {jax.devices()[0]} ({plat}); {args.n}² per-step masked "
+          f"program, f32 vs bf16 from the same Gaussian IC", flush=True)
+    if plat == "cpu":
+        print("NOTE: interpret-mode Pallas (no accelerator) — error values "
+              "are valid, rates are not measured here", flush=True)
+    cks = [c for c in (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1000)
+           if c <= args.steps]
+    if cks[-1] != args.steps:
+        cks.append(args.steps)
+    print(f"{'steps':>6}  {'rel L2':>10}  {'rel max':>10}  "
+          f"{'max(T) f32':>12}  {'max(T) bf16':>12}")
+    for ck, l2, mx, pa, pb in error_curve(args.n, tuple(cks)):
+        print(f"{ck:6d}  {l2:10.4%}  {mx:10.4%}  {pa:12.6f}  {pb:12.6f}",
+              flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

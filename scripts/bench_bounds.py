@@ -98,6 +98,37 @@ def hbm_bounds(n=12288, steps=60, warm=10):
           "(run_hbm_blocked), not a faster per-step kernel.")
 
 
+def dma_sweep(shapes=(2048, 4096, 8192, 12288), tms=(16, 32, 64, 128),
+              steps=60, warm=10):
+    """Pure-DMA Pallas copy across shapes and stripe heights (VERDICT r3
+    weak #2: one more independent probe of "the part can't stream
+    faster"). A copy does no arithmetic — its rate IS the achievable
+    HBM↔VMEM stream rate of this stack at that transfer size; if ANY
+    (shape, tm) cell beats the stream ceiling claimed by the per-step
+    analysis, the claim was wrong."""
+    print("\n== pure-DMA Pallas copy sweep (GB/s actual, 2 passes) ==")
+    print(f"{'n':>7} " + "".join(f"tm={tm:<6d}" for tm in tms))
+
+    def copy_kernel(a_ref, o_ref):
+        o_ref[:] = a_ref[:]
+
+    for n in shapes:
+        T0 = jax.random.uniform(jax.random.PRNGKey(0), (n, n), jnp.float32)
+        P = n * n * 4 / 1e9
+        cells = []
+        for tm in tms:
+            spec = pl.BlockSpec(
+                (tm, n), lambda i: (i, 0), memory_space=pltpu.VMEM
+            )
+            copy = lambda T, C: pl.pallas_call(
+                copy_kernel,
+                out_shape=jax.ShapeDtypeStruct((n, n), jnp.float32),
+                grid=(n // tm,), in_specs=[spec], out_specs=spec)(T)
+            per = timeit(copy, jnp.copy(T0), None, steps, warm)
+            cells.append(f"{2 * P / per:6.1f}   ")
+        print(f"{n:7d} " + "".join(cells), flush=True)
+
+
 def launch_floor(n=252, reps=200_000):
     print(f"\n== VMEM-resident launch floor at {n}² f32 ==")
     T0 = jax.random.uniform(jax.random.PRNGKey(0), (n, n), jnp.float32)
@@ -131,4 +162,5 @@ if __name__ == "__main__":
         sys.exit("bench_bounds.py needs an accelerator backend")
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 12288
     hbm_bounds(n)
+    dma_sweep()
     launch_floor()
