@@ -63,9 +63,11 @@ def wave_step_padded_pallas(Up, Uprev, C2, dt, spacing, interpret=None):
 
     `Up` is the width-1-padded displacement (ghosts from exchange_halo);
     `Uprev` and `C2` (squared wave speed) are core-shaped. Whole-block VMEM
-    kernel; blocks beyond the VMEM budget fall back to the jnp padded form
-    (the wave workload is the layering demo, not the tuned flagship — the
-    diffusion kernels carry the striped/temporal-blocked machinery).
+    kernel; falls back to the IDENTICAL-semantics jnp padded form in two
+    cases (ADVICE r3): blocks beyond the VMEM budget, and dtypes Mosaic
+    cannot compile (f64 on a real TPU — unlike the diffusion kernels,
+    which raise there; the wave workload is the layering demo, not the
+    tuned flagship, so a chip benchmark of wave f64 times the jnp path).
     """
     if interpret is None:
         interpret = _interpret_default()
@@ -183,7 +185,11 @@ def wave_multi_step(
     VMEM — the wave edition of ops.pallas_kernels.fused_multi_step (same
     schedule, chunk, and compile-time constraints; see its docstring).
     Returns the advanced (U, U_prev) pair. `chunk` must divide `n_steps`
-    when both are static; the outer trip count is dynamic. The kernel
+    when both are static; the outer trip count is dynamic — and for a
+    TRACED `n_steps` divisibility cannot be checked at trace time: the
+    trip count floors, silently dropping any `n_steps % chunk` remainder
+    (ADVICE r3). Callers with dynamic step counts must guarantee
+    divisibility themselves, as run_vmem_resident does via gcd. The kernel
     holds 4 field-sized arrays (U, U⁻, M, Cw), so admission is gated on
     half the diffusion kernel's VMEM budget.
     """
