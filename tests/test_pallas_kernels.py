@@ -295,3 +295,17 @@ def test_run_hbm_blocked_model_runner():
     np.testing.assert_allclose(
         np.asarray(res_tb.T), np.asarray(res_ps.T), rtol=2e-5, atol=1e-6
     )
+
+
+def test_interpret_default_raises_on_unknown_accelerator(monkeypatch):
+    # VERDICT r3 hygiene: a GPU backend must error loudly, not silently
+    # run the interpreter (≈hours) — compiled Mosaic is TPU-only.
+    import jax
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "gpu")
+    with pytest.raises(RuntimeError, match="TPU-only"):
+        pk._interpret_default()
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    assert pk._interpret_default() is True
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert pk._interpret_default() is False
