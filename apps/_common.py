@@ -94,6 +94,18 @@ def setup_jax(args):
     return jax
 
 
+def profile_context(jax, args):
+    """The one --profile idiom (SURVEY.md §5.1): a jax.profiler trace over
+    the timed loop when --profile DIR was given, a no-op otherwise. Shared
+    by run_app and the wave app so the profiling convention cannot
+    diverge between workloads."""
+    import contextlib
+
+    if getattr(args, "profile", None):
+        return jax.profiler.trace(args.profile)
+    return contextlib.nullcontext()
+
+
 def build_config(args):
     from rocm_mpi_tpu.config import DiffusionConfig, with_fact
 
@@ -141,13 +153,7 @@ def run_app(variant: str, args) -> int:
         f"({grid.nprocs} device(s): {jax.devices()[0].device_kind} …)"
     )
 
-    import contextlib
-
-    profile_ctx = (
-        jax.profiler.trace(args.profile)
-        if args.profile
-        else contextlib.nullcontext()
-    )
+    profile_ctx = profile_context(jax, args)
     if getattr(args, "deep", 0):
         # The deep-halo schedule replaces the variant's own step entirely
         # (variant-specific knobs like --b-width are unused); label the

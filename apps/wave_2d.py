@@ -56,6 +56,11 @@ def make_parser():
         help="whole-loop-in-VMEM fast path (single device only)",
     )
     p.add_argument("--vis", action="store_true")
+    p.add_argument(
+        "--profile", default=None, metavar="DIR",
+        help="trace the timed loop with jax.profiler into DIR (the "
+        "--profile convention of the diffusion apps, SURVEY.md §5.1)",
+    )
     return p
 
 
@@ -109,8 +114,12 @@ def main(argv=None) -> int:
     else:
         label = args.variant
         runner = lambda: model.run(variant=args.variant)
+    from _common import profile_context
+
+    profile_ctx = profile_context(jax, args)
     log0("Starting the time loop 🚀...", end="")
-    result = runner()
+    with profile_ctx:
+        result = runner()
     log0("done")
     log0(
         f"Executed {result.nt} steps in = {result.wtime:.3e} sec "

@@ -282,6 +282,16 @@ def test_wave_app_runs():
          "--dims", "1,1", "--vmem"]
     )
     assert rc == 0
+    # --profile writes a trace directory (the §5.1 convention).
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        rc = app.main(
+            ["--nx", "24", "--ny", "20", "--nt", "12", "--warmup", "4",
+             "--dims", "2,2", "--variant", "hide", "--profile", td]
+        )
+        assert rc == 0
+        assert any(pathlib.Path(td).iterdir()), "profile trace not written"
     rc = app.main(
         ["--nx", "12", "--ny", "10", "--nz", "8", "--nt", "12",
          "--warmup", "4", "--dims", "2,2,2"]
