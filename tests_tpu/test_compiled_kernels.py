@@ -335,6 +335,44 @@ def test_wave_deep_sweep_compiled():
     _close(got, ref)
 
 
+def test_wave_hide_strip_kernels_compiled():
+    # The wave hide variant's production strip combination (r4): the
+    # 3-operand leapfrog Pallas kernel per region with (U_prev, C2) as
+    # core-only aux pytree — under shard_map on a 1-device mesh, so the
+    # slab-shaped wave kernels compile on the chip even though the
+    # sharded hide path needs >= 2 devices to be selected organically.
+    from jax import shard_map
+
+    from rocm_mpi_tpu.models.wave import wave_step_fused
+    from rocm_mpi_tpu.ops.wave_kernels import wave_step_padded_pallas
+    from rocm_mpi_tpu.parallel.mesh import init_global_grid
+    from rocm_mpi_tpu.parallel.overlap import make_overlap_step
+
+    grid = init_global_grid(48, 48, dims=(1, 1), devices=jax.devices()[:1])
+    dt, spacing = 1e-3, grid.spacing
+
+    def pu(tp, aux, lam, dt_, sp):
+        del lam
+        return wave_step_padded_pallas(tp, aux[0], aux[1], dt_, sp)
+
+    local = make_overlap_step(grid, pu, (8, 8))
+    U = _rand((48, 48))
+    Uprev = _rand((48, 48), seed=1)
+    C2 = 1.0 + _rand((48, 48), seed=2)
+
+    @jax.jit
+    def step(U, Uprev, C2):
+        return shard_map(
+            lambda Ul, Upl, C2l: local(Ul, (Upl, C2l), None, dt, spacing),
+            mesh=grid.mesh,
+            in_specs=(grid.spec,) * 3,
+            out_specs=grid.spec,
+            check_vma=False,
+        )(U, Uprev, C2)
+
+    _close(step(U, Uprev, C2), wave_step_fused(U, Uprev, C2, dt, spacing))
+
+
 def test_model_runners_compiled():
     # The model-level fast paths end-to-end on the chip at tiny sizes.
     cfg = DiffusionConfig(
