@@ -197,9 +197,17 @@ def child_main(budget_s: float) -> int:
             file=sys.stderr,
         )
         _maybe_emit_fake_real_line()
-        r = model(32 + 256, 32).run_vmem_resident()
-        emit(r.gpts, r.gpts / REF_ESTIMATE_GPTS,
-             error="no accelerator backend; interpret-mode smoke value")
+        if os.environ.get("BENCH_FAULT_SKIP_SMOKE"):
+            # Fault injection: stand in for the ~30 s interpret run so the
+            # kill/harvest contract tests are fast and timing-independent.
+            # (emit rounds to 4 decimals — keep the stand-in value above
+            # that resolution so the contract tests can assert > 0.)
+            emit(0.001, 0.0, error="no accelerator backend; smoke skipped "
+                                   "by fault injection")
+        else:
+            r = model(32 + 256, 32).run_vmem_resident()
+            emit(r.gpts, r.gpts / REF_ESTIMATE_GPTS,
+                 error="no accelerator backend; interpret-mode smoke value")
         _maybe_hang_after_emit()
         return RC_NO_TPU
 

@@ -55,14 +55,18 @@ def test_bench_harvests_emitted_line_from_killed_child():
     kill it at the deadline AND still report the flushed measurement —
     emit-as-you-go means a hang can only cost the upgrade, never the number.
 
-    Budget 150 s: ample for the ~30 s interpret-mode smoke emit even on a
-    much slower machine, then the injected hang eats the rest, so the child
-    is provably killed (a completed child exits RC_NO_TPU and takes a
-    different parent path).
+    BENCH_FAULT_SKIP_SMOKE stands in for the ~30 s interpret-mode smoke
+    run, so the emit happens within seconds on any machine and the 60 s
+    budget provably kills the hanging child (a completed child exits
+    RC_NO_TPU and takes a different parent path).
     """
     proc = _run_bench(
-        {"BENCH_BUDGET_S": "150", "BENCH_FAULT_HANG_AFTER_EMIT": "1"},
-        timeout=220,
+        {
+            "BENCH_BUDGET_S": "60",
+            "BENCH_FAULT_SKIP_SMOKE": "1",
+            "BENCH_FAULT_HANG_AFTER_EMIT": "1",
+        },
+        timeout=130,
     )
     assert proc.returncode == 0, proc.stderr[-1000:]
     assert "killed after" in proc.stderr  # the child really was killed
@@ -77,11 +81,12 @@ def test_bench_harvests_real_measurement_over_smoke_fallback():
     harvested real measurement over the smoke line when reporting."""
     proc = _run_bench(
         {
-            "BENCH_BUDGET_S": "150",
+            "BENCH_BUDGET_S": "60",
+            "BENCH_FAULT_SKIP_SMOKE": "1",
             "BENCH_FAULT_EMIT_REAL_VALUE": "123.4",
             "BENCH_FAULT_HANG_AFTER_EMIT": "1",
         },
-        timeout=220,
+        timeout=130,
     )
     assert proc.returncode == 0, proc.stderr[-1000:]
     obj = _contract_line(proc.stdout)
