@@ -358,10 +358,15 @@ def run_suite() -> None:
     row("12288² per-step perf", (12288, 12288), "run", 110, 10,
         variant="perf")
     # Labeled precision-trade fast path (--dtype bf16): halves the memory
-    # traffic of the per-step schedule; ~0.6 % rel. error after 4 steps vs
-    # f32 (documented in BASELINE.md) — the user opts in explicitly.
+    # traffic. Per-step bf16 rounds the state to bf16 EVERY step (error
+    # grows with run length — BASELINE.md's error-vs-steps curve); the
+    # temporal-blocked row below is the usable form: bf16 storage traffic,
+    # f32 in-kernel sweeps, one rounding per k steps (error flat at
+    # quantization level). The user opts in explicitly either way.
     row("12288² per-step perf (bf16)", (12288, 12288), "run", 110, 10,
         dtype="bf16", variant="perf")
+    row("12288² temporal-blocked (k=8, bf16)", (12288, 12288),
+        "run_hbm_blocked", 328, 8, dtype="bf16")
     row("128³ 3D temporal-blocked (k=8)", (128, 128, 128), "run_hbm_blocked",
         3_208, 8)
     row("128³ 3D per-step perf", (128, 128, 128), "run", 1_100, 100,

@@ -566,8 +566,12 @@ class HeatDiffusion:
         """
         cfg = self.config
         if block_steps is None:
+            # bf16 is storage-only in the local kernels (f32 in-kernel):
+            # size the depth at the compute width.
+            from rocm_mpi_tpu.ops.pallas_kernels import _compute_itemsize
+
             k = default_deep_depth(
-                self.grid.local_shape, jnp.dtype(cfg.jax_dtype).itemsize
+                self.grid.local_shape, _compute_itemsize(cfg.jax_dtype)
             )
         else:
             k = block_steps

@@ -46,6 +46,34 @@ def _supports_compiled(dtype) -> bool:
     return jnp.dtype(dtype).itemsize <= 4
 
 
+def _upcast_for_compute(*arrays):
+    """bf16 is STORAGE-ONLY in this kernel family (r4): operands are upcast
+    to f32 on entry and results rounded back once at the kernel boundary.
+    bf16 keeps its whole value — halved HBM/VMEM traffic — while the step
+    arithmetic runs at f32, so quantization is injected once per kernel
+    (per step for the per-step kernels, per chunk/sweep for the multi-step
+    ones) instead of compounding through every intermediate. Measured
+    motivation: with per-step bf16 rounding the 252² trajectory freezes
+    (updates quantize to zero; docs/bf16_error_cpu252_perstep_r4.txt vs
+    the flat curve of docs/bf16_error_cpu252_vmem_r4.txt)."""
+    if arrays[0].dtype == jnp.bfloat16:
+        return tuple(a.astype(jnp.float32) for a in arrays)
+    return arrays
+
+
+def _compute_itemsize(dtype) -> int:
+    """In-kernel bytes per element: bf16 state is upcast to f32 inside
+    the kernels (_upcast_for_compute), so every VMEM/admission/stripe
+    policy must budget at >= f32 width, not storage width. The ONE place
+    the storage-only width rule lives."""
+    return max(jnp.dtype(dtype).itemsize, 4)
+
+
+def _compute_nbytes(arr) -> int:
+    """In-kernel working-set bytes per field (see _compute_itemsize)."""
+    return arr.size * _compute_itemsize(arr.dtype)
+
+
 def _out_struct(shape, exemplar):
     """ShapeDtypeStruct matching `exemplar`'s dtype and mesh-varying axes.
 
@@ -95,9 +123,11 @@ def _lap_from_padded(Tp, inv_d2):
 
 
 def _fused_kernel_whole(Tp_ref, Cp_ref, out_ref, *, lam, dt, inv_d2):
-    Tp = Tp_ref[:]
+    Tp, Cp = _upcast_for_compute(Tp_ref[:], Cp_ref[:])
     core = tuple(slice(1, -1) for _ in range(Tp.ndim))
-    out_ref[:] = Tp[core] + (dt * lam) / Cp_ref[:] * _lap_from_padded(Tp, inv_d2)
+    out_ref[:] = (
+        Tp[core] + (dt * lam) / Cp * _lap_from_padded(Tp, inv_d2)
+    ).astype(out_ref.dtype)
 
 
 def fused_step_padded(Tp, Cp, lam, dt, spacing, interpret=None):
@@ -118,7 +148,7 @@ def fused_step_padded(Tp, Cp, lam, dt, spacing, interpret=None):
     # are rejected by pallas_call; physics constants are static anyway).
     lam, dt = float(lam), float(dt)
     inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
-    nbytes = Cp.size * Cp.dtype.itemsize
+    nbytes = _compute_nbytes(Cp)
     if Tp.ndim in (2, 3) and nbytes > _VMEM_BLOCK_BUDGET_BYTES:
         return _fused_step_striped(Tp, Cp, lam, dt, inv_d2, interpret)
     kernel = functools.partial(
@@ -150,10 +180,11 @@ def _fused_kernel_striped(Ta_ref, Tb_ref, Cp_ref, out_ref, *, lam, dt, inv_d2):
     # `ext` is a fully padded block for this output stripe: padded along
     # axis 0 by the stripe overlap, along the rest by Tp's own pad ring.
     ext = jnp.concatenate([Ta, Tb[:2]], axis=0)  # rows [i·tm, i·tm+tm+2)
+    ext, Cp = _upcast_for_compute(ext, Cp_ref[:])
     core = tuple(slice(1, -1) for _ in range(ext.ndim))
-    out_ref[:] = ext[core] + (dt * lam) / Cp_ref[:] * _lap_from_padded(
-        ext, inv_d2
-    )
+    out_ref[:] = (
+        ext[core] + (dt * lam) / Cp * _lap_from_padded(ext, inv_d2)
+    ).astype(out_ref.dtype)
 
 
 def _stripe_height(row_bytes: int) -> int:
@@ -189,7 +220,8 @@ def _striped_call(kernel, Tp, C, interpret):
     core = C.shape  # Tp is core + 2 per axis
     n1, rest = core[0], core[1:]
     rest_p = tuple(n + 2 for n in rest)
-    row_bytes = C.dtype.itemsize
+    # bf16 operands are upcast to f32 in-kernel: size stripes at f32 width.
+    row_bytes = _compute_itemsize(C.dtype)
     for n in rest_p:
         row_bytes *= n
     tm = _stripe_height(row_bytes)
@@ -236,15 +268,20 @@ def _fused_step_striped(Tp, Cp, lam, dt, inv_d2, interpret):
 
 
 def _fused_kernel_whole_cm(Tp_ref, Cm_ref, out_ref, *, inv_d2):
-    Tp = Tp_ref[:]
+    Tp, Cm = _upcast_for_compute(Tp_ref[:], Cm_ref[:])
     core = tuple(slice(1, -1) for _ in range(Tp.ndim))
-    out_ref[:] = Tp[core] + Cm_ref[:] * _lap_from_padded(Tp, inv_d2)
+    out_ref[:] = (
+        Tp[core] + Cm * _lap_from_padded(Tp, inv_d2)
+    ).astype(out_ref.dtype)
 
 
 def _fused_kernel_striped_cm(Ta_ref, Tb_ref, Cm_ref, out_ref, *, inv_d2):
     ext = jnp.concatenate([Ta_ref[:], Tb_ref[:2]], axis=0)
+    ext, Cm = _upcast_for_compute(ext, Cm_ref[:])
     core = tuple(slice(1, -1) for _ in range(ext.ndim))
-    out_ref[:] = ext[core] + Cm_ref[:] * _lap_from_padded(ext, inv_d2)
+    out_ref[:] = (
+        ext[core] + Cm * _lap_from_padded(ext, inv_d2)
+    ).astype(out_ref.dtype)
 
 
 def fused_step_cm(Tp, Cm, spacing, interpret=None):
@@ -267,7 +304,7 @@ def fused_step_cm(Tp, Cm, spacing, interpret=None):
             "interpret mode for f64 parity runs"
         )
     inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
-    nbytes = Cm.size * Cm.dtype.itemsize
+    nbytes = _compute_nbytes(Cm)
     if Tp.ndim in (2, 3) and nbytes > _VMEM_BLOCK_BUDGET_BYTES:
         kernel = functools.partial(_fused_kernel_striped_cm, inv_d2=inv_d2)
         return _striped_call(kernel, Tp, Cm, interpret)
@@ -298,23 +335,28 @@ def fused_step_cm(Tp, Cm, spacing, interpret=None):
 
 def _flux_kernel(Tp_ref, qx_ref, qy_ref, *, lam, inv_d):
     # Fourier's law on the staggered grid: q = -λ ∂T (kp.jl Flux!).
-    Tp = Tp_ref[:]
-    qx_ref[:] = -lam * (Tp[1:, 1:-1] - Tp[:-1, 1:-1]) * inv_d[0]
-    qy_ref[:] = -lam * (Tp[1:-1, 1:] - Tp[1:-1, :-1]) * inv_d[1]
+    (Tp,) = _upcast_for_compute(Tp_ref[:])
+    qx_ref[:] = (-lam * (Tp[1:, 1:-1] - Tp[:-1, 1:-1]) * inv_d[0]).astype(
+        qx_ref.dtype
+    )
+    qy_ref[:] = (-lam * (Tp[1:-1, 1:] - Tp[1:-1, :-1]) * inv_d[1]).astype(
+        qy_ref.dtype
+    )
 
 
 def _residual_kernel(qx_ref, qy_ref, Cp_ref, dTdt_ref, *, inv_d):
     # Conservation of energy: ∂T/∂t = 1/cₚ(-∇·q) (kp.jl Residual!).
-    qx, qy = qx_ref[:], qy_ref[:]
+    qx, qy, Cp = _upcast_for_compute(qx_ref[:], qy_ref[:], Cp_ref[:])
     div = (qx[1:, :] - qx[:-1, :]) * inv_d[0] + (
         qy[:, 1:] - qy[:, :-1]
     ) * inv_d[1]
-    dTdt_ref[:] = -div / Cp_ref[:]
+    dTdt_ref[:] = (-div / Cp).astype(dTdt_ref.dtype)
 
 
 def _update_kernel(Tp_ref, dTdt_ref, out_ref, *, dt):
     # Temperature update: T_new = T_old + dt·∂T/∂t (kp.jl Update!).
-    out_ref[:] = Tp_ref[1:-1, 1:-1] + dt * dTdt_ref[:]
+    Tp, dTdt = _upcast_for_compute(Tp_ref[:], dTdt_ref[:])
+    out_ref[:] = (Tp[1:-1, 1:-1] + dt * dTdt).astype(out_ref.dtype)
 
 
 def kp_step_padded(Tp, Cp, lam, dt, spacing, interpret=None):
@@ -400,10 +442,11 @@ def _multi_step_kernel(T_ref, Cm_ref, out_ref, *, inv_d2, chunk):
     budget would blow the VMEM footprint the old form was validated under.
     """
     ndim = len(T_ref.shape)
-    nbytes = jnp.dtype(T_ref.dtype).itemsize
+    # bf16 is storage-only: budget the prologue at the f32 compute width.
+    nbytes = _compute_itemsize(T_ref.dtype)
     for d in T_ref.shape:
         nbytes *= d
-    Cm = Cm_ref[:]
+    T_in, Cm = _upcast_for_compute(T_ref[:], Cm_ref[:])
 
     if chunk >= 4 and nbytes <= _AC_FORM_MAX_BYTES:
         if all(inv == inv_d2[0] for inv in inv_d2):
@@ -446,7 +489,9 @@ def _multi_step_kernel(T_ref, Cm_ref, out_ref, *, inv_d2, chunk):
                 lap = term if lap is None else lap + term
             return T + Cm * lap
 
-    out_ref[:] = lax.fori_loop(0, chunk, body, T_ref[:], unroll=True)
+    out_ref[:] = lax.fori_loop(0, chunk, body, T_in, unroll=True).astype(
+        out_ref.dtype
+    )
 
 
 DEFAULT_STEP_CHUNK = 256
@@ -509,16 +554,23 @@ def fused_multi_step(T, Cp, lam, dt, spacing, n_steps, chunk=None, interpret=Non
     The outer trip count is dynamic, so one compiled program serves every
     `n_steps` with the same chunk. Global
     boundary = block boundary (Dirichlet).
+
+    bf16 fields are storage-only (r4): the kernel computes the whole
+    chunk in f32 and rounds back once per chunk, so bf16 keeps its
+    traffic savings without per-step quantization drift
+    (_upcast_for_compute; error curve in BASELINE.md). Admission and
+    chunk policy therefore budget at f32 width.
     """
     if interpret is None:
         interpret = _interpret_default()
     if not _supports_compiled(T.dtype) and not interpret:
         raise TypeError(f"Mosaic does not support {T.dtype}")
-    nbytes = T.size * T.dtype.itemsize
+    nbytes = _compute_nbytes(T)
     if nbytes > _VMEM_BLOCK_BUDGET_BYTES:
         raise ValueError(
-            f"field of {nbytes} bytes exceeds the VMEM-resident budget "
-            f"({_VMEM_BLOCK_BUDGET_BYTES}); use the per-step path"
+            f"field of {nbytes} bytes (f32 compute width) exceeds the "
+            f"VMEM-resident budget ({_VMEM_BLOCK_BUDGET_BYTES}); use the "
+            "per-step path"
         )
     chunk = resolve_step_chunk(n_steps, chunk, nbytes, warn_on_cap)
     lam, dt = float(lam), float(dt)
@@ -561,13 +613,13 @@ def multi_step_cm(T, Cm, spacing, n_steps: int, interpret=None):
         raise TypeError(f"Mosaic does not support {T.dtype}")
     if T.shape != Cm.shape:
         raise ValueError(f"shape mismatch: T {T.shape} vs Cm {Cm.shape}")
-    nbytes = T.size * T.dtype.itemsize
+    nbytes = _compute_nbytes(T)
     if nbytes > _VMEM_BLOCK_BUDGET_BYTES:
         raise ValueError(
-            f"padded block of {nbytes} bytes exceeds the VMEM-resident "
-            f"budget ({_VMEM_BLOCK_BUDGET_BYTES}); for HBM-resident "
-            "blocks use multi_step_cm_hbm (the deep-halo sweep routes "
-            "there automatically) or the per-step variants"
+            f"padded block of {nbytes} bytes (f32 compute width) exceeds "
+            f"the VMEM-resident budget ({_VMEM_BLOCK_BUDGET_BYTES}); for "
+            "HBM-resident blocks use multi_step_cm_hbm (the deep-halo "
+            "sweep routes there automatically) or the per-step variants"
         )
     inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
     kernel = functools.partial(
@@ -642,6 +694,7 @@ def _tb_kernel(Tu_ref, Tc_ref, Td_ref, Cu_ref, Cc_ref, Cd_ref, o_ref, *,
     Cm = jnp.concatenate(
         [jnp.where(i == 0, zg, Cu_ref[:]), Cc_ref[:],
          jnp.where(i == n_i - 1, zg, Cd_ref[:])], 0)
+    T, Cm = _upcast_for_compute(T, Cm)  # bf16 storage, f32 sweep arithmetic
     ndim = T.ndim
     for _ in range(k):
         lap = None
@@ -651,7 +704,7 @@ def _tb_kernel(Tu_ref, Tc_ref, Td_ref, Cu_ref, Cc_ref, Cd_ref, o_ref, *,
             ) * inv_d2[ax]
             lap = term if lap is None else lap + term
         T = T + Cm * lap
-    o_ref[:] = T[g:g + tm]
+    o_ref[:] = T[g:g + tm].astype(o_ref.dtype)
 
 
 def _stripe_ghost_specs(tm, g, n0, rest):
@@ -716,7 +769,9 @@ def fused_multi_step_hbm(T, Cp, lam, dt, spacing, n_steps, block_steps=None,
     perf.jl:3-13) pays by construction. The TPU grid executes
     stripes sequentially, so sweep s+1 only starts after sweep s wrote its
     stripes; correctness needs no inter-stripe synchronization beyond the
-    light-cone ghost blocks (see _tb_kernel).
+    light-cone ghost blocks (see _tb_kernel). bf16 fields are
+    storage-only (r4): slabs upcast to f32 in-kernel and round back once
+    per sweep — bf16 HBM traffic, f32 sweep arithmetic.
 
     Requires n_steps % block_steps == 0 (static check when n_steps is a
     Python int; for traced n_steps the trip count floors) and axis-0 length
@@ -827,13 +882,14 @@ def _per_step_kernel(Tu_ref, Tc_ref, Td_ref, Cm_ref, o_ref, *, inv_d2, g, tm):
     T = jnp.concatenate(
         [jnp.where(i == 0, zg, Tu_ref[:]), Tc_ref[:],
          jnp.where(i == n_i - 1, zg, Td_ref[:])], 0)
+    T, Tc, Cm = _upcast_for_compute(T, Tc_ref[:], Cm_ref[:])
     lap = None
     for ax in range(T.ndim):
         term = (
             jnp.roll(T, -1, ax) + jnp.roll(T, 1, ax) - 2.0 * T
         ) * inv_d2[ax]
         lap = term if lap is None else lap + term
-    o_ref[:] = Tc_ref[:] + Cm_ref[:] * lap[g:g + tm]
+    o_ref[:] = (Tc + Cm * lap[g:g + tm]).astype(o_ref.dtype)
 
 
 def _masked_step_striped(T, Cm, inv_d2, interpret, tm, g):
@@ -872,7 +928,7 @@ def masked_step(T, Cm, spacing, interpret=None, tm=None):
         interpret = _interpret_default()
     if not _supports_compiled(T.dtype) and not interpret:
         raise TypeError(f"Mosaic does not support {T.dtype}")
-    nbytes = T.size * T.dtype.itemsize
+    nbytes = _compute_nbytes(T)
     if nbytes <= _VMEM_BLOCK_BUDGET_BYTES:
         return multi_step_cm(T, Cm, spacing, 1, interpret=interpret)
     inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)

@@ -26,10 +26,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 from rocm_mpi_tpu.ops.pallas_kernels import (
     _VMEM_BLOCK_BUDGET_BYTES,
+    _compute_nbytes,
     _interpret_default,
     _lap_from_padded,
     _out_struct,
     _supports_compiled,
+    _upcast_for_compute,
 )
 
 
@@ -49,13 +51,11 @@ def wave_step_padded(Up, Uprev, C2, dt, spacing):
 
 
 def _wave_kernel_whole(Up_ref, Uprev_ref, C2_ref, out_ref, *, dt2, inv_d2):
-    Up = Up_ref[:]
+    Up, Uprev, C2 = _upcast_for_compute(Up_ref[:], Uprev_ref[:], C2_ref[:])
     core = tuple(slice(1, -1) for _ in range(Up.ndim))
     out_ref[:] = (
-        2.0 * Up[core]
-        - Uprev_ref[:]
-        + dt2 * C2_ref[:] * _lap_from_padded(Up, inv_d2)
-    )
+        2.0 * Up[core] - Uprev + dt2 * C2 * _lap_from_padded(Up, inv_d2)
+    ).astype(out_ref.dtype)
 
 
 def wave_step_padded_pallas(Up, Uprev, C2, dt, spacing, interpret=None):
@@ -71,7 +71,7 @@ def wave_step_padded_pallas(Up, Uprev, C2, dt, spacing, interpret=None):
     """
     if interpret is None:
         interpret = _interpret_default()
-    nbytes = C2.size * C2.dtype.itemsize
+    nbytes = _compute_nbytes(C2)
     if (not _supports_compiled(Up.dtype) and not interpret) or (
         nbytes > _VMEM_BLOCK_BUDGET_BYTES
     ):
@@ -114,18 +114,20 @@ def masked_leapfrog_step(U, Uprev, M, Cw, inv_d2):
 def _wave_multi_step_kernel(
     U_ref, Uprev_ref, M_ref, Cw_ref, oU_ref, oUprev_ref, *, inv_d2, chunk
 ):
-    """`chunk` leapfrog steps with the state pair VMEM-resident."""
-    M, Cw = M_ref[:], Cw_ref[:]
-
+    """`chunk` leapfrog steps with the state pair VMEM-resident (bf16
+    storage upcast to f32 for the whole chunk — one rounding per chunk)."""
+    U0, Uprev0, M, Cw = _upcast_for_compute(
+        U_ref[:], Uprev_ref[:], M_ref[:], Cw_ref[:]
+    )
     U, Uprev = lax.fori_loop(
         0,
         chunk,
         lambda _, s: masked_leapfrog_step(s[0], s[1], M, Cw, inv_d2),
-        (U_ref[:], Uprev_ref[:]),
+        (U0, Uprev0),
         unroll=True,
     )
-    oU_ref[:] = U
-    oUprev_ref[:] = Uprev
+    oU_ref[:] = U.astype(oU_ref.dtype)
+    oUprev_ref[:] = Uprev.astype(oUprev_ref.dtype)
 
 
 def interior_mask(shape, dtype):
@@ -157,11 +159,11 @@ def wave_multi_step_masked(U, Uprev, M, Cw, spacing, n_steps: int,
             f"shape mismatch: U {U.shape}, Uprev {Uprev.shape}, "
             f"M {M.shape}, Cw {Cw.shape}"
         )
-    nbytes = U.size * U.dtype.itemsize
+    nbytes = _compute_nbytes(U)
     if nbytes > _VMEM_BLOCK_BUDGET_BYTES // 2:
         raise ValueError(
-            f"block of {nbytes} bytes exceeds the wave VMEM-resident "
-            f"budget ({_VMEM_BLOCK_BUDGET_BYTES // 2})"
+            f"block of {nbytes} bytes (f32 compute width) exceeds the "
+            f"wave VMEM-resident budget ({_VMEM_BLOCK_BUDGET_BYTES // 2})"
         )
     inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
     kernel = functools.partial(
@@ -199,12 +201,12 @@ def wave_multi_step(
         interpret = _interpret_default()
     if not _supports_compiled(U.dtype) and not interpret:
         raise TypeError(f"Mosaic does not support {U.dtype}")
-    nbytes = U.size * U.dtype.itemsize
+    nbytes = _compute_nbytes(U)
     if nbytes > _VMEM_BLOCK_BUDGET_BYTES // 2:
         raise ValueError(
-            f"field of {nbytes} bytes exceeds the wave VMEM-resident "
-            f"budget ({_VMEM_BLOCK_BUDGET_BYTES // 2}); use the per-step "
-            "path"
+            f"field of {nbytes} bytes (f32 compute width) exceeds the "
+            f"wave VMEM-resident budget ({_VMEM_BLOCK_BUDGET_BYTES // 2}); "
+            "use the per-step path"
         )
     chunk = resolve_step_chunk(n_steps, chunk, nbytes, warn_on_cap)
     M = interior_mask(U.shape, U.dtype)

@@ -90,6 +90,7 @@ def make_deep_sweep(grid: GlobalGrid, k: int, lam, dt, spacing):
         _TB_G,
         _TB_TM,
         _VMEM_BLOCK_BUDGET_BYTES,
+        _compute_nbytes,
         multi_step_cm,
         multi_step_cm_hbm,
     )
@@ -120,7 +121,7 @@ def make_deep_sweep(grid: GlobalGrid, k: int, lam, dt, spacing):
         Cpp = exchange_halo(Cpl, grid, width=k)
         Cm = padded_update_coefficient(Cpp, grid, k, lam, dt)
         n0p = Tp.shape[0]
-        if Tp.size * Tp.dtype.itemsize <= _VMEM_BLOCK_BUDGET_BYTES:
+        if _compute_nbytes(Tp) <= _VMEM_BLOCK_BUDGET_BYTES:
             Tp = multi_step_cm(Tp, Cm, spacing, k)
         elif (
             Tp.ndim in (2, 3)
@@ -164,7 +165,10 @@ def make_wave_deep_sweep(grid: GlobalGrid, k: int, dt, spacing):
             f"sweep depth {k} exceeds a local shard extent "
             f"{grid.local_shape}; ghost slices need width <= shard"
         )
-    from rocm_mpi_tpu.ops.pallas_kernels import _VMEM_BLOCK_BUDGET_BYTES
+    from rocm_mpi_tpu.ops.pallas_kernels import (
+        _VMEM_BLOCK_BUDGET_BYTES,
+        _compute_nbytes,
+    )
     from rocm_mpi_tpu.ops.wave_kernels import (
         masked_leapfrog_step,
         wave_multi_step_masked,
@@ -188,7 +192,7 @@ def make_wave_deep_sweep(grid: GlobalGrid, k: int, dt, spacing):
             hold, jnp.zeros_like(Up_), jnp.ones_like(Up_)
         )
         Cw = dt2 * C2p * M
-        if 2 * Up_.size * Up_.dtype.itemsize <= _VMEM_BLOCK_BUDGET_BYTES:
+        if 2 * _compute_nbytes(Up_) <= _VMEM_BLOCK_BUDGET_BYTES:
             U2, Up2 = wave_multi_step_masked(Up_, Upp, M, Cw, spacing, k)
         else:
             U2, Up2 = jnp_k_steps(Up_, Upp, M, Cw)

@@ -37,43 +37,73 @@ from rocm_mpi_tpu.utils.backend import apply_platform_override  # noqa: E402
 
 
 def error_curve(n=252, checkpoints=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512,
-                                    1000)):
-    """[(steps, rel_l2, rel_max, peak_f32, peak_bf16), ...] for the per-step
-    masked program at n² — shared by the chip harness and the CPU test."""
+                                    1000), schedule="perf",
+                vmem_chunk=None):
+    """[(steps, rel_l2, rel_max, peak_f32, peak_bf16), ...] at n² — shared
+    by the chip harness and the CPU test.
+
+    schedule "perf": the per-step masked program (state rounds to storage
+    dtype every step — the reference-parity schedule, advanced
+    incrementally). schedule "vmem": the whole-loop-in-VMEM multi-step
+    kernel, where bf16 is storage-only — f32 in-kernel compute, ONE
+    rounding per chunk — so each checkpoint is a fresh run from the IC at
+    that step count (chunk = gcd(steps, 256), or `vmem_chunk` to pin the
+    rounding cadence — interpret-mode tracing cost grows superlinearly
+    with the unroll, so the CPU test pins chunk=8; the cadence is part of
+    what's measured, so incremental advance would distort it).
+    """
     import jax
     import numpy as np
 
     from rocm_mpi_tpu.config import DiffusionConfig
     from rocm_mpi_tpu.models import HeatDiffusion
 
-    states = {}
-    advances = {}
+    if schedule not in ("perf", "vmem"):
+        raise ValueError(f"schedule must be perf|vmem, got {schedule!r}")
+
+    models = {}
     for dtype in ("f32", "bf16"):
         cfg = DiffusionConfig(
             global_shape=(n, n), lengths=(10.0, 10.0),
             nt=max(checkpoints), warmup=0, dtype=dtype, dims=(1, 1),
         )
-        model = HeatDiffusion(cfg)
-        T, Cp = model.init_state()
-        states[dtype] = (T, Cp)
-        advances[dtype] = model.advance_fn("perf")
+        models[dtype] = HeatDiffusion(cfg)
 
     rows = []
-    done = 0
-    for ck in checkpoints:
-        delta = ck - done
-        for dtype in ("f32", "bf16"):
-            T, Cp = states[dtype]
-            T = advances[dtype](T, Cp, delta)
-            states[dtype] = (T, Cp)
-        done = ck
-        a = np.asarray(states["f32"][0], dtype=np.float64)
-        b = np.asarray(states["bf16"][0], dtype=np.float64)
-        scale = np.abs(a).max()
-        rel_l2 = float(np.linalg.norm(b - a) / np.linalg.norm(a))
-        rel_max = float(np.abs(b - a).max() / scale)
-        rows.append((ck, rel_l2, rel_max, float(a.max()), float(b.max())))
+    if schedule == "perf":
+        states = {d: m.init_state() for d, m in models.items()}
+        advances = {d: m.advance_fn("perf") for d, m in models.items()}
+        done = 0
+        for ck in checkpoints:
+            delta = ck - done
+            out = {}
+            for dtype in ("f32", "bf16"):
+                T, Cp = states[dtype]
+                T = advances[dtype](T, Cp, delta)
+                states[dtype] = (T, Cp)
+                out[dtype] = T
+            done = ck
+            rows.append(_error_row(ck, out["f32"], out["bf16"]))
+    else:
+        for ck in checkpoints:
+            chunk = None if vmem_chunk is None else min(vmem_chunk, ck)
+            out = {}
+            for dtype in ("f32", "bf16"):
+                m = models[dtype]
+                r = m.run_vmem_resident(nt=ck, warmup=0, chunk=chunk)
+                out[dtype] = r.T
+            rows.append(_error_row(ck, out["f32"], out["bf16"]))
     return rows
+
+
+def _error_row(ck, a_dev, b_dev):
+    import numpy as np
+
+    a = np.asarray(a_dev, dtype=np.float64)
+    b = np.asarray(b_dev, dtype=np.float64)
+    rel_l2 = float(np.linalg.norm(b - a) / np.linalg.norm(a))
+    rel_max = float(np.abs(b - a).max() / np.abs(a).max())
+    return (ck, rel_l2, rel_max, float(a.max()), float(b.max()))
 
 
 def main(argv=None) -> int:
@@ -81,14 +111,23 @@ def main(argv=None) -> int:
     p.add_argument("--n", type=int, default=252)
     p.add_argument("--steps", type=int, default=1000,
                    help="last checkpoint (smaller for interpret-mode runs)")
+    p.add_argument("--schedule", default="perf", choices=["perf", "vmem"],
+                   help="perf: per-step (rounds to storage dtype every "
+                   "step); vmem: multi-step kernel (bf16 storage-only, "
+                   "f32 compute, one rounding per chunk)")
+    p.add_argument("--vmem-chunk", type=int, default=None,
+                   help="pin the vmem schedule's rounding cadence "
+                   "(interpret-mode runs need a small chunk — tracing "
+                   "cost grows superlinearly with the unroll)")
     args = p.parse_args(argv)
 
     apply_platform_override()
     import jax
 
     plat = jax.devices()[0].platform
-    print(f"device: {jax.devices()[0]} ({plat}); {args.n}² per-step masked "
-          f"program, f32 vs bf16 from the same Gaussian IC", flush=True)
+    print(f"device: {jax.devices()[0]} ({plat}); {args.n}² schedule="
+          f"{args.schedule}, f32 vs bf16 from the same Gaussian IC",
+          flush=True)
     if plat == "cpu":
         print("NOTE: interpret-mode Pallas (no accelerator) — error values "
               "are valid, rates are not measured here", flush=True)
@@ -98,7 +137,9 @@ def main(argv=None) -> int:
         cks.append(args.steps)
     print(f"{'steps':>6}  {'rel L2':>10}  {'rel max':>10}  "
           f"{'max(T) f32':>12}  {'max(T) bf16':>12}")
-    for ck, l2, mx, pa, pb in error_curve(args.n, tuple(cks)):
+    for ck, l2, mx, pa, pb in error_curve(args.n, tuple(cks),
+                                          schedule=args.schedule,
+                                          vmem_chunk=args.vmem_chunk):
         print(f"{ck:6d}  {l2:10.4%}  {mx:10.4%}  {pa:12.6f}  {pb:12.6f}",
               flush=True)
     return 0
