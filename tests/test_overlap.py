@@ -135,6 +135,31 @@ class TestDeepHalo:
             np.asarray(r_deep.T), np.asarray(r_ref.T), rtol=2e-5, atol=1e-6
         )
 
+    def test_bf16_deep_sweep_matches_ap(self):
+        # Storage-only bf16 (r4) through the sharded deep sweep: the
+        # width-k exchange moves bf16 ghosts, the local kernel computes
+        # f32 and rounds once per sweep — must track the bf16 GSPMD ap
+        # path to bf16 resolution.
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        m = self._model(shape=(32, 32), dims=(2, 2), nt=8, warmup=0)
+        import dataclasses
+
+        cfg16 = dataclasses.replace(m.config, dtype="bf16")
+        from rocm_mpi_tpu.models import HeatDiffusion
+
+        m16 = HeatDiffusion(cfg16)
+        r_deep = m16.run_deep(block_steps=4)
+        T0, Cp = m16.init_state()
+        ref = m16.advance_fn("ap")(jnp.copy(T0), Cp, 8)
+        np.testing.assert_allclose(
+            np.asarray(r_deep.T, dtype=np.float32),
+            np.asarray(ref, dtype=np.float32),
+            rtol=0.02, atol=0.02,  # bf16 resolution, not a numerics bug
+        )
+
     def test_hbm_branch_real_budget_multi_device(self, monkeypatch):
         # VERDICT r3 #7: the HBM routing scored with the PRODUCTION budget
         # (no shrunk threshold) — a genuinely HBM-class f32 shard on a
