@@ -369,6 +369,8 @@ def run_suite() -> None:
         "run_hbm_blocked", 328, 8, dtype="bf16")
     row("128³ 3D temporal-blocked (k=8)", (128, 128, 128), "run_hbm_blocked",
         3_208, 8)
+    row("128³ 3D deep-halo sweeps (k=8)", (128, 128, 128), "run_deep",
+        3_208, 8)
     row("128³ 3D per-step perf", (128, 128, 128), "run", 1_100, 100,
         variant="perf")
 
