@@ -335,6 +335,48 @@ def test_wave_deep_sweep_compiled():
     _close(got, ref)
 
 
+def test_bf16_storage_only_multi_step_compiled():
+    # r4: bf16 operands upcast to f32 inside the kernel and round back
+    # once per chunk (storage-only bf16). New Mosaic surface: the
+    # convert_element_type pair inside the unrolled VMEM loop must
+    # compile, and the result must track the f32 trajectory to bf16
+    # resolution instead of freezing (the per-step-rounding failure mode
+    # documented in docs/bf16_error_cpu252_perstep_r4.txt).
+    T32 = _rand((64, 64))
+    Cp32 = 1.0 + _rand((64, 64), seed=1)
+    lam, dt, spacing = 1.0, 1e-4, (0.1, 0.1)
+    ref = pk.fused_multi_step(T32, Cp32, lam, dt, spacing, 64, chunk=16)
+    got16 = pk.fused_multi_step(
+        T32.astype(jnp.bfloat16), Cp32.astype(jnp.bfloat16),
+        lam, dt, spacing, 64, chunk=16,
+    )
+    assert got16.dtype == jnp.bfloat16  # rounds back to storage dtype
+    np.testing.assert_allclose(
+        np.asarray(got16, np.float32), np.asarray(ref), rtol=0.02,
+        atol=0.02,
+    )
+
+
+def test_bf16_storage_only_tb_sweep_compiled():
+    # The temporal-blocked edition: bf16 slabs, f32 sweep arithmetic,
+    # one rounding per k-step sweep (the suite's bf16 tb row).
+    T32 = _rand((64, 48))
+    Cp32 = 1.0 + _rand((64, 48), seed=1)
+    lam, dt, spacing = 1.0, 1e-4, (0.1, 0.1)
+    ref = pk.fused_multi_step_hbm(
+        T32, Cp32, lam, dt, spacing, 32, block_steps=8
+    )
+    got16 = pk.fused_multi_step_hbm(
+        T32.astype(jnp.bfloat16), Cp32.astype(jnp.bfloat16),
+        lam, dt, spacing, 32, block_steps=8,
+    )
+    assert got16.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got16, np.float32), np.asarray(ref), rtol=0.02,
+        atol=0.02,
+    )
+
+
 def test_wave_hide_strip_kernels_compiled():
     # The wave hide variant's production strip combination (r4): the
     # 3-operand leapfrog Pallas kernel per region with (U_prev, C2) as
