@@ -740,21 +740,64 @@ DEFAULT_DEEP_STEPS = 32
 _TB_G = 8  # tb-sweep ghost-block rows (the TPU sublane tile) = max k/sweep
 _TB_TM = 16  # stripe height; with _TB_G ghosts, tuned to the VMEM limit
 assert _TB_TM % _TB_G == 0  # _stripe_ghost_specs' index maps require it
+_TB_MAX_STEPS = 16  # deepest supported sweep (the (g=16, tm=32) geometry)
 
 
-def hbm_class_edge(itemsize: int = 4, ghost: int = _TB_G) -> int:
-    """Smallest square-shard edge, aligned to the stripe height, whose
-    `ghost`-padded block exceeds the VMEM-resident budget — i.e. the
-    smallest shard a deep sweep routes to the temporal-blocked HBM kernel
-    (multi_step_cm_hbm) instead of the VMEM loop. The ONE sizing used by
-    the routing-coverage checks (__graft_entry__ dryrun,
-    tests/test_overlap.py), so a budget retune cannot leave them asserting
-    a stale routing claim. Alignment to _TB_TM also satisfies the HBM
-    sweep's stripe-divisibility precondition by construction.
+def tb_geometry(k: int) -> tuple[int, int]:
+    """(ghost rows g, stripe height tm) for a k-step temporal-blocked
+    sweep. k <= 8 keeps the chip-validated production geometry (8, 16);
+    deeper sweeps (k <= 16) use (16, 32) — half the HBM passes per step
+    (5 per 16 steps vs 5 per 8), at a (tm+2g)=64-row slab whose Mosaic
+    compile envelope at very wide rows is measured by
+    scripts/bench_tb_stripes.py's (32,16,16) case before any default
+    changes. Both satisfy tm % g == 0 (_stripe_ghost_specs) and k <= g
+    (the light-cone bound of _tb_kernel)."""
+    if 1 <= k <= _TB_G:
+        return _TB_G, _TB_TM
+    if _TB_G < k <= _TB_MAX_STEPS:
+        return 16, 32
+    raise ValueError(
+        f"temporal-blocked sweeps support 1 <= k <= {_TB_MAX_STEPS}, "
+        f"got {k}"
+    )
+
+
+def tb_slab_fits(k: int, shape, dtype) -> bool:
+    """True when a k-deep sweep's in-kernel slab — (tm+2g) rows at the f32
+    compute width — fits the measured Mosaic compile envelope
+    (_PS_SLAB_BUDGET_BYTES). The deep (16, 32) geometry's 64-row slab
+    exceeds it for f32 rows wider than ~9.7k columns (the flagship 12288²
+    included), so callers must gate on this instead of crashing the
+    compile: fused_multi_step_hbm/multi_step_cm_hbm raise with a clear
+    message, and the deep-halo routing falls back to the jnp path."""
+    g, tm = tb_geometry(k)
+    row = _compute_itemsize(dtype)
+    for n in shape[1:]:
+        row *= n
+    return (tm + 2 * g) * row <= _PS_SLAB_BUDGET_BYTES
+
+
+def hbm_class_edge(itemsize: int = 4, k: int = DEFAULT_TB_STEPS) -> int:
+    """Smallest square-shard edge whose k-padded block exceeds the
+    VMEM-resident budget — i.e. the smallest shard a k-deep sweep routes
+    to the temporal-blocked HBM kernel (multi_step_cm_hbm) instead of the
+    VMEM loop. The ONE sizing used by the routing-coverage checks
+    (__graft_entry__ dryrun, tests/test_overlap.py), so a budget or
+    geometry retune cannot leave them asserting a stale routing claim:
+    the edge iterates in tb_geometry(k) stripe-height units, which (with
+    2k divisible by that tm for the supported depths) keeps the k-padded
+    row count stripe-divisible by construction.
     """
-    n = _TB_TM
-    while (n + 2 * ghost) ** 2 * itemsize <= _VMEM_BLOCK_BUDGET_BYTES:
-        n += _TB_TM
+    g, tm = tb_geometry(k)
+    if (2 * k) % tm != 0:
+        raise ValueError(
+            f"hbm_class_edge needs 2k divisible by the stripe height "
+            f"(k={k}, tm={tm}) so the padded row count stays "
+            "stripe-divisible; pass k=8 or k=16"
+        )
+    n = tm  # n % tm == 0 and 2k % tm == 0 ⇒ (n + 2k) % tm == 0
+    while (n + 2 * k) ** 2 * itemsize <= _VMEM_BLOCK_BUDGET_BYTES:
+        n += tm
     return n
 
 
@@ -763,9 +806,11 @@ def fused_multi_step_hbm(T, Cp, lam, dt, spacing, n_steps, block_steps=None,
     """Advance a *single-shard* HBM-resident field `n_steps` via temporal
     blocking: each memory sweep advances the whole field `block_steps`
     steps. Per sweep, each stripe loads tm+2g rows per tm output rows —
-    with tm=16, g=8 that is 2 reads of T, 2 of Cm, 1 write = 5 whole-array
-    passes per k steps (~0.6 passes/step at k=8), instead of the 3 passes
-    *per step* the per-step path (and the reference's fused GPU kernel,
+    with the (g, tm) geometry picked per depth by tb_geometry: k <= 8 at
+    (8, 16) is 2 reads of T, 2 of Cm, 1 write = 5 whole-array passes per
+    k steps (~0.6 passes/step at k=8); k <= 16 at (16, 32) is the same 5
+    passes per 16 steps (~0.3/step) — instead of the 3 passes *per step*
+    the per-step path (and the reference's fused GPU kernel,
     perf.jl:3-13) pays by construction. The TPU grid executes
     stripes sequentially, so sweep s+1 only starts after sweep s wrote its
     stripes; correctness needs no inter-stripe synchronization beyond the
@@ -774,8 +819,10 @@ def fused_multi_step_hbm(T, Cp, lam, dt, spacing, n_steps, block_steps=None,
     per sweep — bf16 HBM traffic, f32 sweep arithmetic.
 
     Requires n_steps % block_steps == 0 (static check when n_steps is a
-    Python int; for traced n_steps the trip count floors) and axis-0 length
-    divisible by the stripe height (16). Measured on one v5e chip at 12288²
+    Python int; for traced n_steps the trip count floors), axis-0 length
+    divisible by the depth's stripe height (tb_geometry: 16 for k <= 8,
+    32 beyond), and — for the deeper geometry — rows narrow enough for
+    the slab to fit the Mosaic compile envelope (tb_slab_fits). Measured on one v5e chip at 12288²
     f32: ~2 ms/step — effective T_eff ~900 GB/s, above the chip's raw HBM
     bandwidth, which a 3-passes-per-step design can never reach (current
     measured numbers: BASELINE.md's results table).
@@ -785,12 +832,21 @@ def fused_multi_step_hbm(T, Cp, lam, dt, spacing, n_steps, block_steps=None,
     if not _supports_compiled(T.dtype) and not interpret:
         raise TypeError(f"Mosaic does not support {T.dtype}")
     k = DEFAULT_TB_STEPS if block_steps is None else block_steps
-    g, tm = _TB_G, _TB_TM  # ghost rows (also the max k) and stripe height
-    if not 1 <= k <= g:
-        raise ValueError(f"block_steps must be in [1, {g}], got {k}")
+    if not 1 <= k <= _TB_MAX_STEPS:
+        raise ValueError(
+            f"block_steps must be in [1, {_TB_MAX_STEPS}], got {k}"
+        )
+    g, tm = tb_geometry(k)  # ghost rows (>= k) and stripe height
+    if not tb_slab_fits(k, T.shape, T.dtype):
+        raise ValueError(
+            f"a k={k} sweep's (tm+2g)={tm + 2 * g}-row slab exceeds the "
+            f"Mosaic compile envelope ({_PS_SLAB_BUDGET_BYTES} B at f32 "
+            "compute width) for rows this wide; use k <= "
+            f"{_TB_G} or a narrower field"
+        )
     n0 = T.shape[0]
-    # n0 % tm == 0 with tm a multiple of g (asserted above) also gives the
-    # ghost-block alignment the stripe specs need.
+    # n0 % tm == 0 with tm a multiple of g also gives the ghost-block
+    # alignment the stripe specs need.
     if n0 % tm != 0 or (n0 // tm) < 2:
         raise ValueError(
             f"axis-0 length {n0} must be a multiple of {tm} (>= 2 stripes)"
@@ -832,7 +888,10 @@ def multi_step_cm_hbm(T, Cm, spacing, n_steps: int, interpret=None):
     block-edge staleness exactly as the VMEM kernel's roll wraparound
     does, and the in-sweep stripe ghosts (g rows) bound the stripe-level
     staleness, so `n_steps` ≤ g and ≤ ghost width keeps the crop exact.
-    Requires axis-0 length divisible by the stripe height (16).
+    Requires axis-0 length divisible by the depth's stripe height
+    (tb_geometry) and, for the deeper geometry, rows that fit the Mosaic
+    compile envelope (tb_slab_fits — the deep-halo router pre-checks and
+    falls back to the jnp path instead of tripping this).
     """
     if interpret is None:
         interpret = _interpret_default()
@@ -840,11 +899,19 @@ def multi_step_cm_hbm(T, Cm, spacing, n_steps: int, interpret=None):
         raise TypeError(f"Mosaic does not support {T.dtype}")
     if T.shape != Cm.shape:
         raise ValueError(f"shape mismatch: T {T.shape} vs Cm {Cm.shape}")
-    g, tm = _TB_G, _TB_TM
-    if not 1 <= n_steps <= g:
+    if not 1 <= n_steps <= _TB_MAX_STEPS:
         raise ValueError(
-            f"n_steps must be in [1, {g}] per HBM sweep, got {n_steps} "
-            "(the g-row stripe ghosts bound the in-sweep light cone)"
+            f"n_steps must be in [1, {_TB_MAX_STEPS}] per HBM sweep, got "
+            f"{n_steps} (the g-row stripe ghosts bound the in-sweep "
+            "light cone)"
+        )
+    g, tm = tb_geometry(int(n_steps))
+    if not tb_slab_fits(int(n_steps), T.shape, T.dtype):
+        raise ValueError(
+            f"a k={n_steps} sweep's (tm+2g)={tm + 2 * g}-row slab exceeds "
+            f"the Mosaic compile envelope for rows this wide; use k <= "
+            f"{_TB_G} or a narrower block (the deep-halo router falls "
+            "back to the jnp path automatically)"
         )
     n0 = T.shape[0]
     if n0 % tm != 0 or (n0 // tm) < 2:

@@ -76,8 +76,10 @@ def make_deep_sweep(grid: GlobalGrid, k: int, lam, dt, spacing):
     — the deep-halo design makes every chip's inner loop identical to the
     fastest single-chip loop, with communication only at sweep boundaries.
     Shards too large for VMEM route to the temporal-blocked HBM sweep
-    (multi_step_cm_hbm, k ≤ 8): the same schedule at every scale —
-    exchange once, advance k steps locally, crop.
+    (multi_step_cm_hbm; k ≤ 16 with a depth-dependent stripe geometry,
+    gated on the Mosaic compile envelope — tb_slab_fits): the same
+    schedule at every scale — exchange once, advance k steps locally,
+    crop.
     """
     if k < 1:
         raise ValueError(f"sweep depth k must be >= 1, got {k}")
@@ -87,12 +89,13 @@ def make_deep_sweep(grid: GlobalGrid, k: int, lam, dt, spacing):
             f"{grid.local_shape}; ghost slices need width <= shard"
         )
     from rocm_mpi_tpu.ops.pallas_kernels import (
-        _TB_G,
-        _TB_TM,
+        _TB_MAX_STEPS,
         _VMEM_BLOCK_BUDGET_BYTES,
         _compute_nbytes,
         multi_step_cm,
         multi_step_cm_hbm,
+        tb_geometry,
+        tb_slab_fits,
     )
 
     core = tuple(slice(k, -k) for _ in range(grid.ndim))
@@ -102,10 +105,10 @@ def make_deep_sweep(grid: GlobalGrid, k: int, lam, dt, spacing):
     def jnp_k_steps(Tp, Cm):
         # Any-shape/any-k fallback: the same roll+Cm semantics as the
         # Pallas kernels, XLA-fused. Slower (no temporal blocking) but
-        # never shape-constrained — the HBM kernel's stripe divisibility
-        # and k <= 8 bound do not always survive run_deep's depth
-        # degradation (effective_block_steps), and a crashed sweep is
-        # strictly worse than a slower one.
+        # never shape-constrained — the HBM kernel's stripe divisibility,
+        # k <= 16 bound, and compile-envelope gate do not always survive
+        # run_deep's depth degradation (effective_block_steps), and a
+        # crashed sweep is strictly worse than a slower one.
         for _ in range(k):
             lap = None
             for ax in range(Tp.ndim):
@@ -121,14 +124,16 @@ def make_deep_sweep(grid: GlobalGrid, k: int, lam, dt, spacing):
         Cpp = exchange_halo(Cpl, grid, width=k)
         Cm = padded_update_coefficient(Cpp, grid, k, lam, dt)
         n0p = Tp.shape[0]
+        tb_ok = (
+            k <= _TB_MAX_STEPS
+            and Tp.ndim in (2, 3)
+            and tb_slab_fits(k, Tp.shape, Tp.dtype)
+            and n0p % tb_geometry(k)[1] == 0
+            and (n0p // tb_geometry(k)[1]) >= 2
+        )
         if _compute_nbytes(Tp) <= _VMEM_BLOCK_BUDGET_BYTES:
             Tp = multi_step_cm(Tp, Cm, spacing, k)
-        elif (
-            Tp.ndim in (2, 3)
-            and k <= _TB_G
-            and n0p % _TB_TM == 0
-            and (n0p // _TB_TM) >= 2
-        ):
+        elif tb_ok:
             Tp = multi_step_cm_hbm(Tp, Cm, spacing, k)
         else:
             Tp = jnp_k_steps(Tp, Cm)

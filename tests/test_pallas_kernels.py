@@ -273,7 +273,13 @@ def test_temporal_blocked_validation():
     with pytest.raises(ValueError, match="multiple"):
         pk.fused_multi_step_hbm(T, Cp, 1.0, 1e-4, (0.5, 0.5), 12, block_steps=8)
     with pytest.raises(ValueError, match="block_steps"):
-        pk.fused_multi_step_hbm(T, Cp, 1.0, 1e-4, (0.5, 0.5), 16, block_steps=9)
+        pk.fused_multi_step_hbm(
+            T, Cp, 1.0, 1e-4, (0.5, 0.5), 34, block_steps=17
+        )
+    # 8 < k <= 16 is valid since r4 (the (16, 32) geometry) — but its
+    # taller stripes impose their own row-divisibility constraint.
+    with pytest.raises(ValueError, match="axis-0"):
+        pk.fused_multi_step_hbm(T, Cp, 1.0, 1e-4, (0.5, 0.5), 18, block_steps=9)
     with pytest.raises(ValueError, match="axis-0"):
         pk.fused_multi_step_hbm(
             T[:20], Cp[:20], 1.0, 1e-4, (0.5, 0.5), 8, block_steps=8
@@ -309,3 +315,106 @@ def test_interpret_default_raises_on_unknown_accelerator(monkeypatch):
     assert pk._interpret_default() is True
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     assert pk._interpret_default() is False
+
+
+def test_tb_geometry_and_deep_sweep_k16():
+    # The deeper (16, 32) temporal-blocking geometry (r4): k=16 per HBM
+    # sweep — half the passes per step of the (8, 16) geometry — must
+    # reproduce 16 per-step updates exactly (light cone k <= g).
+    assert pk.tb_geometry(8) == (8, 16)
+    assert pk.tb_geometry(16) == (16, 32)
+    with pytest.raises(ValueError):
+        pk.tb_geometry(17)
+
+    T = _rand((64, 48), dtype=jnp.float32)
+    Cp = 1.0 + _rand((64, 48), seed=1, dtype=jnp.float32)
+    lam, dt, spacing = 1.0, 1e-4, (0.1, 0.1)
+    ref = T
+    for _ in range(16):
+        ref = step_fused(ref, Cp, lam, dt, spacing)
+    got = pk.fused_multi_step_hbm(
+        T, Cp, lam, dt, spacing, 16, block_steps=16
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-5, atol=1e-6
+    )
+
+
+def test_deep_sweep_routes_hbm_at_k16(monkeypatch):
+    # Deep sweeps beyond the old k<=8 HBM bound: a k=16 sweep on a
+    # (shrunk-budget) HBM-class shard must route to the temporal-blocked
+    # kernel via the (16, 32) geometry and agree with per-step perf.
+    from rocm_mpi_tpu.config import DiffusionConfig
+    from rocm_mpi_tpu.models import HeatDiffusion
+
+    monkeypatch.setattr(pk, "_VMEM_BLOCK_BUDGET_BYTES", 1024)
+    calls = []
+    orig = pk.multi_step_cm_hbm
+    monkeypatch.setattr(
+        pk, "multi_step_cm_hbm",
+        lambda *a, **k: (calls.append(1), orig(*a, **k))[1],
+    )
+    cfg = DiffusionConfig(
+        global_shape=(64, 64), lengths=(10.0, 10.0), nt=16, warmup=0,
+        dtype="f32", dims=(2, 1),
+    )
+    m = HeatDiffusion(cfg)
+    # shard (32, 64) + 2·16 ghosts → padded (64, 96): 64 % tm(32) == 0.
+    r_deep = m.run_deep(block_steps=16)
+    assert calls, "k=16 deep sweep did not route to multi_step_cm_hbm"
+    r_ref = HeatDiffusion(cfg).run(variant="perf")
+    np.testing.assert_allclose(
+        np.asarray(r_deep.T), np.asarray(r_ref.T), rtol=2e-5, atol=1e-6
+    )
+
+
+def test_tb_slab_envelope_guard():
+    # tb_geometry rejects non-positive and too-deep k (the full contract).
+    for bad in (0, -3, 17):
+        with pytest.raises(ValueError):
+            pk.tb_geometry(bad)
+    # The deep (16, 32) geometry's 64-row slab exceeds the Mosaic compile
+    # envelope at flagship-wide f32 rows; the kernels must refuse loudly
+    # (and the deep router falls back to jnp instead — tested below).
+    assert pk.tb_slab_fits(8, (12288, 12288), jnp.float32)
+    assert not pk.tb_slab_fits(16, (12288, 12288), jnp.float32)
+    assert pk.tb_slab_fits(16, (12288, 4096), jnp.float32)
+    T = jnp.zeros((12320, 12288), jnp.float32)
+    with pytest.raises(ValueError, match="compile envelope"):
+        pk.multi_step_cm_hbm(T, T, (0.1, 0.1), 16)
+    # hbm_class_edge stays stripe-divisible for both supported depths.
+    for k in (8, 16):
+        n = pk.hbm_class_edge(k=k)
+        tm = pk.tb_geometry(k)[1]
+        assert (n + 2 * k) % tm == 0
+        assert (n + 2 * k) ** 2 * 4 > pk._VMEM_BLOCK_BUDGET_BYTES
+    with pytest.raises(ValueError, match="divisible"):
+        pk.hbm_class_edge(k=5)
+
+
+def test_deep_sweep_wide_rows_k16_falls_back_to_jnp(monkeypatch):
+    # A k=16 sweep whose slab would blow the compile envelope must route
+    # to the jnp fallback (the pre-r4 behavior), not crash: shrink the
+    # envelope so a small test shard counts as "too wide".
+    from rocm_mpi_tpu.config import DiffusionConfig
+    from rocm_mpi_tpu.models import HeatDiffusion
+
+    monkeypatch.setattr(pk, "_VMEM_BLOCK_BUDGET_BYTES", 1024)
+    monkeypatch.setattr(pk, "_PS_SLAB_BUDGET_BYTES", 1024)
+    calls = []
+    orig = pk.multi_step_cm_hbm
+    monkeypatch.setattr(
+        pk, "multi_step_cm_hbm",
+        lambda *a, **k: (calls.append(1), orig(*a, **k))[1],
+    )
+    cfg = DiffusionConfig(
+        global_shape=(64, 64), lengths=(10.0, 10.0), nt=16, warmup=0,
+        dtype="f32", dims=(2, 1),
+    )
+    m = HeatDiffusion(cfg)
+    r_deep = m.run_deep(block_steps=16)
+    assert not calls, "router ignored the compile-envelope gate"
+    r_ref = HeatDiffusion(cfg).run(variant="perf")
+    np.testing.assert_allclose(
+        np.asarray(r_deep.T), np.asarray(r_ref.T), rtol=2e-5, atol=1e-6
+    )
