@@ -188,6 +188,31 @@ def test_wave_vmem_multi_step_matches_ap():
     )
 
 
+def test_wave_vmem_equal_spacing_a_form_matches_ap():
+    # The r4 A-form branch of _wave_multi_step_kernel fires only for
+    # equal spacing + chunk >= 4 (the default _cfg is deliberately
+    # unequal, exercising the direct form): a square grid with equal
+    # lengths takes the prologue-hoisted form, which must reproduce the
+    # ap trajectory and hold Dirichlet edges bitwise.
+    from rocm_mpi_tpu.ops.wave_kernels import wave_multi_step
+
+    cfg = _cfg(shape=(24, 24))  # lengths (10, 10) → equal spacing
+    model = AcousticWave(cfg, devices=jax.devices()[:1])
+    U, Uprev, C2 = model.init_state()
+    edge0 = np.asarray(U)[0].copy()
+    ref, ref_prev = model.advance_fn("ap")(
+        jnp.copy(U), jnp.copy(Uprev), C2, 24
+    )
+    got, got_prev = wave_multi_step(
+        U, Uprev, C2, cfg.dt, cfg.spacing, 24, chunk=8
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-12)
+    np.testing.assert_allclose(
+        np.asarray(got_prev), np.asarray(ref_prev), rtol=1e-12
+    )
+    np.testing.assert_array_equal(np.asarray(got)[0], edge0)  # bitwise hold
+
+
 def test_wave_run_vmem_resident():
     cfg = _cfg(nt=48, warmup=16)
     model = AcousticWave(cfg, devices=jax.devices()[:1])
