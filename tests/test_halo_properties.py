@@ -11,6 +11,8 @@ here the property is machine-checked).
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -56,7 +58,7 @@ def halo_cases(draw):
 
 
 @given(halo_cases())
-@settings(max_examples=25, deadline=None)
+@settings(max_examples=int(os.environ.get("RMT_PROP_EXAMPLES", "25")), deadline=None)
 def test_exchange_matches_numpy_oracle(case):
     shape, dims, width = case
     grid = init_global_grid(

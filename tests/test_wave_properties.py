@@ -6,6 +6,8 @@ the machine-checked generalization of test_wave.py's hand-picked cases
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 import pytest
 
@@ -38,7 +40,7 @@ def wave_cases(draw):
 
 
 @given(wave_cases())
-@settings(max_examples=20, deadline=None)
+@settings(max_examples=int(os.environ.get("RMT_PROP_EXAMPLES", "20")), deadline=None)
 def test_wave_perf_matches_oracle_property(case):
     shape, dims, n_steps = case
     cfg = _cfg(shape=shape, dims=dims, nt=max(n_steps, 2) + 1, warmup=0)
