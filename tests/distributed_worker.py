@@ -15,8 +15,8 @@ distributed.maybe_initialize_distributed, gather.gather_to_host0's
 process_count>1 path, and metrics.force's non-addressable branch — plus
 the deep-halo sweep (width-k exchange crossing the process boundary, the
 flagship multi-chip schedule) against the same oracle, and the wave
-workload's perf path and deep sweep (the state-pair exchange crossing the
-same boundary) against the numpy leapfrog oracle.
+workload's perf, hide (overlap), and deep-sweep paths (the state-pair
+exchange crossing the same boundary) against the numpy leapfrog oracle.
 """
 
 import os
@@ -100,6 +100,11 @@ def main() -> int:
     U0_full = gather_to_host0(U)  # collective: both processes participate
     Uw, _ = wave.advance_fn("perf")(jnp.copy(U), jnp.copy(Uprev), C2, n_steps)
     metrics.force(Uw)
+    # r4: the wave hide (overlap) variant's strip-decomposed exchange also
+    # crosses the process boundary; must land on the same state as perf.
+    Uh, _ = wave.advance_fn("hide")(jnp.copy(U), jnp.copy(Uprev), C2, n_steps)
+    metrics.force(Uh)
+    full_wave_hide = gather_to_host0(Uh)
     wsweep = jax.jit(
         make_wave_deep_sweep(
             wave.grid, n_steps, wcfg.jax_dtype(wcfg.dt), wcfg.spacing
@@ -150,6 +155,9 @@ def main() -> int:
             full_wave, want_wave, rtol=1e-12, atol=1e-13
         )
         np.testing.assert_allclose(
+            full_wave_hide, want_wave, rtol=1e-12, atol=1e-13
+        )
+        np.testing.assert_allclose(
             full_wave_deep, want_wave, rtol=1e-12, atol=1e-13
         )
         print("DISTRIBUTED_OK", flush=True)
@@ -157,6 +165,7 @@ def main() -> int:
         assert full is None
         assert full_deep is None
         assert full_wave is None and full_wave_deep is None
+        assert full_wave_hide is None
     jax.distributed.shutdown()
     return 0
 
