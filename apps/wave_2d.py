@@ -61,6 +61,11 @@ def make_parser():
         help="trace the timed loop with jax.profiler into DIR (the "
         "--profile convention of the diffusion apps, SURVEY.md §5.1)",
     )
+    p.add_argument(
+        "--save-field", default=None, metavar="PATH.npy",
+        help="dump the final gathered displacement as .npy on process 0 "
+        "(the machine-readable artifact, SURVEY.md §5.4)",
+    )
     return p
 
 
@@ -129,8 +134,19 @@ def main(argv=None) -> int:
     if args.vis and len(shape) != 2:
         log0("--vis is 2D-only (heatmap); skipping the artifact")
         args.vis = False
+    U_v = (
+        gather_to_host0(result.U)
+        if (args.vis or args.save_field)
+        else None
+    )
+    if args.save_field and U_v is not None:
+        import numpy as np
+
+        out = pathlib.Path(args.save_field)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        np.save(out, U_v)
+        log0(f"wrote {out}")
     if args.vis:
-        U_v = gather_to_host0(result.U)
         if U_v is not None:
             path = OUTPUT_DIR / viz.artifact_name(
                 f"wave_{label}", grid.nprocs, grid.global_shape

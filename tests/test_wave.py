@@ -307,16 +307,22 @@ def test_wave_app_runs():
          "--dims", "1,1", "--vmem"]
     )
     assert rc == 0
-    # --profile writes a trace directory (the §5.1 convention).
+    # --profile writes a trace directory (the §5.1 convention) and
+    # --save-field the .npy artifact (§5.4), together in one run.
     import tempfile
 
     with tempfile.TemporaryDirectory() as td:
+        field = pathlib.Path(td) / "field.npy"
         rc = app.main(
             ["--nx", "24", "--ny", "20", "--nt", "12", "--warmup", "4",
-             "--dims", "2,2", "--variant", "hide", "--profile", td]
+             "--dims", "2,2", "--variant", "hide", "--profile", td,
+             "--save-field", str(field)]
         )
         assert rc == 0
         assert any(pathlib.Path(td).iterdir()), "profile trace not written"
+        import numpy as np
+
+        assert np.load(field).shape == (24, 20)
     rc = app.main(
         ["--nx", "12", "--ny", "10", "--nz", "8", "--nt", "12",
          "--warmup", "4", "--dims", "2,2,2"]
