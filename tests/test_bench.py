@@ -107,6 +107,22 @@ def test_bench_survives_slow_backend_init():
     assert obj["value"] > 0
 
 
+def test_prime_cache_no_accelerator_is_clean_noop():
+    """startup.sh runs `bench.py --prime-cache` unconditionally; without
+    an accelerator it must exit 0 with the explicit skip message (a crash
+    here would make bootstrap misreport the chip tunnel as the culprit —
+    the startup.sh rc-distinction depends on this)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--prime-cache"],
+        capture_output=True, text=True, timeout=120, cwd=str(REPO), env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    assert "nothing to prime" in proc.stderr
+    assert proc.stdout.strip() == ""  # no stray contract line
+
+
 def test_env_budget_malformed(monkeypatch, capsys):
     # The malformed-budget fallback is a pure function; unit-test it
     # instead of paying two full smoke-child subprocess runs.
