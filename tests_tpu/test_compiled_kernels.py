@@ -335,6 +335,23 @@ def test_wave_deep_sweep_compiled():
     _close(got, ref)
 
 
+def test_temporal_blocked_k16_geometry_compiled():
+    # r4: the deeper (g=16, tm=32) sweep geometry — 64-row slabs, 16
+    # unrolled steps — must compile on Mosaic at narrow widths (wide rows
+    # are envelope-gated; the width boundary itself is measured by
+    # scripts/bench_tb_stripes.py, not asserted here).
+    T32 = _rand((64, 48))
+    Cp32 = 1.0 + _rand((64, 48), seed=1)
+    lam, dt, spacing = 1.0, 1e-4, (0.1, 0.1)
+    ref = T32
+    for _ in range(16):
+        ref = step_fused(ref, Cp32, lam, dt, spacing)
+    got = pk.fused_multi_step_hbm(
+        T32, Cp32, lam, dt, spacing, 16, block_steps=16
+    )
+    _close(got, ref)
+
+
 def test_bf16_storage_only_multi_step_compiled():
     # r4: bf16 operands upcast to f32 inside the kernel and round back
     # once per chunk (storage-only bf16). New Mosaic surface: the
