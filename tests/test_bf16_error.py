@@ -40,6 +40,30 @@ def test_bf16_error_bound_at_run_length():
     assert peak_bf16 < by_steps[4][4], "bf16 peak stopped decaying"
 
 
+def test_bf16_rounding_is_per_kernel_not_per_step():
+    """Mechanical proof of the storage-only contract: the traced multi-step
+    kernel contains exactly 3 dtype conversions for bf16 operands — T in,
+    Cm in, result out — INDEPENDENT of the step count. A regression to
+    per-step rounding (storage-width arithmetic) would scale the count
+    with the unroll."""
+    import jax
+    import jax.numpy as jnp
+
+    import rocm_mpi_tpu.ops.pallas_kernels as pk
+
+    T = jnp.zeros((32, 32), jnp.bfloat16)
+    Cm = jnp.zeros((32, 32), jnp.bfloat16)
+    counts = {
+        n: str(
+            jax.make_jaxpr(
+                lambda a, b, n=n: pk.multi_step_cm(a, b, (0.1, 0.1), n)
+            )(T, Cm)
+        ).count("convert_element_type")
+        for n in (4, 16)
+    }
+    assert counts[4] == counts[16] == 3, counts
+
+
 def test_bf16_storage_only_multi_step_curve_flat():
     """The r4 fix: on the multi-step schedules bf16 is STORAGE-ONLY —
     f32 in-kernel compute, one rounding per chunk — so the error stays at
