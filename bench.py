@@ -117,22 +117,9 @@ def _setup_compilation_cache() -> None:
     compile-machine feature sets that can SIGILL on feature mismatch
     (observed warning in the CPU contract tests).
     """
-    import jax
+    from rocm_mpi_tpu.utils.backend import enable_persistent_cache
 
-    if not _accelerated():
-        return
-    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
-    )
-    for knob, val in (
-        ("jax_compilation_cache_dir", cache_dir),
-        ("jax_persistent_cache_min_compile_time_secs", 0.0),
-        ("jax_persistent_cache_min_entry_size_bytes", 0),
-    ):
-        try:
-            jax.config.update(knob, val)
-        except Exception:  # noqa: BLE001
-            pass
+    enable_persistent_cache()
 
 
 def _fault_seconds(name: str) -> float:

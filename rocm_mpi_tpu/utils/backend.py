@@ -13,6 +13,64 @@ from __future__ import annotations
 import os
 
 
+def enable_persistent_cache() -> None:
+    """Point this process at the repo's persistent XLA compilation cache
+    (.jax_cache/, overridable via JAX_COMPILATION_CACHE_DIR).
+
+    On the tunneled chip a first Mosaic compile costs tens of seconds and
+    the tunnel flaps, so every measurement entry point opts in: a re-run
+    after a killed attempt then skips compiles the dead process already
+    paid for. Accelerator-only for the same reason as
+    bench._setup_compilation_cache — XLA:CPU AOT entries embed the compile
+    machine's CPU feature set and can SIGILL on mismatch. Best-effort: an
+    older jax without the knobs must not break a measurement run.
+    """
+    import jax
+
+    try:
+        if jax.default_backend() in ("cpu",):
+            return
+    except Exception:  # noqa: BLE001 — backend probe itself may fail
+        return
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        ".jax_cache",
+    )
+    for knob, val in (
+        ("jax_compilation_cache_dir", cache_dir),
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+        ("jax_persistent_cache_min_entry_size_bytes", 0),
+    ):
+        try:
+            jax.config.update(knob, val)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def require_accelerator(script: str) -> None:
+    """Exit 2 when jax resolved to the CPU fallback.
+
+    Chip measurement scripts call this so a mid-queue tunnel drop (jax
+    silently falls back to CPU when the accelerator plugin fails init)
+    exits nonzero — the queue then records an INCOMPLETE artifact and
+    retries later, instead of promoting interpret-mode timings as the
+    completed chip measurement. One policy, one exit code, one message.
+    """
+    import sys
+
+    import jax
+
+    if jax.devices()[0].platform == "cpu":
+        print(
+            f"{script}: CPU fallback — refusing to measure (an accelerator "
+            "backend is required; interpret-mode numbers must never land "
+            "in a chip-labeled artifact)",
+            file=sys.stderr,
+            flush=True,
+        )
+        raise SystemExit(2)
+
+
 def apply_platform_override() -> None:
     """Re-apply a JAX_PLATFORMS env override via jax.config (no-op when
     the var is unset or the backend is already initialized)."""

@@ -33,7 +33,11 @@ import sys
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 
-from rocm_mpi_tpu.utils.backend import apply_platform_override  # noqa: E402
+from rocm_mpi_tpu.utils.backend import (
+    apply_platform_override,
+    enable_persistent_cache,
+    require_accelerator,
+)  # noqa: E402
 
 
 def error_curve(n=252, checkpoints=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512,
@@ -119,11 +123,18 @@ def main(argv=None) -> int:
                    help="pin the vmem schedule's rounding cadence "
                    "(interpret-mode runs need a small chunk — tracing "
                    "cost grows superlinearly with the unroll)")
+    p.add_argument("--require-accelerator", action="store_true",
+                   help="exit nonzero on the CPU fallback (queue runs: a "
+                   "chip-labeled artifact must never hold interpret-mode "
+                   "curves)")
     args = p.parse_args(argv)
 
     apply_platform_override()
+    enable_persistent_cache()
     import jax
 
+    if args.require_accelerator:
+        require_accelerator("bench_bf16_error.py")
     plat = jax.devices()[0].platform
     print(f"device: {jax.devices()[0]} ({plat}); {args.n}² schedule="
           f"{args.schedule}, f32 vs bf16 from the same Gaussian IC",

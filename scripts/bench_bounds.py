@@ -158,6 +158,9 @@ def launch_floor(n=252, reps=200_000):
 
 
 if __name__ == "__main__":
+    from rocm_mpi_tpu.utils.backend import enable_persistent_cache
+
+    enable_persistent_cache()
     if jax.devices()[0].platform == "cpu":
         sys.exit("bench_bounds.py needs an accelerator backend")
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 12288

@@ -50,6 +50,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from rocm_mpi_tpu.ops.pallas_kernels import edge_masked_cm
 from rocm_mpi_tpu.utils import metrics
+from rocm_mpi_tpu.utils.backend import enable_persistent_cache, require_accelerator
 
 N = 252
 PAD = 256
@@ -118,8 +119,10 @@ def make_advance(shape, inv_d2, form):
 
 
 def main():
+    enable_persistent_cache()
     timed = int(sys.argv[1]) if len(sys.argv) > 1 else 8_388_608
     timed -= timed % CHUNK
+    require_accelerator("bench_kernel_forms.py")
     dev = jax.devices()[0]
     print(f"device: {dev} | {N}² f32 chunk={CHUNK} | warmup {WARMUP} | "
           f"timed {timed}")

@@ -33,12 +33,23 @@ TIMED_DEFAULT = 65_536
 WARMUP = 4_096
 
 
-from rocm_mpi_tpu.utils.backend import apply_platform_override  # noqa: E402
+from rocm_mpi_tpu.utils.backend import (
+    apply_platform_override,
+    enable_persistent_cache,
+    require_accelerator,
+)  # noqa: E402
 
 
 def main(argv=None) -> int:
+    argv = list(argv) if argv else []
+    # Queue runs pass --require-accelerator so a mid-queue CPU fallback
+    # exits nonzero (→ INCOMPLETE artifact, retried) instead of promoting
+    # interpret-mode numbers as the completed chip measurement.
+    require_accel = "--require-accelerator" in argv
+    argv = [a for a in argv if a != "--require-accelerator"]
     timed = int(argv[0]) if argv else TIMED_DEFAULT
     apply_platform_override()
+    enable_persistent_cache()
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -53,6 +64,8 @@ def main(argv=None) -> int:
     )
     from rocm_mpi_tpu.utils import metrics
 
+    if require_accel:
+        require_accelerator("bench_strip_overhead.py")
     dev = jax.devices()[0]
     on_cpu = dev.platform == "cpu"
     if on_cpu:

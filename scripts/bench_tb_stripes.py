@@ -27,6 +27,7 @@ from jax import lax
 
 from rocm_mpi_tpu.ops.pallas_kernels import _make_tb_sweep, edge_masked_cm
 from rocm_mpi_tpu.utils import metrics
+from rocm_mpi_tpu.utils.backend import enable_persistent_cache, require_accelerator
 
 N = 12288
 CHECK_N = 768
@@ -62,8 +63,11 @@ def state(n, key=0):
 
 
 def main():
+    enable_persistent_cache()
     timed = int(sys.argv[1]) if len(sys.argv) > 1 else 1600
-    print(f"device: {jax.devices()[0]} | {N}² f32 | timed {timed} steps")
+    require_accelerator("bench_tb_stripes.py")
+    dev = jax.devices()[0]
+    print(f"device: {dev} | {N}² f32 | timed {timed} steps")
 
     # Correctness referee at CHECK_N: production config, 32 steps.
     Tc, Cmc, invc = state(CHECK_N)

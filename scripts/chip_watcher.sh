@@ -1,0 +1,84 @@
+#!/usr/bin/env bash
+# Tunnel-recovery watcher for the round-4 chip queue.
+#
+# The chip tunnel flaps (documented multi-hour outages in BASELINE.md); a
+# measurement session must start the moment a healthy window opens. This
+# loop probes with a bounded-timeout trivial jit every ~60 s; on the first
+# healthy probe it runs, in priority order:
+#   1. the compiled-Mosaic test tier (tests_tpu/, live-tee'd log)
+#   2. scripts/run_chip_queue.sh (the BASELINE.md measurement debt)
+# The persistent XLA compilation cache is enabled for every child, so a
+# mid-queue drop never re-pays compiles already done.
+#
+# Usage: nohup scripts/chip_watcher.sh > .watcher_r4.log 2>&1 &
+# (log path deliberately untracked — the live file grows while the watcher
+# runs; commit a snapshot into docs/ only after it finishes)
+set -u
+cd "$(dirname "$0")/.."
+
+# Children honor this dir via utils.backend.enable_persistent_cache() /
+# tests_tpu/conftest.py (which also set the persist-everything thresholds
+# themselves — no point exporting those here, they'd be overridden).
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$PWD/.jax_cache}"
+
+DEADLINE=$(( $(date +%s) + ${WATCH_HOURS:-10} * 3600 ))
+
+probe() {
+  # A CPU fallback must NOT count as healthy: when the accelerator plugin
+  # fails init, jax can fall back to CPU and the trivial jit would pass —
+  # firing the one-shot queue into CPU garbage and losing the real window.
+  timeout -k 10 180 python - <<'EOF'
+import jax, jax.numpy as jnp
+dev = jax.devices()[0]
+if dev.platform == "cpu":
+    raise SystemExit(f"probe: CPU fallback ({dev}), tunnel not healthy")
+x = jnp.ones((128, 128), jnp.float32)
+r = jax.jit(lambda a: a * 2 + 1)(x)
+r.block_until_ready()
+print("probe ok on", dev)
+EOF
+}
+
+tier_done() {
+  # The log is only promoted to this path on pytest rc=0 (else it gets an
+  # INCOMPLETE header), so done = exists, has a pass count, no header.
+  [ -s docs/tpu_test_log_r4.txt ] \
+    && grep -qE "[0-9]+ passed" docs/tpu_test_log_r4.txt \
+    && ! grep -q "^INCOMPLETE" docs/tpu_test_log_r4.txt
+}
+
+n=0
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  n=$((n + 1))
+  echo "[watcher] probe $n at $(date -u +%H:%M:%S)"
+  if probe; then
+    if tier_done; then
+      echo "[watcher] compiled tier already passed — skipping"
+    else
+      echo "[watcher] tunnel healthy — running compiled tier"
+      timeout -k 15 3000 python -m pytest tests_tpu/ -q 2>&1 | tee docs/tpu_test_log_r4.txt.part
+      rc=${PIPESTATUS[0]}
+      if [ "$rc" -eq 0 ]; then
+        mv docs/tpu_test_log_r4.txt.part docs/tpu_test_log_r4.txt
+      else
+        { echo "INCOMPLETE rc=$rc at $(date -u +%FT%TZ)"
+          cat docs/tpu_test_log_r4.txt.part; } > docs/tpu_test_log_r4.txt
+        rm -f docs/tpu_test_log_r4.txt.part
+      fi
+      echo "[watcher] compiled tier rc=$rc — running measurement queue"
+    fi
+    if bash scripts/run_chip_queue.sh && tier_done; then
+      # Don't stop at the first healthy window: a mid-queue flap leaves
+      # INCOMPLETE artifacts, and run()'s skip-complete logic makes later
+      # passes cheap — keep watching until everything is actually done.
+      echo "[watcher] all artifacts complete at $(date -u +%H:%M:%S)"
+      exit 0
+    fi
+    echo "[watcher] incomplete artifacts remain; continuing to watch"
+  else
+    echo "[watcher] tunnel down"
+  fi
+  sleep 60
+done
+echo "[watcher] deadline reached with work remaining"
+exit 1

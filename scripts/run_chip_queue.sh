@@ -1,0 +1,50 @@
+#!/usr/bin/env bash
+# Round-4 chip measurement queue (BASELINE.md "pending" debt).
+# Runs every chip-gated harness in priority order, tee-ing each artifact
+# into docs/. Serialized on purpose: one process owns the TPU. Each entry
+# gets a hard timeout so one wedged run can't starve the rest; artifacts
+# are written incrementally so a mid-queue tunnel drop keeps what finished.
+set -u
+cd "$(dirname "$0")/.."
+
+QUEUE_ARTIFACTS=()
+
+run() { # name timeout_s cmd...
+  local name="$1" t="$2"; shift 2
+  local out="docs/${name}_r4.txt"
+  QUEUE_ARTIFACTS+=("$out")
+  if [ -s "$out" ] && ! grep -q "^INCOMPLETE" "$out"; then
+    echo "== $name: artifact $out already complete, skipping =="
+    return 0
+  fi
+  echo "== $name (timeout ${t}s) =="
+  # tee to a temp file and promote only on rc=0, so a re-run that dies
+  # mid-entry can never destroy a previously completed artifact.
+  timeout -k 10 "$t" "$@" 2>&1 | tee "${out}.part"
+  local rc=${PIPESTATUS[0]}
+  if [ "$rc" -eq 0 ]; then
+    mv "${out}.part" "$out"
+  else
+    { echo "INCOMPLETE rc=$rc at $(date -u +%FT%TZ)"; cat "${out}.part"; } > "$out"
+    rm -f "${out}.part"
+  fi
+  echo "-- $name rc=$rc"
+}
+
+run kernel_forms    1800 python scripts/bench_kernel_forms.py
+run strip_overhead  1800 python scripts/bench_strip_overhead.py --require-accelerator
+run tb_stripes      2400 python scripts/bench_tb_stripes.py
+run bf16_error_chip 1800 python scripts/bench_bf16_error.py --require-accelerator
+run bf16_error_vmem_chip 1800 python scripts/bench_bf16_error.py --schedule vmem --require-accelerator
+run bounds          1800 python scripts/bench_bounds.py
+# Completeness is judged ONLY over the artifacts this queue owns — other
+# docs/*_r4.txt files (the watcher's tier log, committed CPU-side curves)
+# are not this script's to report on.
+incomplete=0
+for out in "${QUEUE_ARTIFACTS[@]}"; do
+  if [ ! -s "$out" ] || grep -q "^INCOMPLETE" "$out"; then
+    incomplete=$((incomplete + 1))
+  fi
+done
+echo "== queue done (INCOMPLETE artifacts: $incomplete) =="
+exit "$incomplete"

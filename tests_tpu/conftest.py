@@ -17,6 +17,15 @@ the point is the real backend, f32, compiled (not interpret) Pallas.
 import jax
 import pytest
 
+# Persistent XLA compilation cache: on the tunneled chip a first compile
+# costs tens of seconds and the tunnel flaps, so a re-run of this tier must
+# never re-pay compiles a killed run already did. The helper carries the
+# accelerator-only guard (XLA:CPU AOT entries can SIGILL on feature
+# mismatch) and stays best-effort on older jax.
+from rocm_mpi_tpu.utils.backend import enable_persistent_cache
+
+enable_persistent_cache()
+
 
 def pytest_collection_modifyitems(config, items):
     import rocm_mpi_tpu.ops.pallas_kernels as pk
