@@ -1,5 +1,7 @@
 """Physics models: the diffusion flagship at each performance level, plus
-the acoustic-wave workload (the framework-generality demo)."""
+the acoustic-wave and shallow-water workloads (the framework-generality
+demos — single-field, state-pair, and coupled-multi-field stencils)."""
 
 from rocm_mpi_tpu.models.diffusion import HeatDiffusion, RunResult  # noqa: F401
+from rocm_mpi_tpu.models.swe import SWEConfig, ShallowWater  # noqa: F401
 from rocm_mpi_tpu.models.wave import AcousticWave, WaveConfig  # noqa: F401

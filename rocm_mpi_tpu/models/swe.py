@@ -1,0 +1,404 @@
+"""Linearized shallow-water equations — the framework's third workload.
+
+Purpose: where the wave model shows the framework layers are
+workload-agnostic for a *state pair with one exchanged field*, this model
+exercises the genuinely coupled case — ndim+1 fields (surface height h and
+one face velocity per axis) whose updates read neighbors of *different*
+fields — on the same mesh/halo/Pallas/schedule machinery. This is the
+shape of real multi-field stencil codes (ocean/atmosphere dynamical cores,
+staggered-grid electromagnetics), and it is what drove the pytree-state
+generalization of parallel.overlap.make_overlap_step (r4). No reference
+analog (the reference ships exactly one physics model): additive, not
+parity.
+
+Physics and scheme: see ops/swe_kernels.py — forward-backward
+(symplectic-Euler) time stepping of the C-grid-staggered linear system
+
+    h' = h − dt·H·∇⁻·u,    u_a' = M_a ∘ (u_a − dt·g·∂a⁺ h')
+
+in a closed basin (wall faces masked to exactly 0.0 — mask-as-data). Two
+machine-checkable invariants the other workloads cannot offer together:
+
+  * EXACT mass conservation — the closed-basin divergence telescopes to
+    wall−wall = 0, so sum(h) is constant to fp rounding;
+  * algebraic time-reversibility — the update has the closed-form inverse
+    u = u' + dt·g·M∘∂⁺h';  h = h' + dt·H·∇⁻·u  (inverse sub-steps in
+    reverse order), so a trajectory can be run back to its IC.
+
+Variants mirror the flagship's ladder:
+  "ap"   — global-array jnp rolls (GSPMD partitions; wraparound reads the
+           opposite wall face, which the masks hold at 0 — exact).
+  "perf" — shard_map + one exchange of the full state + the whole-block
+           Pallas padded kernel (ops.swe_kernels.swe_step_padded_pallas).
+  "hide" — perf's kernel on the boundary-slab/interior overlap
+           decomposition, pytree state through parallel.overlap.
+Plus run_deep (width-k ghost exchange of all fields once per k steps) and
+run_vmem_resident (whole loop in one Pallas kernel, single shard).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+
+from rocm_mpi_tpu.config import DTYPES
+from rocm_mpi_tpu.ops.diffusion import gaussian_ic
+from rocm_mpi_tpu.ops.swe_kernels import masked_swe_step, swe_coeffs
+from rocm_mpi_tpu.parallel.halo import exchange_halo
+from rocm_mpi_tpu.parallel.mesh import GlobalGrid, init_global_grid
+from rocm_mpi_tpu.utils import metrics
+
+
+@dataclasses.dataclass(frozen=True)
+class SWEConfig:
+    """Knobs of a shallow-water run (same shape-vocabulary as
+    DiffusionConfig/WaveConfig)."""
+
+    global_shape: tuple[int, ...] = (128, 128)
+    lengths: tuple[float, ...] = (10.0, 10.0)
+    H0: float = 1.0  # resting depth
+    g: float = 1.0  # gravity
+    cfl: float = 0.5  # Courant number vs c = √(g·H0), < 1
+    nt: int = 1000
+    warmup: int = 10
+    dtype: str = "f64"
+    dims: tuple[int, ...] | None = None
+    b_width: tuple[int, ...] = (32, 4)
+
+    def __post_init__(self):
+        if len(self.lengths) != len(self.global_shape):
+            raise ValueError("lengths rank must match global_shape rank")
+        if self.dtype not in DTYPES:
+            raise ValueError(f"dtype must be one of {sorted(DTYPES)}")
+
+    @property
+    def ndim(self) -> int:
+        return len(self.global_shape)
+
+    @property
+    def jax_dtype(self):
+        return DTYPES[self.dtype]
+
+    @property
+    def spacing(self) -> tuple[float, ...]:
+        return tuple(l / n for l, n in zip(self.lengths, self.global_shape))
+
+    @property
+    def wave_speed(self) -> float:
+        return math.sqrt(self.g * self.H0)
+
+    @property
+    def dt(self) -> float:
+        """CFL-stable forward-backward step: cfl·min(d)/(c·√ndim)."""
+        return (
+            self.cfl
+            * min(self.spacing)
+            / (self.wave_speed * math.sqrt(self.ndim))
+        )
+
+
+@dataclasses.dataclass
+class SWERunResult:
+    h: jax.Array
+    us: tuple
+    wtime: float
+    nt: int
+    warmup: int
+    config: SWEConfig
+
+    @property
+    def wtime_it(self) -> float:
+        return metrics.wtime_per_it(self.wtime, self.nt, self.warmup)
+
+    @property
+    def t_eff(self) -> float:
+        # 2·(ndim+1) whole-array passes per step: read + write each of the
+        # h and u_a state fields (masks are coefficient traffic, excluded —
+        # the same accounting stance as the diffusion T_eff formula).
+        return metrics.t_eff_gbs(
+            self.h.shape, self.h.dtype.itemsize, self.wtime_it,
+            n_passes=2 * (len(self.us) + 1),
+        )
+
+    @property
+    def gpts(self) -> float:
+        return metrics.gpts_per_s(self.h.shape, self.wtime_it)
+
+
+class ShallowWater:
+    """Forward-backward linear shallow water on a sharded global grid."""
+
+    def __init__(
+        self,
+        config: SWEConfig,
+        grid: GlobalGrid | None = None,
+        devices=None,
+    ):
+        self.config = config
+        if grid is None:
+            grid = init_global_grid(
+                *config.global_shape,
+                lengths=config.lengths,
+                dims=config.dims,
+                devices=devices,
+            )
+        self.grid = grid
+
+    def face_masks(self):
+        """Per-axis face masks as data arrays: exactly 0.0 on the global
+        high wall face (index n_a−1 along axis a), 1.0 elsewhere. The low
+        wall is the zero-ghost convention (parallel.halo). Sharded like
+        the state so every schedule slices them locally."""
+        cfg, grid = self.config, self.grid
+        dtype = cfg.jax_dtype
+
+        @functools.partial(
+            jax.jit, static_argnums=0, out_shardings=grid.sharding
+        )
+        def make(axis):
+            gidx = lax.broadcasted_iota(
+                jnp.int32, grid.global_shape, axis
+            )
+            return jnp.where(
+                gidx >= grid.global_shape[axis] - 1,
+                jnp.zeros(grid.global_shape, dtype),
+                jnp.ones(grid.global_shape, dtype),
+            )
+
+        return tuple(make(a) for a in range(cfg.ndim))
+
+    def init_state(self):
+        """(h, us): Gaussian surface bump at rest (all velocities zero —
+        wall faces therefore start, and the masks keep them, at 0)."""
+        cfg, grid = self.config, self.grid
+        dtype = cfg.jax_dtype
+
+        @functools.partial(jax.jit, out_shardings=grid.sharding)
+        def make_h():
+            return gaussian_ic(
+                grid.coord_mesh(dtype=dtype), cfg.lengths, dtype=dtype
+            )
+
+        @functools.partial(jax.jit, out_shardings=grid.sharding)
+        def make_u():
+            return jnp.zeros(grid.global_shape, dtype)
+
+        return make_h(), tuple(make_u() for _ in range(cfg.ndim))
+
+    def _step(self, variant: str, Mus):
+        """(h, us) -> (h', us')."""
+        cfg, grid = self.config, self.grid
+        dt = cfg.dt
+        cH, cg = swe_coeffs(dt, cfg.spacing, cfg.H0, cfg.g)
+
+        if variant == "ap":
+
+            def step(h, us):
+                return masked_swe_step(h, us, Mus, cH, cg)
+
+            return step
+        if variant == "perf":
+            from rocm_mpi_tpu.ops.swe_kernels import swe_step_padded_pallas
+
+            def step(h, us):
+                def local(hl, *rest):
+                    uls, Ml = rest[: cfg.ndim], rest[cfg.ndim:]
+                    Sp = tuple(
+                        exchange_halo(f, grid) for f in (hl,) + tuple(uls)
+                    )
+                    outs = swe_step_padded_pallas(
+                        Sp, Ml, (cfg.H0, cfg.g), dt, cfg.spacing
+                    )
+                    return outs
+
+                outs = shard_map(
+                    local,
+                    mesh=grid.mesh,
+                    in_specs=(grid.spec,) * (2 * cfg.ndim + 1),
+                    out_specs=(grid.spec,) * (cfg.ndim + 1),
+                    check_vma=False,
+                )(h, *us, *Mus)
+                return outs[0], tuple(outs[1:])
+
+            return step
+        if variant == "hide":
+            from rocm_mpi_tpu.ops.swe_kernels import swe_step_padded_pallas
+            from rocm_mpi_tpu.parallel.overlap import make_overlap_step
+
+            if grid.nprocs == 1:
+                # No neighbors → nothing to hide (same routing policy as
+                # the diffusion and wave models' single-device hide).
+                return self._step("perf", Mus)
+
+            def pu(Sp, Ml, lam, dt_, spacing):
+                del lam
+                return swe_step_padded_pallas(
+                    Sp, Ml, (cfg.H0, cfg.g), dt_, spacing
+                )
+
+            # Walls ride the mask data — no Dirichlet where (the Cm-style
+            # mask_boundary=False contract).
+            local = make_overlap_step(
+                grid, pu, cfg.b_width, mask_boundary=False
+            )
+
+            def step(h, us):
+                def shard_fn(hl, *rest):
+                    uls, Ml = rest[: cfg.ndim], rest[cfg.ndim:]
+                    return local(
+                        (hl,) + tuple(uls), tuple(Ml), None, dt,
+                        cfg.spacing,
+                    )
+
+                outs = shard_map(
+                    shard_fn,
+                    mesh=grid.mesh,
+                    in_specs=(grid.spec,) * (2 * cfg.ndim + 1),
+                    out_specs=(grid.spec,) * (cfg.ndim + 1),
+                    check_vma=False,
+                )(h, *us, *Mus)
+                return outs[0], tuple(outs[1:])
+
+            return step
+        raise ValueError(
+            f"unknown SWE variant {variant!r} (ap, perf, hide)"
+        )
+
+    def advance_fn(self, variant: str = "perf"):
+        """jitted (h, us, Mus, n) -> (h, us) after n steps."""
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def advance(h, us, Mus, n):
+            step = self._step(variant, Mus)
+            return lax.fori_loop(
+                0, n, lambda _, s: step(s[0], s[1]), (h, us)
+            )
+
+        return advance
+
+    def _run_timed(self, advance, nt, warmup) -> SWERunResult:
+        """Shared scaffold: warmup-advance / tic / advance / toc (the
+        framework's timing protocol; `advance(h, us, Mus, n)` must serve
+        both windows with one compiled program)."""
+        cfg = self.config
+        nt = cfg.nt if nt is None else nt
+        warmup = cfg.warmup if warmup is None else warmup
+        if not 0 <= warmup < nt:
+            raise ValueError(f"need 0 <= warmup < nt, got {warmup}, {nt}")
+        h, us = self.init_state()
+        Mus = self.face_masks()
+        timer = metrics.Timer()
+        h, us = advance(h, us, Mus, warmup)
+        timer.tic(h)
+        h, us = advance(h, us, Mus, nt - warmup)
+        wtime = timer.toc(h)
+        return SWERunResult(
+            h=h, us=us, wtime=wtime, nt=nt, warmup=warmup, config=cfg
+        )
+
+    def run(
+        self, variant: str = "perf",
+        nt: int | None = None, warmup: int | None = None,
+    ) -> SWERunResult:
+        return self._run_timed(self.advance_fn(variant), nt, warmup)
+
+    def run_vmem_resident(
+        self, nt: int | None = None, warmup: int | None = None,
+        chunk: int | None = None,
+    ) -> SWERunResult:
+        """Single-shard fast path: the whole coupled loop inside one
+        Pallas kernel, all ndim+1 fields VMEM-resident
+        (ops.swe_kernels.swe_multi_step)."""
+        from rocm_mpi_tpu.models.diffusion import effective_block_steps
+        from rocm_mpi_tpu.ops.pallas_kernels import DEFAULT_STEP_CHUNK
+        from rocm_mpi_tpu.ops.swe_kernels import swe_multi_step
+
+        cfg = self.config
+        if self.grid.nprocs != 1:
+            raise ValueError(
+                "the VMEM-resident path requires an unsharded grid"
+            )
+        eff_chunk = effective_block_steps(
+            cfg.nt if nt is None else nt,
+            cfg.warmup if warmup is None else warmup,
+            DEFAULT_STEP_CHUNK if chunk is None else chunk,
+            warn=False,
+        )
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def advance(h, us, Mus, n):
+            return swe_multi_step(
+                h, us, Mus, cfg.dt, cfg.spacing, cfg.H0, cfg.g, n,
+                chunk=eff_chunk, warn_on_cap=False,
+            )
+
+        return self._run_timed(advance, nt, warmup)
+
+    DEFAULT_DEEP_STEPS = 8
+
+    def effective_deep_depth(
+        self,
+        nt: int | None = None,
+        warmup: int | None = None,
+        block_steps: int | None = None,
+        warn: bool = True,
+    ) -> int:
+        """The sweep depth run_deep will actually execute — the labeling
+        source of truth (same policy as the diffusion and wave models,
+        ADVICE r3: a DEFAULT depth clamps to the shard, an EXPLICIT one is
+        gcd-degraded against the windows and then raises if it still
+        exceeds the shard)."""
+        from rocm_mpi_tpu.models.diffusion import effective_block_steps
+
+        cfg = self.config
+        explicit = block_steps is not None
+        if block_steps is None:
+            block_steps = min(
+                self.DEFAULT_DEEP_STEPS, min(self.grid.local_shape)
+            )
+        eff = effective_block_steps(
+            cfg.nt if nt is None else nt,
+            cfg.warmup if warmup is None else warmup,
+            block_steps,
+            label="SWE deep-halo sweep depth",
+            warn=warn,
+            stacklevel=3,
+        )
+        if explicit and eff > min(self.grid.local_shape):
+            raise ValueError(
+                f"SWE deep-halo sweep depth {eff} exceeds a local shard "
+                f"extent {self.grid.local_shape}; ghost slices need "
+                "width <= shard"
+            )
+        return eff
+
+    def run_deep(
+        self,
+        nt: int | None = None,
+        warmup: int | None = None,
+        block_steps: int | None = None,
+    ) -> SWERunResult:
+        """Sharded fast path: deep-halo sweeps — ONE width-k ghost
+        exchange of the whole coupled state per k steps
+        (parallel.deep_halo.make_swe_deep_sweep)."""
+        from rocm_mpi_tpu.parallel.deep_halo import make_swe_deep_sweep
+
+        cfg = self.config
+        k = self.effective_deep_depth(nt, warmup, block_steps)
+        sweep = make_swe_deep_sweep(
+            self.grid, k, cfg.dt, cfg.spacing, cfg.H0, cfg.g
+        )
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def advance(h, us, Mus, n):
+            del Mus  # deep sweeps build padded masks internally
+            return lax.fori_loop(
+                0, n // k, lambda _, s: sweep(s[0], s[1]), (h, us)
+            )
+
+        return self._run_timed(advance, nt, warmup)

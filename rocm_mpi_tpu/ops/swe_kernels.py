@@ -1,0 +1,287 @@
+"""Pallas TPU kernels for the shallow-water workload (third model family —
+no reference analog; the reference ships exactly one physics model).
+
+Physics: the linearized shallow-water equations in a closed basin,
+discretized on an Arakawa-C-style staggered grid where every field keeps
+the SAME array shape (h at cell centers; u_a at the +a face of its cell):
+
+    h' = h  − dt·H·Σ_a ∂a⁻(u_a)        (backward differences)
+    u_a' = M_a ∘ (u_a − dt·g·∂a⁺(h'))  (forward differences, updated h)
+
+This forward-backward (symplectic-Euler) pairing of adjoint difference
+operators is the classic energy-stable scheme for first-order wave systems.
+Unlike the diffusion (one field) and wave (state pair, one exchanged field)
+workloads, the SWE state is ndim+1 COUPLED fields whose updates read
+neighbors of *different* fields — the case that exercises the framework's
+pytree-state halo machinery (parallel.overlap, parallel.deep_halo).
+
+Boundary design — mask-as-data, no `where` in the hot loop: the face mask
+M_a is exactly 0.0 on the global high wall (face index n_g−1 along axis a)
+and 1.0 elsewhere, so wall-face velocities stay bitwise 0 forever; the low
+wall is the zero-ghost convention (u_a[−1] ghosts arrive as zeros,
+parallel.halo). Sealed walls give EXACT mass conservation: Σ_core ∂a⁻u_a
+telescopes to (wall − wall) = 0, so sum(h) is invariant to fp rounding —
+the workload's machine-checkable invariant (tests/test_swe.py), alongside
+algebraic time-reversibility (the update has a closed-form inverse).
+
+The roll form below is exact even ON the global array: jnp.roll wraparound
+brings exactly the opposite wall face, which the masks hold at 0 — so one
+definition (`masked_swe_step`) serves the ap variant, the VMEM-resident
+multi-step kernel, and the deep-halo sweep fallback.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from rocm_mpi_tpu.ops.pallas_kernels import (
+    _VMEM_BLOCK_BUDGET_BYTES,
+    _compute_nbytes,
+    _interpret_default,
+    _out_struct,
+    _supports_compiled,
+    _upcast_for_compute,
+)
+
+
+def swe_coeffs(dt, spacing, H, g):
+    """Per-axis scalar update coefficients (cH_a, cg_a) = (dt·H/d_a,
+    dt·g/d_a) — the only place the physical constants meet the grid."""
+    cH = tuple(float(dt) * float(H) / float(d) for d in spacing)
+    cg = tuple(float(dt) * float(g) / float(d) for d in spacing)
+    return cH, cg
+
+
+def masked_swe_step(h, us, Mus, cH, cg):
+    """One forward-backward SWE step (plain jnp rolls) — THE single
+    definition of the update, shared by the ap variant (global arrays:
+    wraparound brings the opposite wall face, held 0 by the masks), the
+    VMEM-resident Pallas kernel body, and the deep-halo fallback (padded
+    blocks: wraparound feeds only the ghost ring, cropped at sweep end;
+    off-domain faces are zeroed by the padded masks).
+
+    `us`/`Mus` are length-ndim sequences; returns (h', us')."""
+    div = None
+    for a, u in enumerate(us):
+        d = cH[a] * (u - jnp.roll(u, 1, a))
+        div = d if div is None else div + d
+    h = h - div
+    us = tuple(
+        Mus[a] * (u - cg[a] * (jnp.roll(h, -1, a) - h))
+        for a, u in enumerate(us)
+    )
+    return h, us
+
+
+def swe_step_padded(Sp, Mus, consts, dt, spacing):
+    """Candidate SWE update for every core cell of a width-1-padded block
+    (pure jnp) — the framework's padded contract (docs/ADDING_A_MODEL.md
+    §1) for a PYTREE state: `Sp = (hp, u0p, …)` are all width-1 padded
+    (ghosts from exchange_halo), `Mus` are core-shaped face masks,
+    `consts = (H, g)`. Returns the (h', u0', …) core tuple.
+
+    h' is computed on the core-plus-high-pad box (one extra cell on the
+    high side of every axis) so the forward differences the velocity
+    updates need never require a second exchange — one ghost exchange of
+    the full state advances the whole coupled step.
+    """
+    hp, *ups = Sp
+    H, g = consts
+    ndim = hp.ndim
+    cH, cg = swe_coeffs(dt, spacing, H, g)
+    ext = tuple(slice(1, None) for _ in range(ndim))
+    div = None
+    for a, up in enumerate(ups):
+        hi = [slice(1, None)] * ndim
+        lo = [slice(1, None)] * ndim
+        lo[a] = slice(0, -1)
+        d = cH[a] * (up[tuple(hi)] - up[tuple(lo)])
+        div = d if div is None else div + d
+    h_ext = hp[ext] - div
+    base = tuple(slice(0, -1) for _ in range(ndim))
+    h_core = h_ext[base]
+    core = tuple(slice(1, -1) for _ in range(ndim))
+    outs = [h_core]
+    for a, up in enumerate(ups):
+        sh = [slice(0, -1)] * ndim
+        sh[a] = slice(1, None)
+        dh = h_ext[tuple(sh)] - h_core
+        outs.append(Mus[a] * (up[core] - cg[a] * dh))
+    return tuple(outs)
+
+
+def _swe_kernel_whole(*refs, ndim, cH, cg):
+    """Whole-block Pallas twin of swe_step_padded: refs are
+    [hp, u0p…, Mu0…, oh, ou0…] (padded state, core masks, core outs)."""
+    n_state = ndim + 1
+    pad_in = refs[:n_state]
+    mask_in = refs[n_state:n_state + ndim]
+    outs = refs[n_state + ndim:]
+    vals = _upcast_for_compute(*[r[:] for r in pad_in + mask_in])
+    Sp, Mus = vals[:n_state], vals[n_state:]
+    # Inline swe_step_padded's expression on the VMEM-resident values
+    # (consts are pre-divided into cH/cg by the caller).
+    hp, *ups = Sp
+    ext = tuple(slice(1, None) for _ in range(ndim))
+    div = None
+    for a, up in enumerate(ups):
+        hi = [slice(1, None)] * ndim
+        lo = [slice(1, None)] * ndim
+        lo[a] = slice(0, -1)
+        d = cH[a] * (up[tuple(hi)] - up[tuple(lo)])
+        div = d if div is None else div + d
+    h_ext = hp[ext] - div
+    base = tuple(slice(0, -1) for _ in range(ndim))
+    h_core = h_ext[base]
+    core = tuple(slice(1, -1) for _ in range(ndim))
+    outs[0][:] = h_core.astype(outs[0].dtype)
+    for a, up in enumerate(ups):
+        sh = [slice(0, -1)] * ndim
+        sh[a] = slice(1, None)
+        dh = h_ext[tuple(sh)] - h_core
+        outs[a + 1][:] = (
+            Mus[a] * (up[core] - cg[a] * dh)
+        ).astype(outs[a + 1].dtype)
+
+
+def swe_step_padded_pallas(Sp, Mus, consts, dt, spacing, interpret=None):
+    """Pallas whole-block form of the padded SWE step (the perf/hide
+    kernel). Falls back to the identical-semantics jnp padded form for
+    blocks beyond the VMEM budget and for dtypes Mosaic cannot compile
+    (f64 on a real chip) — same policy as the wave workload's kernel
+    (wave_step_padded_pallas: the non-flagship models prefer a slower
+    correct path over a crash)."""
+    hp = Sp[0]
+    ndim = hp.ndim
+    if interpret is None:
+        interpret = _interpret_default()
+    # 2·(ndim+1) padded + ndim mask arrays resident at f32 compute width.
+    nbytes = (3 * ndim + 2) * _compute_nbytes(Mus[0])
+    if (not _supports_compiled(hp.dtype) and not interpret) or (
+        nbytes > _VMEM_BLOCK_BUDGET_BYTES
+    ):
+        return swe_step_padded(Sp, Mus, consts, dt, spacing)
+    H, g = consts
+    cH, cg = swe_coeffs(dt, spacing, H, g)
+    kernel = functools.partial(_swe_kernel_whole, ndim=ndim, cH=cH, cg=cg)
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    core_shape = Mus[0].shape
+    out_sd = tuple(
+        _out_struct(core_shape, hp) for _ in range(ndim + 1)
+    )
+    outs = pl.pallas_call(
+        kernel,
+        out_shape=out_sd,
+        in_specs=[vmem] * (2 * ndim + 1),
+        out_specs=(vmem,) * (ndim + 1),
+        interpret=interpret,
+    )(*Sp, *Mus)
+    return tuple(outs)
+
+
+def _swe_multi_step_kernel(*refs, ndim, cH, cg, chunk):
+    """`chunk` forward-backward steps with the whole state VMEM-resident
+    (bf16 storage upcast to f32 for the chunk — one rounding per chunk,
+    the storage-only-bf16 policy of the diffusion/wave multi-step
+    kernels). refs = [h, u0…, Mu0…, oh, ou0…], all same-shape."""
+    n_state = ndim + 1
+    ins = refs[:n_state + ndim]
+    outs = refs[n_state + ndim:]
+    vals = _upcast_for_compute(*[r[:] for r in ins])
+    h0, us0, Mus = vals[0], vals[1:n_state], vals[n_state:]
+
+    def body(_, s):
+        return masked_swe_step(s[0], s[1], Mus, cH, cg)
+
+    h, us = lax.fori_loop(0, chunk, body, (h0, tuple(us0)), unroll=True)
+    outs[0][:] = h.astype(outs[0].dtype)
+    for a, u in enumerate(us):
+        outs[a + 1][:] = u.astype(outs[a + 1].dtype)
+
+
+def swe_multi_step_masked(h, us, Mus, cH, cg, n_steps: int, interpret=None):
+    """`n_steps` unrolled SWE steps on a VMEM-resident state with
+    caller-supplied face masks — the SWE analog of
+    ops.pallas_kernels.multi_step_cm / wave_kernels.wave_multi_step_masked,
+    and the local compute of SWE deep-halo sweeps: the caller pads the
+    blocks and zeroes the masks on wall/off-domain faces; `n_steps` must
+    not exceed the ghost width (the light-cone bound). Returns (h, us)."""
+    if interpret is None:
+        interpret = _interpret_default()
+    if not _supports_compiled(h.dtype) and not interpret:
+        raise TypeError(f"Mosaic does not support {h.dtype}")
+    ndim = h.ndim
+    if len(us) != ndim or len(Mus) != ndim:
+        raise ValueError(
+            f"need ndim={ndim} velocity fields and masks, got "
+            f"{len(us)} and {len(Mus)}"
+        )
+    for arr in (*us, *Mus):
+        if arr.shape != h.shape:
+            raise ValueError(
+                f"all SWE fields share one shape: h {h.shape} vs {arr.shape}"
+            )
+    # 2·(ndim+1) state + ndim masks resident at f32 compute width.
+    nbytes = (3 * ndim + 2) * _compute_nbytes(h)
+    if nbytes > _VMEM_BLOCK_BUDGET_BYTES:
+        raise ValueError(
+            f"state of {nbytes} bytes (f32 compute width) exceeds the "
+            f"VMEM-resident budget ({_VMEM_BLOCK_BUDGET_BYTES})"
+        )
+    kernel = functools.partial(
+        _swe_multi_step_kernel, ndim=ndim, cH=tuple(cH), cg=tuple(cg),
+        chunk=int(n_steps),
+    )
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    out_sd = tuple(_out_struct(h.shape, h) for _ in range(ndim + 1))
+    outs = pl.pallas_call(
+        kernel,
+        out_shape=out_sd,
+        in_specs=[vmem] * (2 * ndim + 1),
+        out_specs=(vmem,) * (ndim + 1),
+        interpret=interpret,
+    )(h, *us, *Mus)
+    return outs[0], tuple(outs[1:])
+
+
+def swe_multi_step(
+    h, us, Mus, dt, spacing, H, g, n_steps, chunk=None, interpret=None,
+    warn_on_cap=True,
+):
+    """Advance a *single-shard* SWE state `n_steps` barely leaving VMEM —
+    the SWE edition of fused_multi_step / wave_multi_step (same chunk
+    policy, resolve_step_chunk; same dynamic-n divisibility caveat: a
+    TRACED `n_steps` floors the trip count, so callers must guarantee
+    `chunk | n_steps` themselves, as run_vmem_resident does via gcd).
+    `Mus` must already hold the wall faces (models.swe.face_masks) — on
+    the global array the roll wraparound then reads exactly those zeroed
+    opposite wall faces, keeping the closed-basin physics exact."""
+    from rocm_mpi_tpu.ops.pallas_kernels import resolve_step_chunk
+
+    if interpret is None:
+        interpret = _interpret_default()
+    if not _supports_compiled(h.dtype) and not interpret:
+        raise TypeError(f"Mosaic does not support {h.dtype}")
+    nbytes = (3 * h.ndim + 2) * _compute_nbytes(h)
+    if nbytes > _VMEM_BLOCK_BUDGET_BYTES:
+        raise ValueError(
+            f"state of {nbytes} bytes (f32 compute width) exceeds the "
+            f"VMEM-resident budget ({_VMEM_BLOCK_BUDGET_BYTES}); use the "
+            "per-step path"
+        )
+    chunk = resolve_step_chunk(n_steps, chunk, _compute_nbytes(h),
+                               warn_on_cap)
+    cH, cg = swe_coeffs(dt, spacing, H, g)
+    return lax.fori_loop(
+        0,
+        n_steps // chunk,
+        lambda _, s: swe_multi_step_masked(
+            s[0], s[1], Mus, cH, cg, chunk, interpret=interpret
+        ),
+        (h, tuple(us)),
+    )

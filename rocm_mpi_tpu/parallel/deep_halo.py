@@ -151,6 +151,94 @@ def make_deep_sweep(grid: GlobalGrid, k: int, lam, dt, spacing):
     return sweep
 
 
+def padded_face_mask(shape, grid: GlobalGrid, axis: int, width: int, dtype):
+    """Face mask for the u_axis field over a width-`width` padded block
+    (inside shard_map): exactly 0.0 on the global high wall face (global
+    index n_g−1 along `axis`) and on off-domain ghost faces along `axis`,
+    1.0 elsewhere. Zeroed wall faces seal the closed basin — off-domain
+    ghost values then cannot influence any in-domain cell no matter how
+    many local steps a sweep takes (flux across a wall is identically 0),
+    which is what lets the SWE deep sweep evolve its ghost ring freely and
+    crop it. Off-domain faces along OTHER axes need no zeroing: their
+    influence would have to cross that axis's wall to reach the domain."""
+    name = grid.axis_names[axis]
+    ln = grid.local_shape[axis]
+    n_g = grid.global_shape[axis]
+    gidx = (
+        lax.axis_index(name) * ln
+        + lax.broadcasted_iota(jnp.int32, shape, axis)
+        - width
+    )
+    invalid = (gidx >= n_g - 1) | (gidx < 0)
+    return jnp.where(
+        invalid, jnp.zeros(shape, dtype), jnp.ones(shape, dtype)
+    )
+
+
+def make_swe_deep_sweep(grid: GlobalGrid, k: int, dt, spacing, H, g):
+    """Deep-halo sweeps for the shallow-water workload: build
+    sweep(h, us) -> (h, us) advanced k steps with ONE width-k ghost
+    exchange of the whole ndim+1-field coupled state (same light-cone
+    argument as make_deep_sweep: the forward-backward update moves
+    information one cell per step in each direction, so width-k ghosts
+    keep the core exact for k steps).
+
+    Local compute: the VMEM-resident masked multi-step kernel
+    (ops.swe_kernels.swe_multi_step_masked) when the padded state fits,
+    else the identical-semantics jnp roll fallback (masked_swe_step — the
+    one definition of the update)."""
+    if k < 1:
+        raise ValueError(f"sweep depth k must be >= 1, got {k}")
+    if any(k > ln for ln in grid.local_shape):
+        raise ValueError(
+            f"sweep depth {k} exceeds a local shard extent "
+            f"{grid.local_shape}; ghost slices need width <= shard"
+        )
+    from rocm_mpi_tpu.ops.pallas_kernels import (
+        _VMEM_BLOCK_BUDGET_BYTES,
+        _compute_nbytes,
+    )
+    from rocm_mpi_tpu.ops.swe_kernels import (
+        masked_swe_step,
+        swe_coeffs,
+        swe_multi_step_masked,
+    )
+
+    ndim = grid.ndim
+    core = tuple(slice(k, -k) for _ in range(ndim))
+    cH, cg = swe_coeffs(dt, spacing, H, g)
+
+    def jnp_k_steps(h, us, Mus):
+        for _ in range(k):
+            h, us = masked_swe_step(h, us, Mus, cH, cg)
+        return h, us
+
+    def local_sweep(hl, *uls):
+        hp = exchange_halo(hl, grid, width=k)
+        ups = tuple(exchange_halo(u, grid, width=k) for u in uls)
+        Mus = tuple(
+            padded_face_mask(hp.shape, grid, a, k, hp.dtype)
+            for a in range(ndim)
+        )
+        if (3 * ndim + 2) * _compute_nbytes(hp) <= _VMEM_BLOCK_BUDGET_BYTES:
+            h2, us2 = swe_multi_step_masked(hp, ups, Mus, cH, cg, k)
+        else:
+            h2, us2 = jnp_k_steps(hp, ups, Mus)
+        return (h2[core],) + tuple(u[core] for u in us2)
+
+    def sweep(h, us):
+        outs = shard_map(
+            local_sweep,
+            mesh=grid.mesh,
+            in_specs=(grid.spec,) * (ndim + 1),
+            out_specs=(grid.spec,) * (ndim + 1),
+            check_vma=False,
+        )(h, *us)
+        return outs[0], tuple(outs[1:])
+
+    return sweep
+
+
 def make_wave_deep_sweep(grid: GlobalGrid, k: int, dt, spacing):
     """Deep-halo sweeps for the acoustic-wave workload: build
     sweep(U, Uprev, C2) -> (U, Uprev) advanced k steps with ONE width-k

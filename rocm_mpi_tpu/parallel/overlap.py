@@ -69,11 +69,19 @@ def make_overlap_step(
     the padded contract (jnp or Pallas). Returns
     `local_step(Tl, Cl, lam, dt, spacing) -> Tl_new`.
 
+    `Tl` — the exchanged state — may itself be a pytree of same-shaped
+    arrays (r4: the shallow-water workload's (h, u, v), whose coupled
+    update reads neighbors of every field): each leaf is halo-exchanged
+    and region-sliced, `padded_update` receives the padded pytree, returns
+    the same-structure core pytree, and the slab/interior splice happens
+    leaf-wise. A bare array is the one-leaf case — the diffusion and wave
+    callers are unchanged, op for op.
+
     `Cl` may be any pytree of core-shaped operands (a bare coefficient
     array for the diffusion rungs; a (U_prev, C2) tuple for the wave
-    leapfrog) — each leaf is sliced to the region and the whole tree is
-    handed to `padded_update` as its second argument. Only the primary
-    field `Tl` is halo-exchanged; aux operands are read core-only.
+    leapfrog; the face masks for SWE) — each leaf is sliced to the region
+    and the whole tree is handed to `padded_update` as its second
+    argument. Aux operands are read core-only, never exchanged.
 
     `mask_boundary=False` drops the final Dirichlet `where`: for the Cm
     contract (C = the boundary-masked coefficient, models.diffusion
@@ -92,13 +100,17 @@ def make_overlap_step(
     bw = effective_b_width(local, b_width)
 
     def local_step(Tl, Cpl, lam, dt, spacing):
-        # (1) halo exchange of the current field — edge-slice ppermutes.
-        Tp = exchange_halo(Tl, grid)  # core + 2 per axis
+        # (1) halo exchange of the current state — edge-slice ppermutes,
+        # one exchange per state leaf (SWE: 3 fields; diffusion/wave: 1).
+        Tp = jax.tree_util.tree_map(
+            lambda t: exchange_halo(t, grid), Tl
+        )  # core + 2 per axis
 
         def region(bounds):
             """Candidate update of the core box given by `bounds`
-            (per-axis (lo, hi) core ranges), read from the padded field."""
-            tp = Tp[tuple(slice(lo, hi + 2) for lo, hi in bounds)]
+            (per-axis (lo, hi) core ranges), read from the padded state."""
+            pad_idx = tuple(slice(lo, hi + 2) for lo, hi in bounds)
+            tp = jax.tree_util.tree_map(lambda a: a[pad_idx], Tp)
             core_idx = tuple(slice(lo, hi) for lo, hi in bounds)
             cp = jax.tree_util.tree_map(lambda a: a[core_idx], Cpl)
             return padded_update(tp, cp, lam, dt, spacing)
@@ -117,12 +129,17 @@ def make_overlap_step(
             if n - 2 * b > 0:
                 parts.append(build(axis + 1, prefix + [(b, n - b)]))
             parts.append(hi_slab)
-            return jnp.concatenate(parts, axis=axis)
+            return jax.tree_util.tree_map(
+                lambda *xs: jnp.concatenate(xs, axis=axis), *parts
+            )
 
         new = build(0, [])
         if not mask_boundary:
             return new
         # (4) Dirichlet: global-domain edge cells never change.
-        return jnp.where(global_boundary_mask(grid), Tl, new)
+        mask = global_boundary_mask(grid)
+        return jax.tree_util.tree_map(
+            lambda old, nw: jnp.where(mask, old, nw), Tl, new
+        )
 
     return local_step

@@ -5,7 +5,7 @@
 # (README.md:21 documents the reference's comment-toggling).
 #
 # Usage:
-#   scripts/run.sh ap|kp|perf|perf_hide|prof|3d|ring|scale|wave|bounds [extra app flags...]
+#   scripts/run.sh ap|kp|perf|perf_hide|prof|3d|ring|scale|wave|swe|bounds [extra app flags...]
 #   RMT_DISTRIBUTED=1 scripts/run.sh perf_hide      # multi-host pod slice
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -23,6 +23,7 @@ case "$app" in
   ring) exec python apps/ici_ring_test.py "$@" ;;
   scale|weak_scaling) exec python apps/weak_scaling.py "$@" ;;
   wave) exec python apps/wave_2d.py "$@" ;;
+  swe) exec python apps/swe_2d.py "$@" ;;
   bounds) exec python scripts/bench_bounds.py "$@" ;;
-  *) echo "unknown app '$app' (ap|kp|perf|perf_hide|prof|3d|ring|scale|wave|bounds)" >&2; exit 2 ;;
+  *) echo "unknown app '$app' (ap|kp|perf|perf_hide|prof|3d|ring|scale|wave|swe|bounds)" >&2; exit 2 ;;
 esac

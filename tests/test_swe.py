@@ -1,0 +1,284 @@
+"""Shallow-water workload (models.swe): numpy oracle, EXACT mass
+conservation, algebraic time reversal, cross-variant and sharding
+equivalence — the correctness strategy of the diffusion/wave suites
+applied to the third workload, whose coupled ndim+1-field state is what
+exercises the pytree-state paths of parallel.overlap and
+parallel.deep_halo."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rocm_mpi_tpu.models.swe import SWEConfig, ShallowWater
+from rocm_mpi_tpu.ops.swe_kernels import swe_coeffs
+
+
+def _cfg(shape=(24, 20), dims=(1, 1), dtype="f64", nt=40, warmup=8):
+    return SWEConfig(
+        global_shape=shape,
+        lengths=tuple(10.0 for _ in shape),
+        nt=nt,
+        warmup=warmup,
+        dtype=dtype,
+        dims=dims,
+    )
+
+
+def _numpy_fb(h, us, dt, spacing, H, g, n):
+    """Transparent numpy oracle of the forward-backward C-grid update:
+    backward-difference divergence into h, then forward-difference
+    gradient of the NEW h into each velocity, with the high wall face
+    along each axis held at 0 and zero beyond-domain values."""
+    h = np.array(h, np.float64)
+    us = [np.array(u, np.float64) for u in us]
+    ndim = h.ndim
+    for _ in range(n):
+        div = np.zeros_like(h)
+        for a, u in enumerate(us):
+            um = np.zeros_like(u)  # u shifted +1 along a, zero-filled
+            lo = tuple(
+                slice(1, None) if ax == a else slice(None)
+                for ax in range(ndim)
+            )
+            hi = tuple(
+                slice(None, -1) if ax == a else slice(None)
+                for ax in range(ndim)
+            )
+            um[lo] = u[hi]
+            div += (u - um) * (dt * H / spacing[a])
+        h = h - div
+        for a in range(ndim):
+            hp = np.zeros_like(h)  # h shifted −1 along a, zero-filled
+            lo = tuple(
+                slice(None, -1) if ax == a else slice(None)
+                for ax in range(ndim)
+            )
+            hi = tuple(
+                slice(1, None) if ax == a else slice(None)
+                for ax in range(ndim)
+            )
+            hp[lo] = h[hi]
+            us[a] = us[a] - (dt * g / spacing[a]) * (hp - h)
+            # hold the high wall face
+            wall = tuple(
+                slice(-1, None) if ax == a else slice(None)
+                for ax in range(ndim)
+            )
+            us[a][wall] = 0.0
+    return h, us
+
+
+def _advance(model, variant, n):
+    h, us = model.init_state()
+    Mus = model.face_masks()
+    return model.advance_fn(variant)(h, us, Mus, n)
+
+
+def test_swe_matches_numpy_oracle():
+    cfg = _cfg()
+    model = ShallowWater(cfg, devices=jax.devices()[:1])
+    h0, us0 = model.init_state()
+    ref_h, ref_us = _numpy_fb(
+        h0, us0, cfg.dt, cfg.spacing, cfg.H0, cfg.g, 25
+    )
+    got_h, got_us = model.advance_fn("ap")(h0, us0, model.face_masks(), 25)
+    np.testing.assert_allclose(np.asarray(got_h), ref_h, rtol=1e-12)
+    for got_u, ref_u in zip(got_us, ref_us):
+        np.testing.assert_allclose(
+            np.asarray(got_u), ref_u, rtol=1e-12, atol=1e-15
+        )
+
+
+def test_swe_mass_exactly_conserved():
+    # The closed-basin divergence telescopes to wall−wall = 0, so sum(h)
+    # is invariant to fp rounding — the workload's exact invariant.
+    cfg = _cfg(nt=200, warmup=0)
+    model = ShallowWater(cfg, devices=jax.devices()[:1])
+    h0, us0 = model.init_state()
+    mass0 = float(jnp.sum(h0, dtype=jnp.float64))
+    got_h, _ = model.advance_fn("ap")(h0, us0, model.face_masks(), 200)
+    mass = float(jnp.sum(got_h, dtype=jnp.float64))
+    assert abs(mass - mass0) <= 1e-13 * abs(mass0)
+
+
+def test_swe_mass_conserved_sharded_all_variants():
+    for variant in ("ap", "perf", "hide"):
+        cfg = _cfg(shape=(32, 32), dims=(2, 4), nt=64, warmup=0)
+        model = ShallowWater(cfg)
+        h0, us0 = model.init_state()
+        mass0 = float(jnp.sum(h0, dtype=jnp.float64))
+        got_h, _ = model.advance_fn(variant)(
+            h0, us0, model.face_masks(), 64
+        )
+        mass = float(jnp.sum(got_h, dtype=jnp.float64))
+        assert abs(mass - mass0) <= 1e-13 * abs(mass0), variant
+
+
+def test_swe_wall_faces_stay_zero():
+    cfg = _cfg()
+    model = ShallowWater(cfg, devices=jax.devices()[:1])
+    _, got_us = _advance(model, "ap", 30)
+    for a, u in enumerate(got_us):
+        wall = tuple(
+            slice(-1, None) if ax == a else slice(None)
+            for ax in range(cfg.ndim)
+        )
+        np.testing.assert_array_equal(np.asarray(u)[wall], 0.0)
+
+
+def test_swe_time_reversal_algebraic():
+    # The forward-backward map has a closed-form inverse (inverse
+    # sub-steps in reverse order); running it returns the IC at rounding
+    # level — the symplectic-structure analog of the wave's leapfrog
+    # reversal test.
+    cfg = _cfg(nt=60)
+    model = ShallowWater(cfg, devices=jax.devices()[:1])
+    h0, us0 = model.init_state()
+    Mus = model.face_masks()
+    n = 40
+    h, us = model.advance_fn("ap")(
+        jnp.copy(h0), tuple(map(jnp.copy, us0)), Mus, n
+    )
+    cH, cg = swe_coeffs(cfg.dt, cfg.spacing, cfg.H0, cfg.g)
+
+    def inverse_step(h, us):
+        us = tuple(
+            u + cg[a] * Mus[a] * (jnp.roll(h, -1, a) - h)
+            for a, u in enumerate(us)
+        )
+        div = sum(
+            cH[a] * (u - jnp.roll(u, 1, a)) for a, u in enumerate(us)
+        )
+        return h + div, us
+
+    for _ in range(n):
+        h, us = inverse_step(h, us)
+    np.testing.assert_allclose(
+        np.asarray(h), np.asarray(h0), rtol=1e-11, atol=1e-13
+    )
+    for u, u0 in zip(us, us0):
+        np.testing.assert_allclose(
+            np.asarray(u), np.asarray(u0), atol=1e-13
+        )
+
+
+@pytest.mark.parametrize("dtype", ["f64", "f32"])
+def test_swe_perf_matches_ap(dtype):
+    tol = 1e-12 if dtype == "f64" else 2e-6
+    cfg = _cfg(dtype=dtype)
+    model = ShallowWater(cfg, devices=jax.devices()[:1])
+    ap_h, ap_us = _advance(model, "ap", 30)
+    pf_h, pf_us = _advance(model, "perf", 30)
+    np.testing.assert_allclose(
+        np.asarray(pf_h), np.asarray(ap_h), rtol=tol, atol=tol
+    )
+    for pu, au in zip(pf_us, ap_us):
+        np.testing.assert_allclose(
+            np.asarray(pu), np.asarray(au), rtol=tol, atol=tol
+        )
+
+
+def test_swe_sharded_matches_single_device():
+    single = ShallowWater(_cfg(shape=(32, 32)), devices=jax.devices()[:1])
+    truth_h, truth_us = _advance(single, "perf", 30)
+    for dims in [(2, 2), (4, 2), (1, 8)]:
+        model = ShallowWater(_cfg(shape=(32, 32), dims=dims))
+        got_h, got_us = _advance(model, "perf", 30)
+        np.testing.assert_allclose(
+            np.asarray(got_h), np.asarray(truth_h), rtol=1e-12, atol=1e-14
+        )
+        for gu, tu in zip(got_us, truth_us):
+            np.testing.assert_allclose(
+                np.asarray(gu), np.asarray(tu), rtol=1e-12, atol=1e-14
+            )
+
+
+def test_swe_hide_matches_perf_sharded():
+    for dims in [(2, 2), (2, 4)]:
+        cfg = _cfg(shape=(32, 32), dims=dims)
+        model = ShallowWater(cfg)
+        pf_h, pf_us = _advance(model, "perf", 30)
+        hd_h, hd_us = _advance(model, "hide", 30)
+        np.testing.assert_allclose(
+            np.asarray(hd_h), np.asarray(pf_h), rtol=1e-12, atol=1e-14
+        )
+        for hu, pu in zip(hd_us, pf_us):
+            np.testing.assert_allclose(
+                np.asarray(hu), np.asarray(pu), rtol=1e-12, atol=1e-14
+            )
+
+
+def test_swe_hide_3d_matches_perf():
+    cfg = _cfg(shape=(12, 12, 12), dims=(2, 2, 2), nt=12, warmup=0)
+    model = ShallowWater(cfg)
+    pf_h, _ = _advance(model, "perf", 10)
+    hd_h, _ = _advance(model, "hide", 10)
+    np.testing.assert_allclose(
+        np.asarray(hd_h), np.asarray(pf_h), rtol=1e-12, atol=1e-14
+    )
+
+
+def test_swe_deep_sweep_matches_per_step():
+    single = ShallowWater(_cfg(shape=(32, 32)), devices=jax.devices()[:1])
+    truth_h, truth_us = _advance(single, "ap", 32)
+    for dims, k in [((2, 2), 4), ((2, 4), 8), ((1, 1), 4)]:
+        model = ShallowWater(_cfg(shape=(32, 32), dims=dims))
+        r = model.run_deep(nt=32, warmup=0, block_steps=k)
+        np.testing.assert_allclose(
+            np.asarray(r.h), np.asarray(truth_h), rtol=1e-12, atol=1e-14
+        )
+        for gu, tu in zip(r.us, truth_us):
+            np.testing.assert_allclose(
+                np.asarray(gu), np.asarray(tu), rtol=1e-12, atol=1e-14
+            )
+
+
+def test_swe_run_vmem_resident_matches_per_step():
+    single = ShallowWater(_cfg(shape=(32, 32)), devices=jax.devices()[:1])
+    truth_h, _ = _advance(single, "ap", 32)
+    r = single.run_vmem_resident(nt=32, warmup=0, chunk=8)
+    np.testing.assert_allclose(
+        np.asarray(r.h), np.asarray(truth_h), rtol=1e-12, atol=1e-14
+    )
+
+
+def test_swe_explicit_oversized_deep_depth_raises():
+    model = ShallowWater(_cfg(shape=(32, 32), dims=(2, 4)))
+    with pytest.raises(ValueError, match="exceeds a local shard extent"):
+        model.run_deep(nt=64, warmup=0, block_steps=64)
+
+
+def test_swe_hide_single_device_routes_to_perf():
+    cfg = _cfg()
+    model = ShallowWater(cfg, devices=jax.devices()[:1])
+    pf_h, _ = _advance(model, "perf", 20)
+    hd_h, _ = _advance(model, "hide", 20)
+    # Bit-identical: the single-device hide IS the perf program.
+    np.testing.assert_array_equal(np.asarray(hd_h), np.asarray(pf_h))
+
+
+def test_swe_run_reports_metrics():
+    model = ShallowWater(_cfg(nt=16, warmup=4), devices=jax.devices()[:1])
+    r = model.run("perf")
+    assert r.wtime > 0 and r.t_eff > 0 and r.gpts > 0
+    assert r.nt == 16 and r.warmup == 4
+
+
+def test_swe_app_runs(tmp_path):
+    import subprocess
+    import sys
+
+    out = tmp_path / "h.npy"
+    proc = subprocess.run(
+        [
+            sys.executable, "apps/swe_2d.py", "--cpu-devices", "4",
+            "--nx", "32", "--ny", "32", "--nt", "24", "--warmup", "4",
+            "--save-field", str(out),
+        ],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "mass drift" in proc.stdout
+    h = np.load(out)
+    assert h.shape == (32, 32)

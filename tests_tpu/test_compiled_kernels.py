@@ -447,3 +447,75 @@ def test_model_runners_compiled():
     np.testing.assert_array_equal(np.asarray(r_hide.T), np.asarray(r_perf.T))
     for r in (r_vmem, r_deep, r_tb):
         _close(r.T, r_perf.T)
+
+
+def test_swe_padded_kernel_compiled():
+    # Third workload (r4): the coupled padded SWE kernel vs its jnp twin.
+    from rocm_mpi_tpu.ops.swe_kernels import (
+        swe_step_padded,
+        swe_step_padded_pallas,
+    )
+
+    hp = _rand((34, 30))
+    ups = (_rand((34, 30), seed=1), _rand((34, 30), seed=2))
+    Mus = (jnp.ones((32, 28), jnp.float32), jnp.ones((32, 28), jnp.float32))
+    consts, dt, spacing = (1.0, 1.0), 1e-3, (0.1, 0.07)
+    got = swe_step_padded_pallas((hp,) + ups, Mus, consts, dt, spacing)
+    ref = swe_step_padded((hp,) + ups, Mus, consts, dt, spacing)
+    for g, r in zip(got, ref):
+        _close(g, r)
+
+
+def test_swe_vmem_multi_step_compiled():
+    # The whole-loop-in-VMEM coupled multi-step, compiled, vs the jnp
+    # roll form (masked_swe_step — the one definition of the update).
+    from rocm_mpi_tpu.ops.swe_kernels import (
+        masked_swe_step,
+        swe_coeffs,
+        swe_multi_step,
+    )
+
+    h0 = _rand((32, 32))
+    us0 = (jnp.zeros((32, 32), jnp.float32),) * 2
+    gidx0 = jax.lax.broadcasted_iota(jnp.int32, (32, 32), 0)
+    gidx1 = jax.lax.broadcasted_iota(jnp.int32, (32, 32), 1)
+    Mus = (
+        jnp.where(gidx0 >= 31, 0.0, 1.0).astype(jnp.float32),
+        jnp.where(gidx1 >= 31, 0.0, 1.0).astype(jnp.float32),
+    )
+    dt, spacing = 2e-3, (0.1, 0.1)
+    cH, cg = swe_coeffs(dt, spacing, 1.0, 1.0)
+    ref_h, ref_us = h0, us0
+    for _ in range(16):
+        ref_h, ref_us = masked_swe_step(ref_h, ref_us, Mus, cH, cg)
+    got_h, got_us = swe_multi_step(
+        h0, us0, Mus, dt, spacing, 1.0, 1.0, 16, chunk=8
+    )
+    _close(got_h, ref_h)
+    for g, r in zip(got_us, ref_us):
+        _close(g, r)
+
+
+def test_swe_deep_sweep_compiled():
+    # The SWE deep-halo sweep's masked VMEM kernel on a 1-device mesh.
+    from rocm_mpi_tpu.models.swe import SWEConfig, ShallowWater
+    from rocm_mpi_tpu.parallel.deep_halo import make_swe_deep_sweep
+
+    cfg = SWEConfig(
+        global_shape=(64, 64), lengths=(10.0, 10.0), nt=8, warmup=0,
+        dtype="f32", dims=(1, 1),
+    )
+    model = ShallowWater(cfg, devices=jax.devices()[:1])
+    h, us = model.init_state()
+    Mus = model.face_masks()
+    ref_h, ref_us = model.advance_fn("ap")(
+        jnp.copy(h), tuple(map(jnp.copy, us)), Mus, 8
+    )
+    sweep = jax.jit(
+        make_swe_deep_sweep(model.grid, 4, cfg.dt, cfg.spacing, cfg.H0,
+                            cfg.g)
+    )
+    got_h, got_us = sweep(*sweep(h, us))
+    _close(got_h, ref_h)
+    for gu, ru in zip(got_us, ref_us):
+        _close(gu, ru)
