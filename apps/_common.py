@@ -18,6 +18,26 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 OUTPUT_DIR = pathlib.Path(__file__).resolve().parent.parent / "output"
 
 
+def positive_int(v):
+    """argparse type: int >= 1 (shared by the workload app parsers)."""
+    import argparse
+
+    i = int(v)
+    if i < 1:
+        raise argparse.ArgumentTypeError(f"must be >= 1, got {v}")
+    return i
+
+
+def nonneg_int(v):
+    """argparse type: int >= 0 (shared by the workload app parsers)."""
+    import argparse
+
+    i = int(v)
+    if i < 0:
+        raise argparse.ArgumentTypeError(f"must be >= 0, got {v}")
+    return i
+
+
 def make_parser(
     variant: str, *, nx: int, ny: int, nt: int, do_vis: bool, nz: int = 0
 ):

@@ -17,17 +17,7 @@ from _common import OUTPUT_DIR, setup_jax  # noqa: E402
 def make_parser():
     import argparse
 
-    def positive_int(v):
-        i = int(v)
-        if i < 1:
-            raise argparse.ArgumentTypeError(f"must be >= 1, got {v}")
-        return i
-
-    def nonneg_int(v):
-        i = int(v)
-        if i < 0:
-            raise argparse.ArgumentTypeError(f"must be >= 0, got {v}")
-        return i
+    from _common import nonneg_int, positive_int
 
     p = argparse.ArgumentParser(description="2D/3D acoustic wave — leapfrog")
     p.add_argument("--nx", type=int, default=252)

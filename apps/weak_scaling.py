@@ -40,9 +40,10 @@ def main(argv=None) -> int:
                    "(run_deep, the flagship multi-chip schedule). "
                    "Default: hide (both workloads)")
     p.add_argument("--workload", default="diffusion",
-                   choices=["diffusion", "wave"],
-                   help="physics model: the diffusion flagship or the "
-                   "acoustic-wave second workload (variants "
+                   choices=["diffusion", "wave", "swe"],
+                   help="physics model: the diffusion flagship, the "
+                   "acoustic-wave second workload, or the shallow-water "
+                   "coupled workload (non-diffusion variants "
                    "ap/perf/hide/deep)")
     p.add_argument("--deep-k", type=int, default=None, metavar="K",
                    help="deep-halo sweep depth (default: run_deep's auto)")
@@ -60,16 +61,22 @@ def main(argv=None) -> int:
     jax = setup_jax(args)  # distributed init + --cpu-devices + x64, shared
     from rocm_mpi_tpu.config import DiffusionConfig
     from rocm_mpi_tpu.utils.logging import log0
-    from rocm_mpi_tpu.models import AcousticWave, HeatDiffusion, WaveConfig
+    from rocm_mpi_tpu.models import (
+        AcousticWave,
+        HeatDiffusion,
+        ShallowWater,
+        SWEConfig,
+        WaveConfig,
+    )
     from rocm_mpi_tpu.parallel.mesh import suggest_dims
 
     if args.variant is None:
         args.variant = "hide"
-    if args.workload == "wave" and args.variant not in (
+    if args.workload != "diffusion" and args.variant not in (
         "ap", "perf", "hide", "deep"
     ):
-        log0(f"--workload wave supports variants ap/perf/hide/deep, "
-             f"not {args.variant!r}")
+        log0(f"--workload {args.workload} supports variants "
+             f"ap/perf/hide/deep, not {args.variant!r}")
         return 2
 
     n_avail = len(jax.devices())
@@ -103,11 +110,11 @@ def main(argv=None) -> int:
             dtype=args.dtype,
             dims=dims,
         )
-        model_cls, cfg_cls = (
-            (AcousticWave, WaveConfig)
-            if args.workload == "wave"
-            else (HeatDiffusion, DiffusionConfig)
-        )
+        model_cls, cfg_cls = {
+            "wave": (AcousticWave, WaveConfig),
+            "swe": (ShallowWater, SWEConfig),
+            "diffusion": (HeatDiffusion, DiffusionConfig),
+        }[args.workload]
         model = model_cls(cfg_cls(**common), devices=jax.devices()[:n])
         if args.variant == "deep":
             # Both models default None to their own depth policy and

@@ -78,22 +78,16 @@ def masked_swe_step(h, us, Mus, cH, cg):
     return h, us
 
 
-def swe_step_padded(Sp, Mus, consts, dt, spacing):
-    """Candidate SWE update for every core cell of a width-1-padded block
-    (pure jnp) — the framework's padded contract (docs/ADDING_A_MODEL.md
-    §1) for a PYTREE state: `Sp = (hp, u0p, …)` are all width-1 padded
-    (ghosts from exchange_halo), `Mus` are core-shaped face masks,
-    `consts = (H, g)`. Returns the (h', u0', …) core tuple.
+def _swe_padded_math(hp, ups, Mus, cH, cg):
+    """The staggered-index C-grid update on width-1-padded values — THE
+    one copy of the coupled slicing arithmetic, shared by the jnp padded
+    form and the Pallas kernel body. Returns the (h', u0', …) core tuple.
 
     h' is computed on the core-plus-high-pad box (one extra cell on the
     high side of every axis) so the forward differences the velocity
     updates need never require a second exchange — one ghost exchange of
-    the full state advances the whole coupled step.
-    """
-    hp, *ups = Sp
-    H, g = consts
+    the full state advances the whole coupled step."""
     ndim = hp.ndim
-    cH, cg = swe_coeffs(dt, spacing, H, g)
     ext = tuple(slice(1, None) for _ in range(ndim))
     div = None
     for a, up in enumerate(ups):
@@ -115,38 +109,34 @@ def swe_step_padded(Sp, Mus, consts, dt, spacing):
     return tuple(outs)
 
 
+def swe_step_padded(Sp, Mus, consts, dt, spacing):
+    """Candidate SWE update for every core cell of a width-1-padded block
+    (pure jnp) — the framework's padded contract (docs/ADDING_A_MODEL.md
+    §1) for a PYTREE state: `Sp = (hp, u0p, …)` are all width-1 padded
+    (ghosts from exchange_halo), `Mus` are core-shaped face masks,
+    `consts = (H, g)`. Returns the (h', u0', …) core tuple
+    (_swe_padded_math has the index-arithmetic story)."""
+    hp, *ups = Sp
+    H, g = consts
+    cH, cg = swe_coeffs(dt, spacing, H, g)
+    return _swe_padded_math(hp, ups, Mus, cH, cg)
+
+
 def _swe_kernel_whole(*refs, ndim, cH, cg):
     """Whole-block Pallas twin of swe_step_padded: refs are
-    [hp, u0p…, Mu0…, oh, ou0…] (padded state, core masks, core outs)."""
+    [hp, u0p…, Mu0…, oh, ou0…] (padded state, core masks, core outs).
+    The index arithmetic is the shared _swe_padded_math on the
+    VMEM-resident values (consts pre-divided into cH/cg by the caller)."""
     n_state = ndim + 1
     pad_in = refs[:n_state]
     mask_in = refs[n_state:n_state + ndim]
     outs = refs[n_state + ndim:]
     vals = _upcast_for_compute(*[r[:] for r in pad_in + mask_in])
     Sp, Mus = vals[:n_state], vals[n_state:]
-    # Inline swe_step_padded's expression on the VMEM-resident values
-    # (consts are pre-divided into cH/cg by the caller).
     hp, *ups = Sp
-    ext = tuple(slice(1, None) for _ in range(ndim))
-    div = None
-    for a, up in enumerate(ups):
-        hi = [slice(1, None)] * ndim
-        lo = [slice(1, None)] * ndim
-        lo[a] = slice(0, -1)
-        d = cH[a] * (up[tuple(hi)] - up[tuple(lo)])
-        div = d if div is None else div + d
-    h_ext = hp[ext] - div
-    base = tuple(slice(0, -1) for _ in range(ndim))
-    h_core = h_ext[base]
-    core = tuple(slice(1, -1) for _ in range(ndim))
-    outs[0][:] = h_core.astype(outs[0].dtype)
-    for a, up in enumerate(ups):
-        sh = [slice(0, -1)] * ndim
-        sh[a] = slice(1, None)
-        dh = h_ext[tuple(sh)] - h_core
-        outs[a + 1][:] = (
-            Mus[a] * (up[core] - cg[a] * dh)
-        ).astype(outs[a + 1].dtype)
+    res = _swe_padded_math(hp, ups, Mus, cH, cg)
+    for o_ref, r in zip(outs, res):
+        o_ref[:] = r.astype(o_ref.dtype)
 
 
 def swe_step_padded_pallas(Sp, Mus, consts, dt, spacing, interpret=None):

@@ -115,6 +115,40 @@ def main() -> int:
     full_wave = gather_to_host0(Uw)
     full_wave_deep = gather_to_host0(Uw_deep)
 
+    # Third workload across the same process boundary (r4): the SWE
+    # model's pytree-state exchange — every coupled field's halo crosses
+    # processes in perf, through the overlap decomposition in hide, and as
+    # one width-k multi-field exchange in the deep sweep.
+    from rocm_mpi_tpu.models import SWEConfig, ShallowWater
+    from rocm_mpi_tpu.parallel.deep_halo import make_swe_deep_sweep
+
+    scfg = SWEConfig(
+        global_shape=cfg.global_shape, lengths=cfg.lengths, nt=n_steps,
+        warmup=0, dtype="f64", dims=cfg.dims,
+    )
+    swe = ShallowWater(scfg, devices=jax.devices())
+    sh0, sus0 = swe.init_state()
+    sMus = swe.face_masks()
+    sh0_full = gather_to_host0(sh0)
+    sh_p, _ = swe.advance_fn("perf")(
+        jnp.copy(sh0), tuple(map(jnp.copy, sus0)), sMus, n_steps
+    )
+    metrics.force(sh_p)
+    sh_h, _ = swe.advance_fn("hide")(
+        jnp.copy(sh0), tuple(map(jnp.copy, sus0)), sMus, n_steps
+    )
+    metrics.force(sh_h)
+    ssweep = jax.jit(
+        make_swe_deep_sweep(
+            swe.grid, n_steps, scfg.dt, scfg.spacing, scfg.H0, scfg.g
+        )
+    )
+    sh_d, _ = ssweep(sh0, sus0)
+    metrics.force(sh_d)
+    full_swe = gather_to_host0(sh_p)
+    full_swe_hide = gather_to_host0(sh_h)
+    full_swe_deep = gather_to_host0(sh_d)
+
     full = gather_to_host0(T)  # process_allgather branch
     if jax.process_index() == 0:
         assert full is not None and full.shape == cfg.global_shape
@@ -160,12 +194,30 @@ def main() -> int:
         np.testing.assert_allclose(
             full_wave_deep, want_wave, rtol=1e-12, atol=1e-13
         )
+
+        # SWE oracle: the numpy forward-backward update from the gathered
+        # initial height (velocities start at zero; H0 = g = 1).
+        from test_swe import _numpy_fb
+
+        want_swe, _ = _numpy_fb(
+            sh0_full,
+            [np.zeros(scfg.global_shape)] * len(scfg.global_shape),
+            scfg.dt, scfg.spacing, scfg.H0, scfg.g, n_steps,
+        )
+        np.testing.assert_allclose(full_swe, want_swe, rtol=1e-12,
+                                   atol=1e-13)
+        np.testing.assert_allclose(full_swe_hide, want_swe, rtol=1e-12,
+                                   atol=1e-13)
+        np.testing.assert_allclose(full_swe_deep, want_swe, rtol=1e-12,
+                                   atol=1e-13)
         print("DISTRIBUTED_OK", flush=True)
     else:
         assert full is None
         assert full_deep is None
         assert full_wave is None and full_wave_deep is None
         assert full_wave_hide is None
+        assert full_swe is None and full_swe_hide is None
+        assert full_swe_deep is None
     jax.distributed.shutdown()
     return 0
 

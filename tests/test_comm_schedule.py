@@ -196,3 +196,50 @@ def test_per_step_exchange_is_one_per_step_not_per_program():
     T, Cp = m.init_state()
     adv = m.advance_fn("perf")
     assert _cp_count(adv.lower(T, Cp, 1)) == _cp_count(adv.lower(T, Cp, 16))
+
+
+def test_swe_per_step_messages_all_fields():
+    # The coupled SWE update needs neighbors of every field, so the
+    # per-step schedule exchanges the whole pytree state: (ndim+1 fields)
+    # · 2 ppermutes per sharded axis per step.
+    from rocm_mpi_tpu.models.swe import SWEConfig, ShallowWater
+
+    scfg = SWEConfig(
+        global_shape=SHAPE, lengths=(10.0, 10.0), nt=8, warmup=0,
+        dtype="f32", dims=DIMS,
+    )
+    swe = ShallowWater(scfg)
+    h, us = swe.init_state()
+    Mus = swe.face_masks()
+    ndim = len(DIMS)
+    for variant in ("perf", "hide"):
+        assert _cp_count(
+            swe.advance_fn(variant).lower(h, us, Mus, 8)
+        ) == (ndim + 1) * 2 * ndim, variant
+
+
+def test_swe_deep_sweep_messages_per_k_steps():
+    # Deep-k: the same ndim+1 fields exchanged once per k steps — the k×
+    # message reduction holds for the coupled workload too.
+    from rocm_mpi_tpu.models.swe import SWEConfig, ShallowWater
+    from rocm_mpi_tpu.parallel.deep_halo import make_swe_deep_sweep
+
+    scfg = SWEConfig(
+        global_shape=SHAPE, lengths=(10.0, 10.0), nt=8, warmup=0,
+        dtype="f32", dims=DIMS,
+    )
+    swe = ShallowWater(scfg)
+    h, us = swe.init_state()
+    k = 4
+    sweep = make_swe_deep_sweep(
+        swe.grid, k, scfg.dt, scfg.spacing, scfg.H0, scfg.g
+    )
+
+    @jax.jit
+    def advance(h, us, n_sweeps):
+        return jax.lax.fori_loop(
+            0, n_sweeps, lambda _, s: sweep(s[0], s[1]), (h, us)
+        )
+
+    ndim = len(DIMS)
+    assert _cp_count(advance.lower(h, us, 2)) == (ndim + 1) * 2 * ndim
