@@ -365,20 +365,37 @@ def run_suite() -> None:
     row("128³ 3D per-step perf", (128, 128, 128), "run", 1_100, 100,
         variant="perf")
 
-    # Second workload (models.wave): per-step leapfrog through the same
-    # layers — 4 passes/step (read U, U_prev, C2; write U⁺).
-    from rocm_mpi_tpu.models.wave import AcousticWave, WaveConfig
+    # The other workloads through the same layers, one perf + one
+    # VMEM-resident row each at the diffusion rows' step protocol (wave:
+    # 4 passes/step; swe: 2·(ndim+1) passes/step — each RunResult's t_eff
+    # carries its own accounting). One loop so a protocol tune cannot
+    # drift between workloads.
+    from rocm_mpi_tpu.models import (
+        AcousticWave,
+        ShallowWater,
+        SWEConfig,
+        WaveConfig,
+    )
 
-    wcfg = WaveConfig(
-        global_shape=(252, 252), lengths=(10.0, 10.0), nt=220_000,
-        warmup=20_000, dtype="f32", dims=(1, 1),
-    )
-    report("252² wave per-step perf", AcousticWave(wcfg).run(variant="perf"))
-    wcfg_v = dataclasses.replace(wcfg, nt=32_768 + 1_048_576, warmup=32_768)
-    report(
-        "252² wave VMEM-resident loop",
-        AcousticWave(wcfg_v).run_vmem_resident(),
-    )
+    for name, cfg_cls, model_cls in (
+        ("wave", WaveConfig, AcousticWave),
+        ("swe", SWEConfig, ShallowWater),
+    ):
+        mcfg = cfg_cls(
+            global_shape=(252, 252), lengths=(10.0, 10.0), nt=220_000,
+            warmup=20_000, dtype="f32", dims=(1, 1),
+        )
+        report(
+            f"252² {name} per-step perf",
+            model_cls(mcfg).run(variant="perf"),
+        )
+        mcfg_v = dataclasses.replace(
+            mcfg, nt=32_768 + 1_048_576, warmup=32_768
+        )
+        report(
+            f"252² {name} VMEM-resident loop",
+            model_cls(mcfg_v).run_vmem_resident(),
+        )
 
 
 # --------------------------------------------------------------------------
@@ -577,9 +594,20 @@ def main() -> int:
     if "--suite" in argv:
         # Manual/diagnostic mode: no subprocess shielding; honor the
         # platform override BEFORE run_suite's first backend use, and keep
-        # exit code 0 (the no-TPU child code is a parent-retry signal).
+        # exit code 0 (the no-TPU child code is a parent-retry signal) —
+        # UNLESS --require-accelerator asks for queue semantics: there a
+        # CPU fallback must exit nonzero so the measurement queue records
+        # an INCOMPLETE artifact and retries, instead of promoting an
+        # empty skip log as the completed chip suite.
         _apply_platform_override()
         _setup_compilation_cache()
+        if "--require-accelerator" in argv and not _accelerated():
+            print(
+                "bench.py --suite --require-accelerator: CPU fallback, "
+                "refusing to record an empty suite artifact",
+                file=sys.stderr,
+            )
+            return 2
         run_suite()
         child_main(_env_budget())
         return 0

@@ -32,6 +32,7 @@ run() { # name timeout_s cmd...
 }
 
 run kernel_forms    1800 python scripts/bench_kernel_forms.py
+run bench_suite     3600 python bench.py --suite --require-accelerator
 run strip_overhead  1800 python scripts/bench_strip_overhead.py --require-accelerator
 run tb_stripes      2400 python scripts/bench_tb_stripes.py
 run bf16_error_chip 1800 python scripts/bench_bf16_error.py --require-accelerator
