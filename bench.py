@@ -601,13 +601,10 @@ def main() -> int:
         # empty skip log as the completed chip suite.
         _apply_platform_override()
         _setup_compilation_cache()
-        if "--require-accelerator" in argv and not _accelerated():
-            print(
-                "bench.py --suite --require-accelerator: CPU fallback, "
-                "refusing to record an empty suite artifact",
-                file=sys.stderr,
-            )
-            return 2
+        if "--require-accelerator" in argv:
+            from rocm_mpi_tpu.utils.backend import require_accelerator
+
+            require_accelerator("bench.py --suite")
         run_suite()
         child_main(_env_budget())
         return 0

@@ -519,3 +519,58 @@ def test_swe_deep_sweep_compiled():
     _close(got_h, ref_h)
     for gu, ru in zip(got_us, ref_us):
         _close(gu, ru)
+
+
+def test_swe_hide_strip_kernels_compiled():
+    # The SWE hide variant's strip combination: the pytree-state overlap
+    # decomposition with the coupled padded Pallas kernel per region —
+    # under shard_map on a 1-device mesh, so the slab-shaped SWE kernels
+    # compile on the chip even though the sharded hide path needs >= 2
+    # devices to be selected organically.
+    from jax import shard_map
+
+    from rocm_mpi_tpu.ops.swe_kernels import (
+        swe_step_padded,
+        swe_step_padded_pallas,
+    )
+    from rocm_mpi_tpu.parallel.mesh import init_global_grid
+    from rocm_mpi_tpu.parallel.overlap import make_overlap_step
+
+    grid = init_global_grid(48, 48, dims=(1, 1), devices=jax.devices()[:1])
+    dt, spacing = 1e-3, grid.spacing
+    consts = (1.0, 1.0)
+
+    def pu(Sp, Ml, lam, dt_, sp):
+        del lam
+        return swe_step_padded_pallas(Sp, Ml, consts, dt_, sp)
+
+    local = make_overlap_step(grid, pu, (8, 8), mask_boundary=False)
+    h = _rand((48, 48))
+    us = (_rand((48, 48), seed=1), _rand((48, 48), seed=2))
+    gi0 = jax.lax.broadcasted_iota(jnp.int32, (48, 48), 0)
+    gi1 = jax.lax.broadcasted_iota(jnp.int32, (48, 48), 1)
+    Mus = (
+        jnp.where(gi0 >= 47, 0.0, 1.0).astype(jnp.float32),
+        jnp.where(gi1 >= 47, 0.0, 1.0).astype(jnp.float32),
+    )
+
+    @jax.jit
+    def step(h, u0, u1, M0, M1):
+        return shard_map(
+            lambda hl, u0l, u1l, M0l, M1l: local(
+                (hl, u0l, u1l), (M0l, M1l), None, dt, spacing
+            ),
+            mesh=grid.mesh,
+            in_specs=(grid.spec,) * 5,
+            out_specs=(grid.spec,) * 3,
+            check_vma=False,
+        )(h, u0, u1, M0, M1)
+
+    got = step(h, us[0], us[1], Mus[0], Mus[1])
+    # Referee: the jnp padded form on the zero-padded whole block (the
+    # 1-device ghost convention).
+    pad = [(1, 1)] * 2
+    Sp = tuple(jnp.pad(f, pad) for f in (h,) + us)
+    ref = swe_step_padded(Sp, Mus, consts, dt, spacing)
+    for g, r in zip(got, ref):
+        _close(g, r)
