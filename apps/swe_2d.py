@@ -127,7 +127,7 @@ def main(argv=None) -> int:
     mass = float(jnp.sum(result.h, dtype=jnp.float64))
     log0(
         f"mass drift = {abs(mass - mass0) / abs(mass0):.3e} "
-        "(closed basin: exactly conserved up to fp rounding)"
+        "(closed basin: conserved up to storage-dtype rounding)"
     )
     if args.vis and len(shape) != 2:
         log0("--vis is 2D-only (heatmap); skipping the artifact")

@@ -282,3 +282,29 @@ def test_swe_app_runs(tmp_path):
     assert "mass drift" in proc.stdout
     h = np.load(out)
     assert h.shape == (32, 32)
+
+
+def test_swe_bf16_rounding_is_per_kernel_not_per_step():
+    """Storage-only bf16 holds for the SWE multi-step kernel too: the
+    traced kernel contains exactly 2·(ndim+1)+ndim dtype conversions for
+    bf16 operands — each state field in and out, each mask in —
+    INDEPENDENT of the unroll (the diffusion mechanical pin,
+    test_bf16_error.py, applied to the coupled workload)."""
+    from rocm_mpi_tpu.ops.swe_kernels import swe_multi_step_masked
+
+    h = jnp.zeros((32, 32), jnp.bfloat16)
+    us = (jnp.zeros((32, 32), jnp.bfloat16),) * 2
+    Mus = (jnp.ones((32, 32), jnp.bfloat16),) * 2
+    cH = cg = (1e-3, 1e-3)
+    counts = {
+        n: str(
+            jax.make_jaxpr(
+                lambda h, us, Mus: swe_multi_step_masked(
+                    h, us, Mus, cH, cg, n
+                )
+            )(h, us, Mus)
+        ).count("convert_element_type")
+        for n in (4, 16)
+    }
+    # 3 state in + 2 masks in + 3 state out = 8, whatever the unroll.
+    assert counts[4] == counts[16] == 8, counts
