@@ -1,0 +1,104 @@
+"""N-rank process launcher — the `srun -n N --mpi=pmix` analog (D9).
+
+The reference's multi-process entry point is a cluster launcher
+(/root/reference/README.md:18); this framework's launcher contract is the
+RMT_* env block consumed by parallel.distributed.maybe_initialize_distributed
+(RMT_COORDINATOR/RMT_NUM_PROCS/RMT_PROCESS_ID). `spawn_ranks` plays that
+launcher on one machine: it spawns N real Python processes wired by the
+contract, each with its own virtual CPU devices, so sharded programs cross
+genuine process boundaries (gloo) without a cluster. One implementation
+serves the 2-process test harness (tests/test_distributed.py) and the
+N-rank mechanics script (scripts/run_multiproc_mechanics.py).
+
+Robustness contract:
+  * every rank's pipes are drained CONCURRENTLY (a rank blocked writing
+    >64 KB to an unread pipe mid-collective would deadlock the others);
+  * a rank that outlives `timeout` is killed and its flushed output kept;
+  * every still-running rank is killed on any exit path (no leaked gloo
+    ranks holding the coordinator port).
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+import threading
+
+_ROOT = pathlib.Path(__file__).resolve().parents[2]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def spawn_ranks(
+    argv,
+    nprocs: int = 2,
+    timeout: float = 240,
+    init_timeout_s: int = 60,
+):
+    """Spawn `nprocs` ranks of `[sys.executable] + argv` under the RMT_*
+    launcher contract; return [(proc, (stdout, stderr)), ...] in rank
+    order. Callers judge returncodes (a killed-at-timeout rank reports
+    its signal code with whatever it flushed)."""
+    port = _free_port()
+    base = os.environ.copy()
+    # Ranks size their own device count (--cpu-devices); an inherited
+    # XLA_FLAGS device-count force would conflict with it.
+    base.pop("XLA_FLAGS", None)
+    procs = []
+    for pid in range(nprocs):
+        env = dict(
+            base,
+            JAX_PLATFORMS="cpu",
+            RMT_DISTRIBUTED="1",
+            RMT_COORDINATOR=f"127.0.0.1:{port}",
+            RMT_NUM_PROCS=str(nprocs),
+            RMT_PROCESS_ID=str(pid),
+            RMT_INIT_TIMEOUT_S=str(init_timeout_s),
+            # The spawned interpreter only gets the script's own dir on
+            # sys.path; prepend (never clobber) so inherited entries
+            # stay importable.
+            PYTHONPATH=os.pathsep.join(
+                [str(_ROOT)]
+                + ([base["PYTHONPATH"]] if "PYTHONPATH" in base else [])
+            ),
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable] + [str(a) for a in argv],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+                cwd=_ROOT,
+            )
+        )
+    outs: list = [None] * nprocs
+
+    def drain(i: int, p) -> None:
+        try:
+            outs[i] = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            outs[i] = p.communicate()
+
+    threads = [
+        threading.Thread(target=drain, args=(i, p), daemon=True)
+        for i, p in enumerate(procs)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return list(zip(procs, outs))

@@ -1,0 +1,54 @@
+"""N-process gloo launch of the weak-scaling harness (the `srun -n N
+--mpi=pmix` analog, /root/reference/README.md:18): plays the launcher via
+the shared RMT_* contract implementation
+(rocm_mpi_tpu.parallel.launcher.spawn_ranks), each rank contributing
+`--cpu-devices` virtual devices, so the largest mesh spans every process.
+A mechanics record (the interpret-mode rates are meaningless) proving the
+scaling loop, the pytree/deep exchanges, and the rank-0-gated reporting
+all cross real process boundaries at N > 2 — the committed artifact is
+docs/weak_scaling_gloo4_mechanics_r4.jsonl.
+
+    python scripts/run_multiproc_mechanics.py [nprocs] [-- extra flags...]
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from rocm_mpi_tpu.parallel.launcher import spawn_ranks  # noqa: E402
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    nprocs = int(argv.pop(0)) if argv and argv[0].isdigit() else 4
+    if argv and argv[0] == "--":
+        argv.pop(0)
+    app_flags = argv or [
+        "--cpu-devices", "2", "--local", "16", "--nt", "24",
+        "--warmup", "8", "--counts", "2,4,8", "--workload", "swe",
+        "--variant", "deep", "--deep-k", "8", "--json",
+    ]
+    results = spawn_ranks(
+        [str(ROOT / "apps" / "weak_scaling.py")] + app_flags,
+        nprocs=nprocs,
+        timeout=1200,
+        init_timeout_s=120,
+    )
+    rc = 0
+    for pid, (p, (out, err)) in enumerate(results):
+        if p.returncode != 0:
+            rc = 1
+            print(f"rank {pid} FAILED rc={p.returncode}\n{err[-2000:]}",
+                  file=sys.stderr)
+    # Rank 0 owns the report (log0-gated); echo its JSON rows.
+    for ln in results[0][1][0].splitlines():
+        if ln.startswith("{"):
+            print(ln)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

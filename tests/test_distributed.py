@@ -11,69 +11,24 @@ gather_to_host0's process_allgather branch, and metrics.force's
 non-addressable branch.
 """
 
-import os
 import pathlib
-import socket
-import subprocess
-import sys
+
+from rocm_mpi_tpu.parallel.launcher import spawn_ranks
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
 def _spawn_two_process(argv, timeout=240):
-    """Play the launcher (srun/PMIx analog): spawn 2 ranks of `argv` wired
-    by the RMT_* env contract, return [(proc, (stdout, stderr)), ...]."""
-    port = _free_port()
-    base = os.environ.copy()
-    # The workers size their own device count (2 cpu devices per process);
-    # an inherited XLA_FLAGS device-count force would conflict with it.
-    base.pop("XLA_FLAGS", None)
-    procs = []
-    for pid in range(2):
-        env = dict(
-            base,
-            JAX_PLATFORMS="cpu",
-            RMT_DISTRIBUTED="1",
-            RMT_COORDINATOR=f"127.0.0.1:{port}",
-            RMT_NUM_PROCS="2",
-            RMT_PROCESS_ID=str(pid),
-            RMT_INIT_TIMEOUT_S="60",
-            # The worker imports the package from the repo root (the spawned
-            # interpreter only gets the script's own dir on sys.path).
-            PYTHONPATH=os.pathsep.join(
-                [str(ROOT)] + ([base["PYTHONPATH"]] if "PYTHONPATH" in base else [])
-            ),
-        )
-        procs.append(
-            subprocess.Popen(
-                [sys.executable] + argv,
-                env=env,
-                stdout=subprocess.PIPE,
-                stderr=subprocess.PIPE,
-                text=True,
-                cwd=ROOT,
-            )
-        )
-    outs = []
-    try:
-        for p in procs:
-            outs.append(p.communicate(timeout=timeout))
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    for pid, (p, (out, err)) in enumerate(zip(procs, outs)):
+    """Play the launcher (srun/PMIx analog) via the shared N-rank
+    implementation (parallel.launcher.spawn_ranks), asserting every rank
+    exits cleanly."""
+    results = spawn_ranks(argv, nprocs=2, timeout=timeout)
+    for pid, (p, (out, err)) in enumerate(results):
         assert p.returncode == 0, (
             f"worker {pid} rc={p.returncode}\n--- stdout ---\n{out}"
             f"\n--- stderr ---\n{err[-3000:]}"
         )
-    return list(zip(procs, outs))
+    return results
 
 
 def test_two_process_distributed_step_and_gather():
