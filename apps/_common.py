@@ -217,10 +217,8 @@ def run_app(variant: str, args) -> int:
             )
             log0(f"wrote {path}")
             if getattr(args, "vis_shards", False) and grid.ndim == 2:
-                ppath = OUTPUT_DIR / f"poc_{variant}_{grid.nprocs}.png"
-                viz.save_shard_panels(
-                    T_v, grid.dims, ppath,
-                    title=f"per-device shards — {variant} mesh={grid.dims}",
+                ppath = viz.save_shard_panels_artifact(
+                    T_v, grid, variant, OUTPUT_DIR
                 )
                 log0(f"wrote {ppath}")
     else:

@@ -51,6 +51,11 @@ def make_parser():
     )
     p.add_argument("--vis", action="store_true")
     p.add_argument(
+        "--vis-shards", action="store_true",
+        help="also render one panel per device shard (the "
+        "poc_rocmaware.png-style halo-exchange proof; 2D + --vis only)",
+    )
+    p.add_argument(
         "--profile", default=None, metavar="DIR",
         help="trace the timed loop with jax.profiler into DIR (the "
         "--profile convention of the diffusion apps, SURVEY.md §5.1)",
@@ -154,6 +159,13 @@ def main(argv=None) -> int:
                 title=f"swe {label} nt={result.nt} mesh={grid.dims}",
             )
             log0(f"wrote {path}")
+            if args.vis_shards and grid.ndim == 2:
+                # signed: h oscillates around 0 — symmetric limits, or the
+                # troughs clip to flat colormap-bottom and hide seams.
+                ppath = viz.save_shard_panels_artifact(
+                    h_v, grid, f"swe_{label}", OUTPUT_DIR, signed=True
+                )
+                log0(f"wrote {ppath}")
     else:
         log0(f"maximum(|h|) = {float(jnp.abs(result.h).max())}")
     return 0

@@ -47,12 +47,18 @@ def save_heatmap(field, path, title: str | None = None) -> pathlib.Path:
     return path
 
 
-def save_shard_panels(field, dims, path, title: str | None = None):
+def save_shard_panels(field, dims, path, title: str | None = None,
+                      signed: bool = False):
     """Render each shard of a 2D field as its own panel — the halo-exchange
     PoC artifact (the reference's docs/poc_rocmaware.png shows one GKS
     window per rank, README.md:5-7). A working exchange shows the blob
     spilling smoothly across panel edges; a broken one shows clipped or
     seamed blobs.
+
+    `signed=True` scales the colormap symmetrically around 0 — required
+    for fields that oscillate (the SWE surface height): the default
+    non-negative scale would clip every trough to flat colormap-bottom,
+    hiding exactly the seams the artifact exists to expose.
     """
     import matplotlib
 
@@ -65,7 +71,8 @@ def save_shard_panels(field, dims, path, title: str | None = None):
     lx, ly = field.shape[0] // dims[0], field.shape[1] // dims[1]
     path = pathlib.Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
-    vmax = field.max() or 1.0
+    vmax = (np.abs(field).max() if signed else field.max()) or 1.0
+    vmin = -vmax if signed else 0.0
     # Panel rows follow display convention: axis 1 (y) is vertical,
     # top row = highest y shard, so panels tile like the field itself.
     fig, axes = plt.subplots(
@@ -76,8 +83,9 @@ def save_shard_panels(field, dims, path, title: str | None = None):
         for cy in range(dims[1]):
             shard = field[cx * lx:(cx + 1) * lx, cy * ly:(cy + 1) * ly]
             ax = axes[dims[1] - 1 - cy][cx]
-            ax.imshow(shard.T, origin="lower", cmap="inferno",
-                      vmin=0.0, vmax=vmax)
+            ax.imshow(shard.T, origin="lower",
+                      cmap="RdBu_r" if signed else "inferno",
+                      vmin=vmin, vmax=vmax)
             ax.set_title(f"device ({cx},{cy})", fontsize=8)
             ax.set_xticks([]), ax.set_yticks([])
     if title:
@@ -86,3 +94,16 @@ def save_shard_panels(field, dims, path, title: str | None = None):
     fig.savefig(path, dpi=120)
     plt.close(fig)
     return path
+
+
+def save_shard_panels_artifact(field, grid, label, out_dir,
+                               signed: bool = False):
+    """The app drivers' one entry point for the PoC panels: builds the
+    shared filename scheme (poc_<label>_<nprocs>.png) and title, so the
+    diffusion and SWE apps cannot drift on either. Returns the path."""
+    path = pathlib.Path(out_dir) / f"poc_{label}_{grid.nprocs}.png"
+    return save_shard_panels(
+        field, grid.dims, path,
+        title=f"per-device shards — {label} mesh={grid.dims}",
+        signed=signed,
+    )
