@@ -42,6 +42,13 @@ def main() -> int:
         help="text report path (the reference's ./prof.txt analog)",
     )
     args = parser.parse_args()
+    if args.checkpoint or args.resume:
+        # The profiling driver times a trace window, not a durable run;
+        # silently accepting the flags would let a user believe a
+        # multi-hour profiled run was checkpointed when it was not.
+        print("--checkpoint/--resume are not supported by the profiling "
+              "app; use the perf/hide apps for durable runs")
+        return 2
     if not 0 <= args.warmup < args.nt:
         parser.error(
             f"need 0 <= warmup < nt, got warmup={args.warmup} nt={args.nt} "

@@ -65,6 +65,9 @@ def make_parser():
         help="dump the final gathered surface height as .npy on process 0 "
         "(the machine-readable artifact, SURVEY.md §5.4)",
     )
+    from _common import add_checkpoint_flags
+
+    add_checkpoint_flags(p)
     return p
 
 
@@ -99,7 +102,33 @@ def main(argv=None) -> int:
     mass0 = float(jnp.sum(h0, dtype=jnp.float64))
     # One chain decides label AND runner together (the _common.py
     # convention: artifacts must identify the schedule that actually ran).
-    if args.deep:
+    if args.checkpoint:
+        if args.deep or args.vmem:
+            log0("--checkpoint supports the per-step variants; drop "
+                 "--deep/--vmem")
+            return 2
+        from _common import make_checkpoint_runner
+
+        from rocm_mpi_tpu.models.swe import SWERunResult
+
+        label = f"ckpt_{args.variant}"
+
+        def advance_state():
+            advance = model.advance_fn(args.variant)
+            h1, us1 = model.init_state()
+            Mus = model.face_masks()
+            return (
+                lambda s, n: tuple(advance(s[0], s[1], Mus, n)),
+                (h1, us1),
+            )
+
+        runner = make_checkpoint_runner(
+            args, log0, advance_state,
+            lambda s, ran, wtime: SWERunResult(
+                h=s[0], us=s[1], wtime=wtime, nt=ran, warmup=0, config=cfg
+            ),
+        )
+    elif args.deep:
         k_eff = model.effective_deep_depth(block_steps=args.deep, warn=False)
         label = f"deep{k_eff}"
         log0(f"--deep: running deep-halo sweeps (k={k_eff}) instead of "
@@ -124,11 +153,9 @@ def main(argv=None) -> int:
     with profile_ctx:
         result = runner()
     log0("done")
-    log0(
-        f"Executed {result.nt} steps in = {result.wtime:.3e} sec "
-        f"(@ T_eff = {result.t_eff:.2f} GB/s aggregate, "
-        f"{result.gpts:.4f} Gpts/s)"
-    )
+    from _common import report_checkpointed_line
+
+    report_checkpointed_line(result, args, log0)
     mass = float(jnp.sum(result.h, dtype=jnp.float64))
     log0(
         f"mass drift = {abs(mass - mass0) / abs(mass0):.3e} "

@@ -56,6 +56,9 @@ def make_parser():
         help="dump the final gathered displacement as .npy on process 0 "
         "(the machine-readable artifact, SURVEY.md §5.4)",
     )
+    from _common import add_checkpoint_flags
+
+    add_checkpoint_flags(p)
     return p
 
 
@@ -89,7 +92,32 @@ def main(argv=None) -> int:
     # --variant is ignored by the schedule overrides). For --deep, the
     # effective depth is computed once here and passed explicitly, so the
     # label cannot drift from the k run_deep executes.
-    if args.deep:
+    if args.checkpoint:
+        if args.deep or args.vmem:
+            log0("--checkpoint supports the per-step variants; drop "
+                 "--deep/--vmem")
+            return 2
+        from _common import make_checkpoint_runner
+
+        from rocm_mpi_tpu.models.wave import WaveRunResult
+
+        label = f"ckpt_{args.variant}"
+
+        def advance_state():
+            advance = model.advance_fn(args.variant)
+            U1, Uprev1, C2 = model.init_state()
+            return (
+                lambda s, n: tuple(advance(s[0], s[1], C2, n)),
+                (U1, Uprev1),
+            )
+
+        runner = make_checkpoint_runner(
+            args, log0, advance_state,
+            lambda s, ran, wtime: WaveRunResult(
+                U=s[0], wtime=wtime, nt=ran, warmup=0, config=cfg
+            ),
+        )
+    elif args.deep:
         k_eff = model.effective_deep_depth(
             block_steps=args.deep, warn=False
         )
@@ -116,11 +144,9 @@ def main(argv=None) -> int:
     with profile_ctx:
         result = runner()
     log0("done")
-    log0(
-        f"Executed {result.nt} steps in = {result.wtime:.3e} sec "
-        f"(@ T_eff = {result.t_eff:.2f} GB/s aggregate, "
-        f"{result.gpts:.4f} Gpts/s)"
-    )
+    from _common import report_checkpointed_line
+
+    report_checkpointed_line(result, args, log0)
     if args.vis and len(shape) != 2:
         log0("--vis is 2D-only (heatmap); skipping the artifact")
         args.vis = False

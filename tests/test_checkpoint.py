@@ -1,0 +1,99 @@
+"""Checkpoint/resume (SURVEY.md §5.4 upgraded — utils/checkpoint.py):
+segmented runs must be bitwise-identical to straight runs, a resumed run
+must land exactly where the uninterrupted one does, and restores must
+come back with the original shardings. Exercised on the sharded mesh
+(orbax saves per-shard) and at the app layer via the --checkpoint/--resume
+flags."""
+
+import pathlib
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rocm_mpi_tpu.models.swe import SWEConfig, ShallowWater
+from rocm_mpi_tpu.utils import checkpoint as ckpt
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _swe(dims=(2, 4)):
+    cfg = SWEConfig(
+        global_shape=(32, 32), lengths=(10.0, 10.0), nt=48, warmup=0,
+        dtype="f64", dims=dims,
+    )
+    model = ShallowWater(cfg)
+    h, us = model.init_state()
+    Mus = model.face_masks()
+    advance = model.advance_fn("perf")
+    adv = lambda s, n: tuple(advance(s[0], s[1], Mus, n))
+    return model, adv, (h, us)
+
+
+def test_segmented_run_bitwise_equals_straight(tmp_path):
+    _, adv, state = _swe()
+    ref = adv((jnp.copy(state[0]), tuple(map(jnp.copy, state[1]))), 48)
+    out = ckpt.run_segmented(adv, state, 48, tmp_path, every=16)
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(ref[0]))
+    for ou, ru in zip(out[1], ref[1]):
+        np.testing.assert_array_equal(np.asarray(ou), np.asarray(ru))
+    assert ckpt.latest_step(tmp_path) == 48
+
+
+def test_crash_resume_lands_on_straight_run(tmp_path):
+    model, adv, state = _swe()
+    ref = adv((jnp.copy(state[0]), tuple(map(jnp.copy, state[1]))), 48)
+    # "Crash" after 32 of 48 steps...
+    ckpt.run_segmented(adv, state, 32, tmp_path, every=16)
+    assert ckpt.latest_step(tmp_path) == 32
+    # ...then resume from a FRESH process-state template (new model,
+    # new initializer arrays), as the app's --resume path does.
+    h2, us2 = model.init_state()
+    restored = ckpt.restore_state(tmp_path, 32, (h2, us2))
+    assert restored[0].sharding == h2.sharding
+    out = ckpt.run_segmented(
+        adv, restored, 48, tmp_path, every=16, start_step=32
+    )
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(ref[0]))
+
+
+def test_interval_and_window_validation(tmp_path):
+    _, adv, state = _swe(dims=(1, 1))
+    with pytest.raises(ValueError, match="interval"):
+        ckpt.run_segmented(adv, state, 8, tmp_path, every=0)
+    with pytest.raises(ValueError, match="start_step"):
+        ckpt.run_segmented(adv, state, 8, tmp_path, every=4, start_step=9)
+
+
+def test_latest_step_empty_dir(tmp_path):
+    assert ckpt.latest_step(tmp_path / "nonexistent") is None
+
+
+def test_app_checkpoint_then_resume(tmp_path):
+    """The app-layer contract: a run checkpointed at nt=24 then resumed to
+    nt=48 must end on the same field as one straight 48-step run."""
+    d = tmp_path / "ck"
+    straight = tmp_path / "straight.npy"
+    resumed = tmp_path / "resumed.npy"
+    common = [
+        sys.executable, "apps/swe_2d.py", "--cpu-devices", "4",
+        "--nx", "24", "--ny", "24", "--warmup", "0",
+    ]
+
+    def run(extra):
+        proc = subprocess.run(
+            common + extra, capture_output=True, text=True, timeout=600,
+            cwd=ROOT,
+        )
+        assert proc.returncode == 0, proc.stderr
+        return proc.stdout
+
+    run(["--nt", "48", "--save-field", str(straight)])
+    run(["--nt", "24", "--checkpoint", str(d), "--ckpt-every", "12"])
+    out = run(["--nt", "48", "--checkpoint", str(d), "--resume",
+               "--save-field", str(resumed)])
+    assert "restoring step 24" in out
+    np.testing.assert_array_equal(np.load(resumed), np.load(straight))
