@@ -121,19 +121,28 @@ def add_checkpoint_flags(p) -> None:
     )
 
 
-def checkpointed_run(args, advance, init_state, log0):
+def checkpointed_run(args, advance, init_state, log0, quantum: int = 1):
     """--checkpoint mode: segmented advance with orbax saves between
     segments; --resume restores the latest step first. `advance(state, n)
     -> state` is the framework's standard traced-step-count contract, so
     all segments share one compiled program. Returns
     (final_state, steps_run_here, wtime) — wtime spans the segmented loop
     INCLUDING save time (this is the durability mode, not the benchmark
-    protocol; the reported rate says so)."""
+    protocol; the reported rate says so).
+
+    `quantum` is the schedule's step granularity (the deep schedule
+    advances k steps per sweep): the save interval is rounded UP to a
+    multiple of it, so segment lengths never truncate a sweep."""
     import time
 
     from rocm_mpi_tpu.utils import checkpoint as ckpt
 
     every = args.ckpt_every or max(args.nt // 4, 1)
+    if every % quantum:
+        rounded = ((every // quantum) + 1) * quantum
+        log0(f"--ckpt-every {every} rounded to {rounded} (the schedule "
+             f"advances {quantum} steps at a time)")
+        every = rounded
     start = 0
     state = init_state
     if args.resume:
@@ -145,6 +154,18 @@ def checkpointed_run(args, advance, init_state, log0):
         else:
             log0(f"--resume: no checkpoint under {args.checkpoint}; "
                  "starting from the initial condition")
+    # A checkpoint written by a different schedule/nt can land on a step
+    # the current schedule cannot reach exactly (the deep advance moves k
+    # steps per sweep and its trip count floors — a misaligned window
+    # would silently drop up to k-1 trailing steps). Refuse loudly.
+    if start % quantum or (args.nt - start) % quantum:
+        log0(
+            f"--resume: checkpoint step {start} / window {args.nt - start} "
+            f"is not a multiple of the schedule's step quantum {quantum} "
+            "(was this checkpoint written by a different schedule or nt?); "
+            "resume with the schedule that wrote it or adjust --nt"
+        )
+        raise SystemExit(2)
     if start >= args.nt:
         log0(f"--resume: checkpoint already at step {start} >= nt={args.nt};"
              " nothing to run")
@@ -159,7 +180,22 @@ def checkpointed_run(args, advance, init_state, log0):
     return state, args.nt - start, wtime
 
 
-def make_checkpoint_runner(args, log0, advance_state, make_result):
+def checkpoint_schedule(args, model, per_step_label, make_per_step):
+    """The one chooser for checkpoint mode's schedule: returns
+    (make_advance, quantum, label). With --deep it builds the model's
+    deep advance ONCE and uses the k that deep_advance_fn itself returns
+    (single source — label, quantum, and executed depth cannot diverge);
+    otherwise the per-step variant with quantum 1."""
+    if getattr(args, "deep", 0):
+        advance, k = model.deep_advance_fn(
+            block_steps=args.deep, nt=args.nt, warmup=0
+        )
+        return (lambda: advance), k, f"ckpt_deep{k}"
+    return make_per_step, 1, f"ckpt_{per_step_label}"
+
+
+def make_checkpoint_runner(args, log0, advance_state, make_result,
+                           quantum: int = 1):
     """The one checkpoint-mode runner shared by the workload apps:
     `advance_state() -> (adv, init_state)` builds the model's segmented
     advance (the standard `adv(state, n) -> state` contract) and
@@ -171,7 +207,9 @@ def make_checkpoint_runner(args, log0, advance_state, make_result):
 
     def runner():
         adv, init_state = advance_state()
-        state, ran, wtime = checkpointed_run(args, adv, init_state, log0)
+        state, ran, wtime = checkpointed_run(
+            args, adv, init_state, log0, quantum=quantum
+        )
         return make_result(state, ran, wtime)
 
     return runner
@@ -267,35 +305,45 @@ def run_app(variant: str, args) -> int:
     )
 
     profile_ctx = profile_context(jax, args)
+    ckpt_mode = bool(getattr(args, "checkpoint", None))
     if getattr(args, "deep", 0):
         # The deep-halo schedule replaces the variant's own step entirely
         # (variant-specific knobs like --b-width are unused); label the
         # run and its artifacts with the depth that will actually execute
         # — the model's own accounting, so label and executed k cannot
         # drift (run_deep degrades k when the step counts aren't
-        # divisible).
-        k_eff = model.effective_deep_depth(block_steps=args.deep, warn=False)
+        # divisible). Checkpoint mode has no warmup window, so its k is
+        # gcd'd against nt alone — computed here so label and executed
+        # depth agree in that mode too.
+        k_eff = model.effective_deep_depth(
+            warmup=0 if ckpt_mode else None,
+            block_steps=args.deep, warn=False,
+        )
         variant = f"deep{k_eff}"
         log0(f"--deep: running deep-halo sweeps (k={k_eff}"
              + (f", degraded from {args.deep}" if k_eff != args.deep else "")
              + ") instead of the per-step variant")
-    if getattr(args, "checkpoint", None):
-        if getattr(args, "deep", 0):
-            log0("--checkpoint supports the per-step variants "
-                 "(--deep replaces the step program); drop one of the two")
-            return 2
+    if ckpt_mode:
         from rocm_mpi_tpu.models.diffusion import RunResult
 
+        per_step = variant  # bind before the label rebinding below
+        make_advance, quantum, variant = checkpoint_schedule(
+            args, model, per_step, lambda: model.advance_fn(per_step)
+        )
+
         def advance_state():
-            advance = model.advance_fn(variant)
-            T0, Cp = model.init_state()
-            return (lambda s, n: (advance(s[0], s[1], n), s[1])), (T0, Cp)
+            advance = make_advance()
+            return (
+                lambda s, n: (advance(s[0], s[1], n), s[1]),
+                model.init_state(),
+            )
 
         runner = make_checkpoint_runner(
             args, log0, advance_state,
             lambda s, ran, wtime: RunResult(
                 T=s[0], wtime=wtime, nt=ran, warmup=0, config=cfg
             ),
+            quantum=quantum,
         )
         with profile_ctx:
             result = runner()

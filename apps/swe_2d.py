@@ -103,18 +103,21 @@ def main(argv=None) -> int:
     # One chain decides label AND runner together (the _common.py
     # convention: artifacts must identify the schedule that actually ran).
     if args.checkpoint:
-        if args.deep or args.vmem:
-            log0("--checkpoint supports the per-step variants; drop "
-                 "--deep/--vmem")
+        if args.vmem:
+            log0("--checkpoint supports the per-step and deep schedules; "
+                 "drop --vmem")
             return 2
-        from _common import make_checkpoint_runner
+        from _common import checkpoint_schedule, make_checkpoint_runner
 
         from rocm_mpi_tpu.models.swe import SWERunResult
 
-        label = f"ckpt_{args.variant}"
+        make_advance, quantum, label = checkpoint_schedule(
+            args, model, args.variant,
+            lambda: model.advance_fn(args.variant),
+        )
 
         def advance_state():
-            advance = model.advance_fn(args.variant)
+            advance = make_advance()
             h1, us1 = model.init_state()
             Mus = model.face_masks()
             return (
@@ -127,6 +130,7 @@ def main(argv=None) -> int:
             lambda s, ran, wtime: SWERunResult(
                 h=s[0], us=s[1], wtime=wtime, nt=ran, warmup=0, config=cfg
             ),
+            quantum=quantum,
         )
     elif args.deep:
         k_eff = model.effective_deep_depth(block_steps=args.deep, warn=False)

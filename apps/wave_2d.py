@@ -93,18 +93,21 @@ def main(argv=None) -> int:
     # effective depth is computed once here and passed explicitly, so the
     # label cannot drift from the k run_deep executes.
     if args.checkpoint:
-        if args.deep or args.vmem:
-            log0("--checkpoint supports the per-step variants; drop "
-                 "--deep/--vmem")
+        if args.vmem:
+            log0("--checkpoint supports the per-step and deep schedules; "
+                 "drop --vmem")
             return 2
-        from _common import make_checkpoint_runner
+        from _common import checkpoint_schedule, make_checkpoint_runner
 
         from rocm_mpi_tpu.models.wave import WaveRunResult
 
-        label = f"ckpt_{args.variant}"
+        make_advance, quantum, label = checkpoint_schedule(
+            args, model, args.variant,
+            lambda: model.advance_fn(args.variant),
+        )
 
         def advance_state():
-            advance = model.advance_fn(args.variant)
+            advance = make_advance()
             U1, Uprev1, C2 = model.init_state()
             return (
                 lambda s, n: tuple(advance(s[0], s[1], C2, n)),
@@ -116,6 +119,7 @@ def main(argv=None) -> int:
             lambda s, ran, wtime: WaveRunResult(
                 U=s[0], wtime=wtime, nt=ran, warmup=0, config=cfg
             ),
+            quantum=quantum,
         )
     elif args.deep:
         k_eff = model.effective_deep_depth(

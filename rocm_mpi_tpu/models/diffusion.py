@@ -584,6 +584,37 @@ class HeatDiffusion:
             stacklevel=3,
         )
 
+    def deep_advance_fn(
+        self,
+        block_steps: int | None = None,
+        nt: int | None = None,
+        warmup: int | None = None,
+    ):
+        """(jitted (T, Cp, n_steps) -> T, executed depth k) — the deep
+        schedule's advance as a first-class function, so callers beyond
+        run_deep (the --checkpoint segmented loop) can drive the sweep.
+        `n_steps` must be a multiple of k (the fori_loop trip count
+        floors) — the step-count convention every model's deep advance
+        shares (wave/swe match)."""
+        from rocm_mpi_tpu.parallel.deep_halo import make_deep_sweep
+
+        cfg = self.config
+        if cfg.halo_transport == "host":
+            # The warning lives with the schedule builder so EVERY deep
+            # caller (run_deep, the --checkpoint segmented loop) gets it.
+            warn_host_transport_ignored("deep", stacklevel=3)
+        k = self.effective_deep_depth(nt, warmup, block_steps)
+        dt = cfg.jax_dtype(cfg.dt)
+        sweep = make_deep_sweep(self.grid, k, cfg.lam, dt, cfg.spacing)
+
+        @functools.partial(jax.jit, donate_argnums=0)
+        def advance(T, Cp, n_steps):
+            return lax.fori_loop(
+                0, n_steps // k, lambda _, x: sweep(x, Cp), T
+            )
+
+        return advance, k
+
     def run_deep(
         self,
         nt: int | None = None,
@@ -601,28 +632,19 @@ class HeatDiffusion:
         the deepest VMEM-fitting depth; HBM-resident shards cap the
         default at 8 (default_deep_depth).
         """
-        from rocm_mpi_tpu.parallel.deep_halo import make_deep_sweep
-
         cfg = self.config
         nt = cfg.nt if nt is None else nt
         warmup = cfg.warmup if warmup is None else warmup
         if not 0 <= warmup < nt:
             raise ValueError(f"need 0 <= warmup < nt, got {warmup}, {nt}")
-        if cfg.halo_transport == "host":
-            warn_host_transport_ignored("deep", stacklevel=2)
-        k = self.effective_deep_depth(nt, warmup, block_steps)
-        dt = cfg.jax_dtype(cfg.dt)
-        sweep = make_deep_sweep(self.grid, k, cfg.lam, dt, cfg.spacing)
-
-        @functools.partial(jax.jit, donate_argnums=0)
-        def advance(T, Cp, n_sweeps):
-            return lax.fori_loop(0, n_sweeps, lambda _, x: sweep(x, Cp), T)
-
+        advance, _ = self.deep_advance_fn(
+            block_steps=block_steps, nt=nt, warmup=warmup
+        )
         T, Cp = self.init_state()
         timer = metrics.Timer()
-        T = advance(T, Cp, warmup // k)
+        T = advance(T, Cp, warmup)
         timer.tic(T)
-        T = advance(T, Cp, (nt - warmup) // k)
+        T = advance(T, Cp, nt - warmup)
         wtime = timer.toc(T)
         return RunResult(T=T, wtime=wtime, nt=nt, warmup=warmup, config=cfg)
 

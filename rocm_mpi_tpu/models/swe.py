@@ -377,15 +377,18 @@ class ShallowWater:
             )
         return eff
 
-    def run_deep(
+    def deep_advance_fn(
         self,
+        block_steps: int | None = None,
         nt: int | None = None,
         warmup: int | None = None,
-        block_steps: int | None = None,
-    ) -> SWERunResult:
-        """Sharded fast path: deep-halo sweeps — ONE width-k ghost
-        exchange of the whole coupled state per k steps
-        (parallel.deep_halo.make_swe_deep_sweep)."""
+    ):
+        """(jitted (h, us, Mus, n_steps) -> (h, us), executed depth k) —
+        the SWE deep schedule's advance as a first-class function
+        (HeatDiffusion.deep_advance_fn); `n_steps` must be a multiple of
+        k (the fori_loop trip count floors). Mus is accepted and ignored
+        so the signature matches advance_fn's (deep sweeps build padded
+        masks internally)."""
         from rocm_mpi_tpu.parallel.deep_halo import make_swe_deep_sweep
 
         cfg = self.config
@@ -396,9 +399,21 @@ class ShallowWater:
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def advance(h, us, Mus, n):
-            del Mus  # deep sweeps build padded masks internally
+            del Mus
             return lax.fori_loop(
                 0, n // k, lambda _, s: sweep(s[0], s[1]), (h, us)
             )
 
+        return advance, k
+
+    def run_deep(
+        self,
+        nt: int | None = None,
+        warmup: int | None = None,
+        block_steps: int | None = None,
+    ) -> SWERunResult:
+        """Sharded fast path: deep-halo sweeps — ONE width-k ghost
+        exchange of the whole coupled state per k steps
+        (parallel.deep_halo.make_swe_deep_sweep)."""
+        advance, _ = self.deep_advance_fn(block_steps, nt, warmup)
         return self._run_timed(advance, nt, warmup)

@@ -349,17 +349,16 @@ class AcousticWave:
             )
         return eff
 
-    def run_deep(
+    def deep_advance_fn(
         self,
+        block_steps: int | None = None,
         nt: int | None = None,
         warmup: int | None = None,
-        block_steps: int | None = None,
-    ) -> WaveRunResult:
-        """Sharded fast path: deep-halo sweeps for the wave — one width-k
-        ghost exchange of the leapfrog state pair per k steps
-        (parallel.deep_halo.make_wave_deep_sweep), the second workload on
-        the flagship multi-chip schedule (HeatDiffusion.run_deep).
-        """
+    ):
+        """(jitted (U, Uprev, C2, n_steps) -> (U, Uprev), executed depth
+        k) — the wave deep schedule's advance as a first-class function
+        (HeatDiffusion.deep_advance_fn); `n_steps` must be a multiple of
+        k (the fori_loop trip count floors)."""
         from rocm_mpi_tpu.parallel.deep_halo import make_wave_deep_sweep
 
         cfg = self.config
@@ -373,4 +372,18 @@ class AcousticWave:
                 0, n // k, lambda _, s: sweep(s[0], s[1], C2), (U, Uprev)
             )
 
+        return advance, k
+
+    def run_deep(
+        self,
+        nt: int | None = None,
+        warmup: int | None = None,
+        block_steps: int | None = None,
+    ) -> WaveRunResult:
+        """Sharded fast path: deep-halo sweeps for the wave — one width-k
+        ghost exchange of the leapfrog state pair per k steps
+        (parallel.deep_halo.make_wave_deep_sweep), the second workload on
+        the flagship multi-chip schedule (HeatDiffusion.run_deep).
+        """
+        advance, _ = self.deep_advance_fn(block_steps, nt, warmup)
         return self._run_timed(advance, nt, warmup)

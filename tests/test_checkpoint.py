@@ -97,3 +97,32 @@ def test_app_checkpoint_then_resume(tmp_path):
                "--save-field", str(resumed)])
     assert "restoring step 24" in out
     np.testing.assert_array_equal(np.load(resumed), np.load(straight))
+
+def test_deep_schedule_checkpoint_resume_app(tmp_path):
+    """The deep schedule is checkpointable too (quantum = sweep depth k):
+    a --deep run checkpointed at 24 then resumed to 48 must end on the
+    same field as one straight --deep 48-step run; the save interval
+    rounds up to a multiple of k."""
+    d = tmp_path / "ck"
+    straight = tmp_path / "straight.npy"
+    resumed = tmp_path / "resumed.npy"
+    common = [
+        sys.executable, "apps/swe_2d.py", "--cpu-devices", "4",
+        "--nx", "24", "--ny", "24", "--warmup", "0", "--deep", "8",
+    ]
+
+    def run(extra):
+        proc = subprocess.run(
+            common + extra, capture_output=True, text=True, timeout=600,
+            cwd=ROOT,
+        )
+        assert proc.returncode == 0, proc.stderr
+        return proc.stdout
+
+    run(["--nt", "48", "--save-field", str(straight)])
+    out = run(["--nt", "24", "--checkpoint", str(d), "--ckpt-every", "10"])
+    assert "rounded to 16" in out  # 10 → next multiple of k=8
+    out = run(["--nt", "48", "--checkpoint", str(d), "--resume",
+               "--save-field", str(resumed)])
+    assert "restoring step 24" in out
+    np.testing.assert_array_equal(np.load(resumed), np.load(straight))
