@@ -126,3 +126,26 @@ def test_deep_schedule_checkpoint_resume_app(tmp_path):
                "--save-field", str(resumed)])
     assert "restoring step 24" in out
     np.testing.assert_array_equal(np.load(resumed), np.load(straight))
+
+
+def test_resume_refuses_quantum_misaligned_checkpoint(tmp_path):
+    """A checkpoint written by one schedule must not silently lose steps
+    under another: resuming a step-12 checkpoint with --deep 9 (quantum
+    9, window 24) exits 2 with the mismatch spelled out."""
+    d = tmp_path / "ck"
+    common = [
+        sys.executable, "apps/swe_2d.py", "--cpu-devices", "2",
+        "--nx", "24", "--ny", "24", "--warmup", "0",
+    ]
+    proc = subprocess.run(
+        common + ["--nt", "12", "--checkpoint", str(d), "--ckpt-every", "6"],
+        capture_output=True, text=True, timeout=600, cwd=ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr
+    proc = subprocess.run(
+        common + ["--nt", "36", "--deep", "9", "--checkpoint", str(d),
+                  "--resume"],
+        capture_output=True, text=True, timeout=600, cwd=ROOT,
+    )
+    assert proc.returncode == 2, (proc.returncode, proc.stdout)
+    assert "not a multiple of the schedule's step quantum" in proc.stdout
