@@ -1,16 +1,24 @@
 #!/usr/bin/env bash
-# Tunnel-recovery watcher for the round-4 chip queue.
+# Tunnel-recovery watcher for the round-5 chip work.
 #
 # The chip tunnel flaps (documented multi-hour outages in BASELINE.md); a
 # measurement session must start the moment a healthy window opens. This
-# loop probes with a bounded-timeout trivial jit every ~60 s; on the first
-# healthy probe it runs, in priority order:
-#   1. the compiled-Mosaic test tier (tests_tpu/, live-tee'd log)
-#   2. scripts/run_chip_queue.sh (the BASELINE.md measurement debt)
-# The persistent XLA compilation cache is enabled for every child, so a
-# mid-queue drop never re-pays compiles already done.
+# loop probes with a bounded-timeout trivial jit every ~60 s; on each
+# healthy probe it runs, in priority order (VERDICT r4 next #1 — the
+# round-4 ordering spent the only healthy window on compiles and banked
+# nothing):
+#   1. bench.py — the driver-contract headline number. Emit-as-you-go
+#      lands a real chip rate on stdout in seconds (floor kernel compiles
+#      fast; the persistent cache makes retries instant), so even a
+#      minutes-long window banks the one number the round is scored on.
+#   2. scripts/run_chip_queue.sh — the measurement debt, value-ordered,
+#      per-artifact resumable.
+#   3. the compiled-Mosaic tier, one ranked sub-group at a time
+#      (pytest -m g1..g4, tests_tpu/conftest.py), each group's log
+#      promoted independently — a short window still banks g1 (the
+#      scored-path kernels) instead of an all-or-INCOMPLETE log.
 #
-# Usage: nohup scripts/chip_watcher.sh > .watcher_r4.log 2>&1 &
+# Usage: nohup scripts/chip_watcher.sh > .watcher_r5.log 2>&1 &
 # (log path deliberately untracked — the live file grows while the watcher
 # runs; commit a snapshot into docs/ only after it finishes)
 set -u
@@ -21,7 +29,11 @@ cd "$(dirname "$0")/.."
 # themselves — no point exporting those here, they'd be overridden).
 export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$PWD/.jax_cache}"
 
-DEADLINE=$(( $(date +%s) + ${WATCH_HOURS:-10} * 3600 ))
+DEADLINE=$(( $(date +%s) + ${WATCH_HOURS:-11} * 3600 ))
+# The ranked sub-groups come FROM tests_tpu/conftest.py (its _GROUPS line)
+# so the two lists cannot drift; the fallback only covers a parse failure.
+TIER_GROUPS=($(sed -n 's/^_GROUPS = (\(.*\))$/\1/p' tests_tpu/conftest.py | tr -d '",'))
+[ "${#TIER_GROUPS[@]}" -gt 0 ] || TIER_GROUPS=(g1 g2 g3 g4)
 
 probe() {
   # A CPU fallback must NOT count as healthy: when the accelerator plugin
@@ -39,12 +51,84 @@ print("probe ok on", dev)
 EOF
 }
 
+headline_done() {
+  # Complete = the promoted log's last JSON line is a real accelerator
+  # measurement (no "error" field — smoke/fallback lines carry one).
+  [ -s docs/bench_headline_r5.txt ] \
+    && grep -q '"metric"' docs/bench_headline_r5.txt \
+    && ! tail -1 docs/bench_headline_r5.txt | grep -q '"error"'
+}
+
+run_headline() {
+  # The headline budget is deliberately modest: with the floor emit a real
+  # number lands in well under a minute; 300 s covers cold-cache compiles.
+  # The outer kill is derived from the budget (+60 s grace) so raising
+  # BENCH_HEADLINE_BUDGET_S can never make the wrapper kill bench.py
+  # before its own parent prints the contract line.
+  local budget="${BENCH_HEADLINE_BUDGET_S:-300}"
+  BENCH_BUDGET_S="$budget" \
+    timeout -k 15 $((budget + 60)) python bench.py > docs/bench_headline_r5.txt.part 2> .bench_headline_stderr.log
+  local rc=$?
+  cat .bench_headline_stderr.log
+  cat docs/bench_headline_r5.txt.part
+  if [ "$rc" -eq 0 ] && [ -s docs/bench_headline_r5.txt.part ] \
+      && ! tail -1 docs/bench_headline_r5.txt.part | grep -q '"error"'; then
+    { echo "# bench.py headline run at $(date -u +%FT%TZ) (stdout contract line last)"
+      grep -E "floor|flagship|long window|µs/step|us/step" .bench_headline_stderr.log || true
+      cat docs/bench_headline_r5.txt.part; } > docs/bench_headline_r5.txt
+    rm -f docs/bench_headline_r5.txt.part
+    echo "[watcher] headline banked: $(tail -1 docs/bench_headline_r5.txt)"
+  else
+    rm -f docs/bench_headline_r5.txt.part
+    echo "[watcher] headline attempt rc=$rc did not produce a real chip line"
+  fi
+}
+
+group_log() { echo "docs/tpu_tier_${1}_r5.txt"; }
+
+group_done() {
+  # Promoted only on pytest rc=0 with a pass count and no skips (a
+  # mid-window CPU fallback would green-skip the whole group).
+  local log; log="$(group_log "$1")"
+  [ -s "$log" ] \
+    && grep -qE "[0-9]+ passed" "$log" \
+    && ! grep -qE "[0-9]+ skipped" "$log" \
+    && ! grep -q "^INCOMPLETE" "$log"
+}
+
 tier_done() {
-  # The log is only promoted to this path on pytest rc=0 (else it gets an
-  # INCOMPLETE header), so done = exists, has a pass count, no header.
-  [ -s docs/tpu_test_log_r4.txt ] \
-    && grep -qE "[0-9]+ passed" docs/tpu_test_log_r4.txt \
-    && ! grep -q "^INCOMPLETE" docs/tpu_test_log_r4.txt
+  local g
+  for g in "${TIER_GROUPS[@]}"; do
+    group_done "$g" || return 1
+  done
+  return 0
+}
+
+run_tier_groups() {
+  local g log rc
+  for g in "${TIER_GROUPS[@]}"; do
+    if group_done "$g"; then
+      echo "[watcher] tier $g already green — skipping"
+      continue
+    fi
+    log="$(group_log "$g")"
+    echo "[watcher] tier $g starting at $(date -u +%H:%M:%S)"
+    timeout -k 15 2400 python -m pytest tests_tpu/ -m "$g" -q 2>&1 | tee "${log}.part"
+    rc=${PIPESTATUS[0]}
+    if [ "$rc" -eq 0 ] && grep -qE "[0-9]+ passed" "${log}.part" \
+        && ! grep -qE "[0-9]+ skipped" "${log}.part"; then
+      mv "${log}.part" "$log"
+    else
+      { echo "INCOMPLETE rc=$rc at $(date -u +%FT%TZ)"
+        cat "${log}.part"; } > "$log"
+      rm -f "${log}.part"
+      echo "[watcher] tier $g rc=$rc — re-probing before the next group"
+      # A failed group usually means the tunnel dropped mid-compile: fall
+      # out to the main loop rather than burn the remaining groups' time.
+      return 1
+    fi
+  done
+  return 0
 }
 
 n=0
@@ -52,25 +136,29 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   n=$((n + 1))
   echo "[watcher] probe $n at $(date -u +%H:%M:%S)"
   if probe; then
-    if tier_done; then
-      echo "[watcher] compiled tier already passed — skipping"
+    if headline_done; then
+      echo "[watcher] headline already banked — skipping"
     else
-      echo "[watcher] tunnel healthy — running compiled tier"
-      timeout -k 15 3000 python -m pytest tests_tpu/ -q 2>&1 | tee docs/tpu_test_log_r4.txt.part
-      rc=${PIPESTATUS[0]}
-      if [ "$rc" -eq 0 ]; then
-        mv docs/tpu_test_log_r4.txt.part docs/tpu_test_log_r4.txt
-      else
-        { echo "INCOMPLETE rc=$rc at $(date -u +%FT%TZ)"
-          cat docs/tpu_test_log_r4.txt.part; } > docs/tpu_test_log_r4.txt
-        rm -f docs/tpu_test_log_r4.txt.part
+      echo "[watcher] tunnel healthy — headline bench first"
+      run_headline
+      if ! headline_done; then
+        # A failed headline right after a green probe is the signature of
+        # a mid-window flap: don't hand the queue hours of hard timeouts
+        # against a stalled backend — re-probe first (same fail-fast
+        # policy run_tier_groups applies between groups).
+        echo "[watcher] headline failed post-probe — re-probing before queue"
+        sleep 60
+        continue
       fi
-      echo "[watcher] compiled tier rc=$rc — running measurement queue"
     fi
-    if bash scripts/run_chip_queue.sh && tier_done; then
-      # Don't stop at the first healthy window: a mid-queue flap leaves
-      # INCOMPLETE artifacts, and run()'s skip-complete logic makes later
-      # passes cheap — keep watching until everything is actually done.
+    echo "[watcher] running measurement queue"
+    bash scripts/run_chip_queue.sh
+    queue_rc=$?
+    run_tier_groups
+    if headline_done && [ "$queue_rc" -eq 0 ] && tier_done; then
+      # Don't stop at the first healthy window otherwise: a mid-queue flap
+      # leaves INCOMPLETE artifacts, and the skip-complete logic makes
+      # later passes cheap — keep watching until everything is done.
       echo "[watcher] all artifacts complete at $(date -u +%H:%M:%S)"
       exit 0
     fi

@@ -1,9 +1,16 @@
 #!/usr/bin/env bash
-# Round-4 chip measurement queue (BASELINE.md "pending" debt).
-# Runs every chip-gated harness in priority order, tee-ing each artifact
-# into docs/. Serialized on purpose: one process owns the TPU. Each entry
-# gets a hard timeout so one wedged run can't starve the rest; artifacts
-# are written incrementally so a mid-queue tunnel drop keeps what finished.
+# Round-5 chip measurement queue (BASELINE.md "pending" debt).
+# Runs every chip-gated harness in VALUE order, tee-ing each artifact into
+# docs/. Serialized on purpose: one process owns the TPU. Each entry gets a
+# hard timeout so one wedged run can't starve the rest; artifacts are
+# written incrementally so a mid-queue tunnel drop keeps what finished.
+#
+# Value order (VERDICT r4 next #2/#3): `bounds` first — its pure-DMA shape
+# sweep is the one artifact that closes the per-step 12288² parity
+# argument; then the A/Bs that can move shipped defaults (kernel forms,
+# pending two rounds; strip overhead; tb stripes); then the bf16 chip
+# error curves; the full suite refresh runs LAST because it is the longest
+# entry and should measure whatever defaults the A/Bs justify.
 set -u
 cd "$(dirname "$0")/.."
 
@@ -11,7 +18,7 @@ QUEUE_ARTIFACTS=()
 
 run() { # name timeout_s cmd...
   local name="$1" t="$2"; shift 2
-  local out="docs/${name}_r4.txt"
+  local out="docs/${name}_r5.txt"
   QUEUE_ARTIFACTS+=("$out")
   if [ -s "$out" ] && ! grep -q "^INCOMPLETE" "$out"; then
     echo "== $name: artifact $out already complete, skipping =="
@@ -31,15 +38,15 @@ run() { # name timeout_s cmd...
   echo "-- $name rc=$rc"
 }
 
+run perstep_bounds  1800 python scripts/bench_bounds.py
 run kernel_forms    1800 python scripts/bench_kernel_forms.py
-run bench_suite     3600 python bench.py --suite --require-accelerator
 run strip_overhead  1800 python scripts/bench_strip_overhead.py --require-accelerator
 run tb_stripes      2400 python scripts/bench_tb_stripes.py
 run bf16_error_chip 1800 python scripts/bench_bf16_error.py --require-accelerator
 run bf16_error_vmem_chip 1800 python scripts/bench_bf16_error.py --schedule vmem --require-accelerator
-run bounds          1800 python scripts/bench_bounds.py
+run bench_suite     3600 python bench.py --suite --require-accelerator
 # Completeness is judged ONLY over the artifacts this queue owns — other
-# docs/*_r4.txt files (the watcher's tier log, committed CPU-side curves)
+# docs/*_r5.txt files (the watcher's tier logs, the headline bench record)
 # are not this script's to report on.
 incomplete=0
 for out in "${QUEUE_ARTIFACTS[@]}"; do

