@@ -147,7 +147,10 @@ def checkpointed_run(args, advance, init_state, log0, quantum: int = 1):
     state = init_state
     if args.resume:
         latest = ckpt.latest_step(args.checkpoint)
-        if latest:
+        # `is not None`, not truthiness: latest_step's contract is
+        # int | None, and a (hypothetical) step-0 checkpoint must restore,
+        # not silently fall through to the initial condition.
+        if latest is not None:
             log0(f"--resume: restoring step {latest} from {args.checkpoint}")
             state = ckpt.restore_state(args.checkpoint, latest, init_state)
             start = latest

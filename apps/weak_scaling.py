@@ -136,13 +136,20 @@ def main(argv=None) -> int:
         )
         if args.json and jax.process_index() == 0:
             wl = "" if args.workload == "diffusion" else f"{args.workload} "
-            print(json.dumps({
+            row = {
                 "metric": f"weak-scaling {wl}{args.variant} "
                           f"{args.local}²/dev",
                 "devices": n, "dims": dims, "gpts": round(r.gpts, 4),
                 "gpts_per_device": round(per_dev, 4),
                 "efficiency": round(eff, 4),
-            }))
+            }
+            if jax.devices()[0].platform == "cpu":
+                # Interpret-mode rates are meaningless; without this stamp
+                # a committed jsonl row's bare `efficiency` reads as a
+                # performance claim (VERDICT r4 weak #6). Real-hardware
+                # rows omit the key and ARE the claim.
+                row["mechanics_only"] = True
+            print(json.dumps(row))
     return 0
 
 

@@ -82,11 +82,22 @@ def spawn_ranks(
     outs: list = [None] * nprocs
 
     def drain(i: int, p) -> None:
+        # Any failure records SOMETHING into outs[i]: callers unpack
+        # (stdout, stderr) per rank, and a None would turn a rank failure
+        # into an opaque TypeError at the call site. The post-kill
+        # communicate gets its own timeout too — a grandchild that
+        # inherited the pipes keeps them open past the kill.
         try:
             outs[i] = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             p.kill()
-            outs[i] = p.communicate()
+            try:
+                outs[i] = p.communicate(timeout=30)
+            except Exception as exc:  # noqa: BLE001
+                outs[i] = ("", f"rank {i} drain failed post-kill: {exc!r}")
+        except Exception as exc:  # noqa: BLE001
+            p.kill()
+            outs[i] = ("", f"rank {i} drain failed: {exc!r}")
 
     threads = [
         threading.Thread(target=drain, args=(i, p), daemon=True)

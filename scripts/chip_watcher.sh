@@ -66,6 +66,8 @@ run_headline() {
   # BENCH_HEADLINE_BUDGET_S can never make the wrapper kill bench.py
   # before its own parent prints the contract line.
   local budget="${BENCH_HEADLINE_BUDGET_S:-300}"
+  budget="${budget%%.*}"  # bench.py accepts floats; bash arithmetic doesn't
+  [[ "$budget" =~ ^[0-9]+$ ]] || budget=300
   BENCH_BUDGET_S="$budget" \
     timeout -k 15 $((budget + 60)) python bench.py > docs/bench_headline_r5.txt.part 2> .bench_headline_stderr.log
   local rc=$?
@@ -132,6 +134,7 @@ run_tier_groups() {
 }
 
 n=0
+headline_fails=0
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   n=$((n + 1))
   echo "[watcher] probe $n at $(date -u +%H:%M:%S)"
@@ -141,14 +144,23 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     else
       echo "[watcher] tunnel healthy — headline bench first"
       run_headline
-      if ! headline_done; then
-        # A failed headline right after a green probe is the signature of
-        # a mid-window flap: don't hand the queue hours of hard timeouts
+      if headline_done; then
+        headline_fails=0
+      else
+        headline_fails=$((headline_fails + 1))
+        # A failed headline right after a green probe is usually a
+        # mid-window flap: don't hand the queue hours of hard timeouts
         # against a stalled backend — re-probe first (same fail-fast
-        # policy run_tier_groups applies between groups).
-        echo "[watcher] headline failed post-probe — re-probing before queue"
-        sleep 60
-        continue
+        # policy run_tier_groups applies between groups). But a
+        # DETERMINISTIC bench failure (healthy tunnel, reproducible
+        # crash) must not starve priorities 2 and 3 for the whole
+        # watch: after 2 consecutive failures, fall through anyway.
+        if [ "$headline_fails" -lt 2 ]; then
+          echo "[watcher] headline failed post-probe — re-probing before queue"
+          sleep 60
+          continue
+        fi
+        echo "[watcher] headline failed ${headline_fails}x — falling through to queue/tier"
       fi
     fi
     echo "[watcher] running measurement queue"
