@@ -245,6 +245,14 @@ def setup_jax(args):
         jax.config.update("jax_num_cpu_devices", args.cpu_devices)
     if args.dtype == "f64":
         jax.config.update("jax_enable_x64", True)
+    # Persistent compile cache: on the flapping chip tunnel an app re-run
+    # skips the Mosaic compiles a killed run already paid; on CPU it is a
+    # no-op unless the test harness opts in (RMT_CPU_CACHE=1 — see
+    # utils.backend), where it stops the suite's subprocess app tests
+    # re-paying identical XLA:CPU compiles every run.
+    from rocm_mpi_tpu.utils.backend import enable_persistent_cache
+
+    enable_persistent_cache()
     return jax
 
 

@@ -20,15 +20,23 @@ def enable_persistent_cache() -> None:
     On the tunneled chip a first Mosaic compile costs tens of seconds and
     the tunnel flaps, so every measurement entry point opts in: a re-run
     after a killed attempt then skips compiles the dead process already
-    paid for. Accelerator-only for the same reason as
+    paid for. Accelerator-only by default for the same reason as
     bench._setup_compilation_cache — XLA:CPU AOT entries embed the compile
-    machine's CPU feature set and can SIGILL on mismatch. Best-effort: an
+    machine's CPU feature set and can SIGILL on mismatch — EXCEPT under
+    RMT_CPU_CACHE=1, the test harness's machine-local opt-in
+    (tests/conftest.py): there the cache dir lives untracked on the one
+    machine that wrote it, mismatch cannot occur, and the per-commit
+    suite's subprocess children (apps, bench contract, dryrun) stop
+    re-paying identical XLA:CPU compiles on every run. Best-effort: an
     older jax without the knobs must not break a measurement run.
     """
     import jax
 
+    cpu_cache = os.environ.get("RMT_CPU_CACHE", "").strip().lower() not in (
+        "", "0", "false", "no",
+    )
     try:
-        if jax.default_backend() in ("cpu",):
+        if jax.default_backend() in ("cpu",) and not cpu_cache:
             return
     except Exception:  # noqa: BLE001 — backend probe itself may fail
         return

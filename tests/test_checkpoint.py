@@ -98,6 +98,7 @@ def test_app_checkpoint_then_resume(tmp_path):
     assert "restoring step 24" in out
     np.testing.assert_array_equal(np.load(resumed), np.load(straight))
 
+@pytest.mark.slow
 def test_deep_schedule_checkpoint_resume_app(tmp_path):
     """The deep schedule is checkpointable too (quantum = sweep depth k):
     a --deep run checkpointed at 24 then resumed to 48 must end on the

@@ -35,7 +35,17 @@ def run_py(code, env_extra=None, timeout=240):
     return proc.stdout
 
 
-@pytest.mark.parametrize("n", [2, 4, 8])
+# n=8 is the driver's actual invocation and stays in the per-commit lane;
+# the smaller meshes re-prove the same legs at different dims and move to
+# the soak lane (VERDICT r4 #4 — keep coverage, cut the default gate).
+@pytest.mark.parametrize(
+    "n",
+    [
+        pytest.param(2, marks=pytest.mark.slow),
+        pytest.param(4, marks=pytest.mark.slow),
+        8,
+    ],
+)
 def test_dryrun_multichip_self_provisioned(n):
     out = run_py(
         f"import __graft_entry__ as g; g.dryrun_multichip({n})"
