@@ -412,6 +412,14 @@ def kp_step_padded(Tp, Cp, lam, dt, spacing, interpret=None):
 # ---------------------------------------------------------------------------
 
 
+# Equal-spacing body form for _multi_step_kernel: "eqc" (A∘T + c∘s, the
+# r3-measured production form) or "conly" (A-free, one fewer VMEM operand
+# stream). A module constant, not config plumbing: the choice is a
+# measured hardware default, not a user decision — flip it here when the
+# chip A/B (scripts/bench_kernel_forms.py, VERDICT r4 next #2) justifies.
+EQC_BODY_FORM = "eqc"
+
+
 def _multi_step_kernel(T_ref, Cm_ref, out_ref, *, inv_d2, chunk):
     """`chunk` steps of T += Cm · ∇²T, fully VMEM-resident.
 
@@ -457,14 +465,35 @@ def _multi_step_kernel(T_ref, Cm_ref, out_ref, *, inv_d2, chunk):
             # step than the general A/c form. Same Dirichlet argument:
             # Cm==0 ⇒ c==0, A==1 ⇒ T'==T bitwise.
             c = Cm * inv_d2[0]
-            A = 1.0 - (2.0 * ndim) * c
+            # Two algebraically-identical final expressions over ONE
+            # shared neighbor sum; the branch resolves at trace time.
+            # "conly" (T' = T + c∘(s − 2·ndim·T)) reads one fewer VMEM
+            # operand stream per step than "eqc" (no A array; 2·ndim is a
+            # scalar) at the same VPU op count; the Dirichlet hold is
+            # exact either way (c==0 ⇒ T'==T bitwise). Whether the saved
+            # stream matters is the pending chip A/B's question
+            # (scripts/bench_kernel_forms.py); CPU equivalence of both
+            # forms is pinned in tests/test_pallas_kernels.py.
+            if EQC_BODY_FORM not in ("eqc", "conly"):
+                raise ValueError(
+                    f"EQC_BODY_FORM must be 'eqc' or 'conly', got "
+                    f"{EQC_BODY_FORM!r}"
+                )
+            conly = EQC_BODY_FORM == "conly"
+            coef = (
+                jnp.asarray(2.0 * ndim, c.dtype)
+                if conly
+                else 1.0 - (2.0 * ndim) * c
+            )
 
             def body(_, T):
                 s = None
                 for ax in range(ndim):
                     r = jnp.roll(T, -1, ax) + jnp.roll(T, 1, ax)
                     s = r if s is None else s + r
-                return A * T + c * s
+                if conly:
+                    return T + c * (s - coef * T)
+                return coef * T + c * s
 
         else:
             cs = [Cm * inv for inv in inv_d2]

@@ -65,6 +65,27 @@ def test_multi_step_chunk4_ac_forms_match_stepwise(spacing):
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-12)
 
 
+def test_multi_step_conly_form_matches_stepwise(monkeypatch):
+    """The A-free equal-spacing body (EQC_BODY_FORM='conly') is the same
+    update to rounding: pinned against the per-step jnp oracle BEFORE the
+    chip A/B so flipping the default (scripts/bench_kernel_forms.py,
+    VERDICT r4 next #2) is a measured one-line change, not a correctness
+    event. Also pins the Dirichlet hold the form's algebra promises:
+    Cm==0 on the rim ⇒ rim cells bitwise frozen."""
+    monkeypatch.setattr(pk, "EQC_BODY_FORM", "conly")
+    T = _rand((32, 32))
+    Cp = 1.0 + _rand((32, 32), seed=1)
+    args = (1.0, 1e-5, (0.1, 0.1))
+    got = fused_multi_step(T, Cp, *args, n_steps=16, chunk=8)
+    ref = T
+    for _ in range(16):
+        ref = step_fused(ref, Cp, *args)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-12)
+    rim = np.ones((32, 32), bool)
+    rim[1:-1, 1:-1] = False
+    np.testing.assert_array_equal(np.asarray(got)[rim], np.asarray(T)[rim])
+
+
 def _cm_oracle(Tp, Cm, spacing):
     """jnp oracle of the Cm contract: new core = Tp[core] + Cm·lap(Tp)."""
     ndim = Cm.ndim
