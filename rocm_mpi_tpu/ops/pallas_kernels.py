@@ -26,6 +26,7 @@ step functions (production), per SURVEY.md §7 "f64 on TPU".
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -419,6 +420,20 @@ def kp_step_padded(Tp, Cp, lam, dt, spacing, interpret=None):
 # chip A/B (scripts/bench_kernel_forms.py, VERDICT r4 next #2) justifies.
 EQC_BODY_FORM = "eqc"
 
+# Pad the VMEM-resident loop's field to power-of-two axes (252² → 256²):
+# every vreg tile is then full and the ±1 rolls are aligned shifts. The
+# pad ring carries Cm = 0, so pad cells never update and the interior is
+# bit-identical to the unpadded program (wraparound only ever reaches
+# frozen cells — the kernel's own Dirichlet argument). Same contract as
+# EQC_BODY_FORM: a measured hardware default, flipped here if the chip
+# A/B's pad_eqc/pad_conly rows justify; the CPU bitwise-equivalence test
+# (tests/test_pallas_kernels.py) holds either way.
+VMEM_PAD_POW2 = False
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << (int(n) - 1).bit_length()
+
 
 def _multi_step_kernel(T_ref, Cm_ref, out_ref, *, inv_d2, chunk):
     """`chunk` steps of T += Cm · ∇²T, fully VMEM-resident.
@@ -601,13 +616,22 @@ def fused_multi_step(T, Cp, lam, dt, spacing, n_steps, chunk=None, interpret=Non
             f"VMEM-resident budget ({_VMEM_BLOCK_BUDGET_BYTES}); use the "
             "per-step path"
         )
-    chunk = resolve_step_chunk(n_steps, chunk, nbytes, warn_on_cap)
     lam, dt = float(lam), float(dt)
     inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
     # Masked update coefficient, computed ONCE per advance call (not per
     # step) — for the single-shard use the block edge IS the global
     # boundary (the reference's interior-only guard, perf.jl:7).
     Cm = _edge_masked_cm(T, Cp, lam, dt)
+    orig_shape = T.shape
+    if VMEM_PAD_POW2:
+        padded = tuple(_next_pow2(d) for d in T.shape)
+        pad_bytes = math.prod(padded) * _compute_itemsize(T.dtype)
+        if padded != T.shape and pad_bytes <= _VMEM_BLOCK_BUDGET_BYTES:
+            widths = tuple((0, p - d) for p, d in zip(padded, T.shape))
+            T = jnp.pad(T, widths)  # pad values are frozen (Cm pads to 0)
+            Cm = jnp.pad(Cm, widths)
+            nbytes = pad_bytes  # the unroll cap must see the padded size
+    chunk = resolve_step_chunk(n_steps, chunk, nbytes, warn_on_cap)
     kernel = functools.partial(_multi_step_kernel, inv_d2=inv_d2, chunk=chunk)
     run_chunk = pl.pallas_call(
         kernel,
@@ -623,7 +647,10 @@ def fused_multi_step(T, Cp, lam, dt, spacing, n_steps, chunk=None, interpret=Non
     # trip count floors, so a non-multiple silently rounds DOWN to the
     # nearest chunk — callers with dynamic n must guarantee divisibility
     # (run_vmem_resident does, via gcd).
-    return lax.fori_loop(0, n_steps // chunk, lambda _, x: run_chunk(x, Cm), T)
+    out = lax.fori_loop(0, n_steps // chunk, lambda _, x: run_chunk(x, Cm), T)
+    if out.shape != orig_shape:
+        out = out[tuple(slice(0, d) for d in orig_shape)]
+    return out
 
 
 def multi_step_cm(T, Cm, spacing, n_steps: int, interpret=None):

@@ -65,6 +65,21 @@ def test_multi_step_chunk4_ac_forms_match_stepwise(spacing):
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-12)
 
 
+def test_multi_step_pow2_pad_bitwise_equals_unpadded(monkeypatch):
+    """The padded-layout opt-in (VMEM_PAD_POW2, the chip A/B's pad_* rows)
+    must be BITWISE the unpadded program on the interior: the pad ring
+    carries Cm==0, so pad cells never update and wraparound only reaches
+    frozen cells. Non-pow2 shape (20, 24) pads to (32, 32)."""
+    T = _rand((20, 24), dtype=jnp.float32)
+    Cp = (1.0 + _rand((20, 24), seed=1)).astype(jnp.float32)
+    args = (1.0, 1e-5, (0.1, 0.1))
+    ref = fused_multi_step(T, Cp, *args, n_steps=16, chunk=8)
+    monkeypatch.setattr(pk, "VMEM_PAD_POW2", True)
+    got = fused_multi_step(T, Cp, *args, n_steps=16, chunk=8)
+    assert got.shape == ref.shape
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
 def test_multi_step_conly_form_matches_stepwise(monkeypatch):
     """The A-free equal-spacing body (EQC_BODY_FORM='conly') is the same
     update to rounding: pinned against the per-step jnp oracle BEFORE the

@@ -95,6 +95,22 @@ def test_vmem_multi_step_compiled(form, monkeypatch):
     np.testing.assert_array_equal(np.asarray(got)[rim], np.asarray(T)[rim])
 
 
+def test_vmem_multi_step_pow2_pad_compiled(monkeypatch):
+    # The padded-layout opt-in (VMEM_PAD_POW2, the chip A/B's pad_* rows):
+    # a non-pow2 field pads to aligned axes, runs the same unrolled loop,
+    # and slices back — must agree with the jnp oracle compiled.
+    monkeypatch.setattr(pk, "VMEM_PAD_POW2", True)
+    T = _rand((20, 24))
+    Cp = 1.0 + _rand((20, 24), seed=1)
+    args = (1.0, 1e-5, (0.1, 0.1))
+    ref = T
+    for _ in range(16):
+        ref = step_fused(ref, Cp, *args)
+    got = pk.fused_multi_step(T, Cp, *args, n_steps=16, chunk=8)
+    assert got.shape == T.shape
+    _close(got, ref)
+
+
 def test_vmem_multi_step_unequal_spacing_compiled():
     # chunk >= 4 with unequal spacing: the general per-axis A/c branch
     # (equal spacing above takes the single-c specialization instead).
