@@ -555,8 +555,6 @@ def resolve_step_chunk(n_steps, chunk, nbytes, warn_on_cap=True):
     chunk=256 in tens of seconds; 512² at chunk=64 exceeded 9 minutes,
     measured) — warning when that degrades an explicitly requested chunk.
     """
-    import math
-
     n_static = isinstance(n_steps, int)
     explicit = chunk is not None
     if chunk is None:
