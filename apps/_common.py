@@ -102,9 +102,9 @@ def make_parser(
 
 
 def add_checkpoint_flags(p) -> None:
-    """The shared --checkpoint/--ckpt-every/--resume block (SURVEY.md
-    §5.4 upgraded: orbax periodic checkpoints + resume-from-latest —
-    utils/checkpoint.py has the design)."""
+    """The shared --checkpoint/--ckpt-every/--resume/--retries/
+    --inject-fault block (SURVEY.md §5.4 upgraded + the resilience layer
+    — utils/checkpoint.py and rocm_mpi_tpu/resilience/ have the design)."""
     p.add_argument(
         "--checkpoint", default=None, metavar="DIR",
         help="periodically checkpoint the run state into DIR (orbax, "
@@ -116,8 +116,21 @@ def add_checkpoint_flags(p) -> None:
     )
     p.add_argument(
         "--resume", action="store_true",
-        help="with --checkpoint: continue from the latest saved step in "
-        "DIR instead of the initial condition",
+        help="with --checkpoint: continue from the latest VALID saved "
+        "step in DIR (corrupt/truncated checkpoints are skipped) instead "
+        "of the initial condition",
+    )
+    p.add_argument(
+        "--retries", type=nonneg_int, default=0, metavar="N",
+        help="with --checkpoint: supervise the run — on a crash/backend "
+        "error, restore the latest valid checkpoint and retry with "
+        "exponential backoff, up to N restarts (resilience.run_supervised)",
+    )
+    p.add_argument(
+        "--inject-fault", default=None, metavar="SPEC",
+        help="deterministic fault injection for drills/tests, e.g. "
+        "'crash@step=12' or 'truncate-latest@segment=2' "
+        "(rocm_mpi_tpu/resilience/faults.py has the grammar)",
     )
 
 
@@ -143,17 +156,24 @@ def checkpointed_run(args, advance, init_state, log0, quantum: int = 1):
         log0(f"--ckpt-every {every} rounded to {rounded} (the schedule "
              f"advances {quantum} steps at a time)")
         every = rounded
+    supervised = getattr(args, "retries", 0) > 0
     start = 0
     state = init_state
     if args.resume:
-        latest = ckpt.latest_step(args.checkpoint)
-        # `is not None`, not truthiness: latest_step's contract is
-        # int | None, and a (hypothetical) step-0 checkpoint must restore,
-        # not silently fall through to the initial condition.
+        # The latest VALID step (integrity manifest checked): a corrupt
+        # or truncated checkpoint falls back to the previous kept step
+        # instead of being restored — or worse, trusted.
+        latest = ckpt.latest_valid_step(args.checkpoint, log=log0)
+        # `is not None`, not truthiness: the contract is int | None, and
+        # a (hypothetical) step-0 checkpoint must restore, not silently
+        # fall through to the initial condition.
         if latest is not None:
-            log0(f"--resume: restoring step {latest} from {args.checkpoint}")
-            state = ckpt.restore_state(args.checkpoint, latest, init_state)
             start = latest
+            if not supervised:
+                log0(f"--resume: restoring step {latest} from "
+                     f"{args.checkpoint}")
+                state = ckpt.restore_state(args.checkpoint, latest,
+                                           init_state)
         else:
             log0(f"--resume: no checkpoint under {args.checkpoint}; "
                  "starting from the initial condition")
@@ -169,18 +189,34 @@ def checkpointed_run(args, advance, init_state, log0, quantum: int = 1):
             "resume with the schedule that wrote it or adjust --nt"
         )
         raise SystemExit(2)
-    if start >= args.nt:
+    if start >= args.nt and not supervised:
         log0(f"--resume: checkpoint already at step {start} >= nt={args.nt};"
              " nothing to run")
         return state, 0, 0.0
     t0 = time.perf_counter()
-    state = ckpt.run_segmented(
-        advance, state, args.nt, args.checkpoint, every, start_step=start
-    )
+    if supervised:
+        # Crash supervision (resilience.run_supervised): restore, the
+        # nothing-to-run case, and retry restarts are all owned by the
+        # supervisor — the app only pre-resolved `start` for the quantum
+        # guard above and the steps-run accounting below.
+        from rocm_mpi_tpu.resilience import run_supervised
+
+        log0(f"supervised run: up to {args.retries} restart(s), "
+             f"resume={'on' if args.resume else 'off'}")
+        state = run_supervised(
+            advance, init_state, args.nt, args.checkpoint, every,
+            max_retries=args.retries, resume=args.resume, log=log0,
+        )
+    else:
+        state = ckpt.run_segmented(
+            advance, state, args.nt, args.checkpoint, every, start_step=start
+        )
     wtime = time.perf_counter() - t0
-    log0(f"checkpointed {start}→{args.nt} every {every} steps into "
-         f"{args.checkpoint}")
-    return state, args.nt - start, wtime
+    ran = max(args.nt - start, 0)
+    if ran:
+        log0(f"checkpointed {start}→{args.nt} every {every} steps into "
+             f"{args.checkpoint}")
+    return state, ran, wtime
 
 
 def checkpoint_schedule(args, model, per_step_label, make_per_step):
@@ -239,10 +275,18 @@ def setup_jax(args):
 
     from rocm_mpi_tpu.parallel.distributed import maybe_initialize_distributed
 
+    if getattr(args, "inject_fault", None):
+        # Before distributed init: the "init" fault point (delay-rank
+        # drills) fires inside maybe_initialize_distributed.
+        from rocm_mpi_tpu.resilience import faults
+
+        faults.install(args.inject_fault)
     maybe_initialize_distributed()
     if args.cpu_devices:
+        from rocm_mpi_tpu.utils.backend import set_cpu_device_count
+
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+        set_cpu_device_count(args.cpu_devices)
     if args.dtype == "f64":
         jax.config.update("jax_enable_x64", True)
     # Persistent compile cache: on the flapping chip tunnel an app re-run

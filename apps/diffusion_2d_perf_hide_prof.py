@@ -112,7 +112,9 @@ def main() -> int:
             "",
             "XLA cost analysis of the timed program (per invocation):",
         ]
-        cost = compiled.cost_analysis() or {}
+        from rocm_mpi_tpu.utils.compat import cost_analysis_dict
+
+        cost = cost_analysis_dict(compiled)
         for key in sorted(cost):
             val = cost[key]
             if isinstance(val, (int, float)) and val:

@@ -40,8 +40,10 @@ def main(argv=None) -> int:
     import jax
 
     if args.cpu_devices:
+        from rocm_mpi_tpu.utils.backend import set_cpu_device_count
+
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+        set_cpu_device_count(args.cpu_devices)
 
     import numpy as np
 

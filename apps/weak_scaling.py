@@ -100,6 +100,15 @@ def main(argv=None) -> int:
         if n > n_avail:
             log0(f"n={n}: skipped (only {n_avail} devices)")
             continue
+        if not any(
+            d.process_index == jax.process_index()
+            for d in jax.devices()[:n]
+        ):
+            # A rung whose submesh holds none of this process's devices:
+            # this process cannot allocate on it (jax 0.4.x refuses a
+            # device assignment with no local devices) and the compute is
+            # entirely local to the owning process(es) — sit the rung out.
+            continue
         dims = suggest_dims(n, 2)
         shape = (args.local * dims[0], args.local * dims[1])
         common = dict(

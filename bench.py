@@ -248,12 +248,14 @@ def child_main(budget_s: float) -> int:
     # Stage 2.5 — the kernel-form ladder, run where the driver runs
     # (VERDICT r4 next #2's A/B, landed in the one harness guaranteed a
     # chip run): each candidate re-traces the same VMEM-resident program
-    # with a different trace-time body form / layout (module constants in
-    # ops.pallas_kernels; scripts/bench_kernel_forms.py is the standalone
-    # edition). Per-form rates go to stderr — the driver's recorded tail
-    # IS the measurement record — and the long window below then rides
-    # the within-run winner. Emit-as-you-go still guarantees the floor:
-    # a compile hang here can only cost the upgrade.
+    # with a different trace-time body form / layout, passed as EXPLICIT
+    # kwargs per rung (body_form/pad_pow2 — ADVICE r5 #1: a mutated
+    # module global would be silently ignored by any cached/reused
+    # compiled advance; a kwarg changes the trace). Per-form rates go to
+    # stderr — the driver's recorded tail IS the measurement record —
+    # and the long window below then rides the within-run winner.
+    # Emit-as-you-go still guarantees the floor: a compile hang here can
+    # only cost the upgrade.
     import rocm_mpi_tpu.ops.pallas_kernels as pk
 
     best_cfg, best_form_gpts = ("eqc", False), r2.gpts
@@ -263,19 +265,27 @@ def child_main(budget_s: float) -> int:
             print("bench.py: budget exhausted mid-ladder; "
                   f"best so far {best_cfg}", file=sys.stderr)
             break
-        pk.EQC_BODY_FORM, pk.VMEM_PAD_POW2 = form, pad
         label = f"252² chunk-256 {form}{'+pad256' if pad else ''}"
         t0 = time.monotonic()
-        rv = model(warmup + 262_144, warmup).run_vmem_resident()
+        rv = model(warmup + 262_144, warmup).run_vmem_resident(
+            body_form=form, pad_pow2=pad
+        )
+        # The trace can refuse a requested pad (VMEM budget): then neither
+        # this row nor — should the rung win — the long-window record may
+        # carry a pad label for an unpadded program (ADVICE r5 #4). The
+        # winner keeps the EFFECTIVE config, so the long window re-runs
+        # and labels what was actually measured.
+        eff_pad = pad and pk.last_pad_applied() is not False
+        if pad and not eff_pad:
+            label += " (pad skipped)"
         print(
             f"{label} compile+run {time.monotonic() - t0:.1f} s",
             file=sys.stderr,
         )
         emit_if_better(rv, label)
         if rv.gpts > best_form_gpts:
-            best_cfg, best_form_gpts = (form, pad), rv.gpts
+            best_cfg, best_form_gpts = (form, eff_pad), rv.gpts
             per_step = rv.wtime_it
-    pk.EQC_BODY_FORM, pk.VMEM_PAD_POW2 = best_cfg
     print(f"kernel-form ladder winner: {best_cfg[0]}"
           f"{'+pad256' if best_cfg[1] else ''} "
           f"({best_form_gpts:.2f} Gpts/s calibration)", file=sys.stderr)
@@ -302,7 +312,9 @@ def child_main(budget_s: float) -> int:
         f"{remaining:.0f} s budget left)",
         file=sys.stderr,
     )
-    r3 = model(warmup + timed, warmup).run_vmem_resident()
+    r3 = model(warmup + timed, warmup).run_vmem_resident(
+        body_form=best_cfg[0], pad_pow2=best_cfg[1]
+    )
     win = f"{best_cfg[0]}{'+pad256' if best_cfg[1] else ''}"
     emit_if_better(r3, f"252² chunk-256 {win} x{timed}")
     return RC_OK
@@ -323,26 +335,26 @@ def prime_cache() -> int:
         )
         return 0
 
-    import rocm_mpi_tpu.ops.pallas_kernels as pk
-
     model = _bench_model
     for label, nt, wu, chunk, form, pad in (
         ("floor chunk-16", 32, 16, 16, "eqc", False),
         ("flagship chunk-256", 512, 256, None, "eqc", False),
         # The stage-2.5 kernel-form ladder's candidates: prime them all so
-        # the driver-run ladder pays zero compiles.
+        # the driver-run ladder pays zero compiles. Explicit trace-time
+        # kwargs — the same ones the ladder passes — so the primed
+        # programs are bit-identical to the measured ones.
         ("flagship conly", 512, 256, None, "conly", False),
         ("flagship eqc+pad256", 512, 256, None, "eqc", True),
         ("flagship conly+pad256", 512, 256, None, "conly", True),
     ):
-        pk.EQC_BODY_FORM, pk.VMEM_PAD_POW2 = form, pad
         t0 = time.monotonic()
-        model(nt, wu).run_vmem_resident(chunk=chunk)
+        model(nt, wu).run_vmem_resident(
+            chunk=chunk, body_form=form, pad_pow2=pad
+        )
         print(
             f"primed {label} in {time.monotonic() - t0:.1f} s",
             file=sys.stderr,
         )
-    pk.EQC_BODY_FORM, pk.VMEM_PAD_POW2 = "eqc", False
     return 0
 
 

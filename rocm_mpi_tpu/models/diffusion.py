@@ -24,7 +24,9 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from rocm_mpi_tpu.utils.compat import shard_map
 
 from rocm_mpi_tpu.config import DiffusionConfig
 from rocm_mpi_tpu.ops.diffusion import (
@@ -446,7 +448,7 @@ class HeatDiffusion:
 
     def _run_single_shard(
         self, nt, warmup, multi_step_fn, granularity: int, granularity_kw: str,
-        explicit: bool = False,
+        explicit: bool = False, extra_kw=None,
     ) -> RunResult:
         """Shared scaffold of the single-shard fast paths: validate, pick a
         step granularity dividing both the warmup and timed windows (so one
@@ -477,6 +479,8 @@ class HeatDiffusion:
         kw = {key: gran}
         if key == "chunk":
             kw["warn_on_cap"] = explicit
+        if extra_kw:
+            kw.update(extra_kw)
 
         @functools.partial(jax.jit, donate_argnums=0)
         def advance(T, Cp, n):
@@ -494,6 +498,8 @@ class HeatDiffusion:
         nt: int | None = None,
         warmup: int | None = None,
         chunk: int | None = None,
+        body_form: str | None = None,
+        pad_pow2: bool | None = None,
     ) -> RunResult:
         """Single-shard fast path: the whole nt-step loop inside one Pallas
         kernel, field VMEM-resident (ops.pallas_kernels.fused_multi_step).
@@ -505,6 +511,10 @@ class HeatDiffusion:
         DEFAULT_STEP_CHUNK): Mosaic compile time scales with the unroll, so
         a small chunk (e.g. 16) compiles in seconds where 256 takes tens —
         bench.py's floor measurement depends on this knob.
+
+        `body_form`/`pad_pow2` select the kernel-form A/B candidates as
+        trace-time kwargs (bench.py's stage-2.5 ladder); None keeps the
+        module-constant hardware defaults.
         """
         from rocm_mpi_tpu.ops.pallas_kernels import (
             DEFAULT_STEP_CHUNK,
@@ -518,6 +528,7 @@ class HeatDiffusion:
             DEFAULT_STEP_CHUNK if chunk is None else chunk,
             "chunk",
             explicit=chunk is not None,
+            extra_kw={"body_form": body_form, "pad_pow2": pad_pow2},
         )
 
     def run_hbm_blocked(

@@ -38,7 +38,9 @@ import math
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from rocm_mpi_tpu.utils.compat import shard_map
 
 from rocm_mpi_tpu.config import DTYPES
 from rocm_mpi_tpu.ops.diffusion import gaussian_ic

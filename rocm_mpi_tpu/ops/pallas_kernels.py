@@ -79,11 +79,12 @@ def _out_struct(shape, exemplar):
     """ShapeDtypeStruct matching `exemplar`'s dtype and mesh-varying axes.
 
     Inside shard_map (jax>=0.9 check_vma), pallas_call outputs must declare
-    which mesh axes they vary over; propagate the input's vma set.
+    which mesh axes they vary over; propagate the input's vma set
+    (version-portably — utils.compat owns the jax-API drift).
     """
-    return jax.ShapeDtypeStruct(
-        shape, exemplar.dtype, vma=jax.typeof(exemplar).vma
-    )
+    from rocm_mpi_tpu.utils.compat import out_struct_like
+
+    return out_struct_like(shape, exemplar)
 
 
 def _interpret_default() -> bool:
@@ -430,12 +431,27 @@ EQC_BODY_FORM = "eqc"
 # (tests/test_pallas_kernels.py) holds either way.
 VMEM_PAD_POW2 = False
 
+# What the LAST fused_multi_step trace actually did about padding:
+# True = pad applied, False = pad requested but skipped (VMEM budget),
+# None = no pad requested / field already pow2. Trace-time bookkeeping
+# for measurement labeling (bench.py appends '(pad skipped)' to a rung's
+# label off this), queryable via last_pad_applied().
+_LAST_PAD_APPLIED: bool | None = None
+
+
+def last_pad_applied() -> bool | None:
+    """Did the most recent fused_multi_step trace apply the pow2 pad?
+    (True/False/None-not-requested; see _LAST_PAD_APPLIED.) Valid right
+    after the call that traced the program — bench reads it per rung."""
+    return _LAST_PAD_APPLIED
+
 
 def _next_pow2(n: int) -> int:
     return 1 << (int(n) - 1).bit_length()
 
 
-def _multi_step_kernel(T_ref, Cm_ref, out_ref, *, inv_d2, chunk):
+def _multi_step_kernel(T_ref, Cm_ref, out_ref, *, inv_d2, chunk,
+                       body_form=None):
     """`chunk` steps of T += Cm · ∇²T, fully VMEM-resident.
 
     Tuned for the latency-bound small-field regime (the 252²/chip benchmark
@@ -489,12 +505,14 @@ def _multi_step_kernel(T_ref, Cm_ref, out_ref, *, inv_d2, chunk):
             # stream matters is the pending chip A/B's question
             # (scripts/bench_kernel_forms.py); CPU equivalence of both
             # forms is pinned in tests/test_pallas_kernels.py.
-            if EQC_BODY_FORM not in ("eqc", "conly"):
+            if body_form is None:
+                body_form = EQC_BODY_FORM
+            if body_form not in ("eqc", "conly"):
                 raise ValueError(
-                    f"EQC_BODY_FORM must be 'eqc' or 'conly', got "
-                    f"{EQC_BODY_FORM!r}"
+                    f"body_form must be 'eqc' or 'conly', got "
+                    f"{body_form!r}"
                 )
-            conly = EQC_BODY_FORM == "conly"
+            conly = body_form == "conly"
             coef = (
                 jnp.asarray(2.0 * ndim, c.dtype)
                 if conly
@@ -581,8 +599,16 @@ def resolve_step_chunk(n_steps, chunk, nbytes, warn_on_cap=True):
 
 
 def fused_multi_step(T, Cp, lam, dt, spacing, n_steps, chunk=None, interpret=None,
-                     warn_on_cap=True):
+                     warn_on_cap=True, body_form=None, pad_pow2=None):
     """Advance a *single-shard* field `n_steps` barely leaving VMEM.
+
+    `body_form` ('eqc'/'conly') and `pad_pow2` are explicit TRACE-TIME
+    switches for the kernel-form A/B (bench.py's stage-2.5 ladder passes
+    them per rung); None defaults to the module constants EQC_BODY_FORM /
+    VMEM_PAD_POW2 — the measured hardware defaults. Explicit kwargs, not
+    global mutation: a cached/reused jitted advance would silently ignore
+    a mutated module global, but a changed kwarg changes the trace
+    (ADVICE r5 #1).
 
     TPU-only optimization (no reference analog — the GPU version must round-
     trip HBM every step): the kernel runs `chunk` steps per invocation with
@@ -621,16 +647,38 @@ def fused_multi_step(T, Cp, lam, dt, spacing, n_steps, chunk=None, interpret=Non
     # boundary (the reference's interior-only guard, perf.jl:7).
     Cm = _edge_masked_cm(T, Cp, lam, dt)
     orig_shape = T.shape
-    if VMEM_PAD_POW2:
+    if pad_pow2 is None:
+        pad_pow2 = VMEM_PAD_POW2
+    global _LAST_PAD_APPLIED
+    _LAST_PAD_APPLIED = None  # no pad requested (or nothing to pad)
+    if pad_pow2:
         padded = tuple(_next_pow2(d) for d in T.shape)
         pad_bytes = math.prod(padded) * _compute_itemsize(T.dtype)
-        if padded != T.shape and pad_bytes <= _VMEM_BLOCK_BUDGET_BYTES:
+        if padded == T.shape:
+            _LAST_PAD_APPLIED = None  # already pow2: nothing requested to do
+        elif pad_bytes <= _VMEM_BLOCK_BUDGET_BYTES:
             widths = tuple((0, p - d) for p, d in zip(padded, T.shape))
             T = jnp.pad(T, widths)  # pad values are frozen (Cm pads to 0)
             Cm = jnp.pad(Cm, widths)
             nbytes = pad_bytes  # the unroll cap must see the padded size
+            _LAST_PAD_APPLIED = True
+        else:
+            # Requested but skipped: without a loud record, a bench row at
+            # a larger geometry would carry a 'pad256' label for a program
+            # that actually ran unpadded (ADVICE r5 #4).
+            _LAST_PAD_APPLIED = False
+            import warnings
+
+            warnings.warn(
+                f"pad_pow2 requested but SKIPPED: padded field "
+                f"{padded} would be {pad_bytes} bytes, over the VMEM "
+                f"budget ({_VMEM_BLOCK_BUDGET_BYTES}); the program runs "
+                "unpadded — do not label this measurement 'pad'",
+                stacklevel=2,
+            )
     chunk = resolve_step_chunk(n_steps, chunk, nbytes, warn_on_cap)
-    kernel = functools.partial(_multi_step_kernel, inv_d2=inv_d2, chunk=chunk)
+    kernel = functools.partial(_multi_step_kernel, inv_d2=inv_d2, chunk=chunk,
+                               body_form=body_form)
     run_chunk = pl.pallas_call(
         kernel,
         out_shape=_out_struct(T.shape, T),

@@ -31,7 +31,9 @@ the bare field.
 from __future__ import annotations
 
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from rocm_mpi_tpu.utils.compat import shard_map
 
 from rocm_mpi_tpu.parallel.halo import exchange_halo
 from rocm_mpi_tpu.parallel.mesh import GlobalGrid

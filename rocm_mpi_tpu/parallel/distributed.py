@@ -20,6 +20,42 @@ import os
 _initialized = False
 
 
+def process_id() -> int:
+    """This process's rank under the launcher contract, WITHOUT forcing
+    backend/cluster init: RMT_PROCESS_ID when the launcher set it, else
+    jax.process_index() if a backend is already up, else 0. The
+    resilience layer's rank-scoped fault clauses key off this."""
+    raw = os.environ.get("RMT_PROCESS_ID")
+    if raw is not None:
+        try:
+            return int(raw)
+        except ValueError:
+            pass
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            return jax.process_index()
+        except Exception:  # noqa: BLE001 — backend may not be up yet
+            pass
+    return 0
+
+
+def _enable_cpu_collectives() -> None:
+    """Multi-process CPU runs need gloo collectives selected explicitly
+    on jax 0.4.x (`jax_cpu_collectives_implementation` defaults to
+    'none' there — cross-process programs then fail with 'Multiprocess
+    computations aren't implemented on the CPU backend'); newer jax
+    defaults to gloo and drops the knob, hence best-effort."""
+    import jax
+
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):
+        pass
+
+
 def maybe_initialize_distributed() -> bool:
     """Call jax.distributed.initialize() when a multi-host launch is
     requested (RMT_DISTRIBUTED=1, or explicit JAX coordinator env).
@@ -73,6 +109,12 @@ def maybe_initialize_distributed() -> bool:
         )
         if "RMT_INIT_TIMEOUT_S" in env:
             kwargs["initialization_timeout"] = int_env("RMT_INIT_TIMEOUT_S")
+    # Resilience drill site: a delay-rank fault here simulates the slow/
+    # stalled joiner the launcher's heartbeat reporting must surface.
+    from rocm_mpi_tpu.resilience import faults
+
+    faults.fault_point("init")
+    _enable_cpu_collectives()
     jax.distributed.initialize(**kwargs)
     _initialized = True
     return True

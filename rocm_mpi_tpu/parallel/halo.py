@@ -44,7 +44,9 @@ def _edge(u, axis: int, side: str, width: int):
 def neighbor_shift(x, axis_name: str, direction: int):
     """Send `x` to the neighbor `direction` steps up the mesh axis
     (non-periodic: edge devices receive zeros)."""
-    n = lax.axis_size(axis_name)
+    from rocm_mpi_tpu.utils.compat import axis_size
+
+    n = axis_size(axis_name)
     if direction == +1:
         perm = [(i, i + 1) for i in range(n - 1)]
     elif direction == -1:

@@ -7,25 +7,36 @@ RMT_* env block consumed by parallel.distributed.maybe_initialize_distributed
 launcher on one machine: it spawns N real Python processes wired by the
 contract, each with its own virtual CPU devices, so sharded programs cross
 genuine process boundaries (gloo) without a cluster. One implementation
-serves the 2-process test harness (tests/test_distributed.py) and the
-N-rank mechanics script (scripts/run_multiproc_mechanics.py).
+serves the 2-process test harness (tests/test_distributed.py), the N-rank
+mechanics script (scripts/run_multiproc_mechanics.py), and the resilience
+tier's rank-failure drills (tests/test_resilience.py).
 
 Robustness contract:
   * every rank's pipes are drained CONCURRENTLY (a rank blocked writing
     >64 KB to an unread pipe mid-collective would deadlock the others);
+  * a supervision thread heartbeats rank liveness: the FIRST nonzero
+    rank exit is recorded (rank, rc, time) and, after `peer_grace_s`,
+    still-running peers — almost certainly hung in a collective waiting
+    on the dead rank — are killed and named in the report, instead of
+    every survivor burning the full `timeout` on a bare kill;
   * a rank that outlives `timeout` is killed and its flushed output kept;
   * every still-running rank is killed on any exit path (no leaked gloo
-    ranks holding the coordinator port).
+    ranks holding the coordinator port);
+  * `inject_fault` forwards a resilience.faults spec to every rank via
+    RMT_INJECT_FAULT, so rank-failure paths are drilled in the real
+    multi-process harness (docs/RESILIENCE.md §3).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import pathlib
 import socket
 import subprocess
 import sys
 import threading
+import time
 
 _ROOT = pathlib.Path(__file__).resolve().parents[2]
 
@@ -36,16 +47,43 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@dataclasses.dataclass
+class LaunchReport:
+    """What the supervision thread observed: who failed first, when, and
+    which hung peers it had to put down."""
+
+    first_failure: tuple[int, int, float] | None = None  # (rank, rc, t_s)
+    killed_after_failure: list[int] = dataclasses.field(default_factory=list)
+    events: list[str] = dataclasses.field(default_factory=list)
+
+    def note(self, msg: str) -> None:
+        self.events.append(msg)
+        if os.environ.get("RMT_LAUNCH_VERBOSE"):
+            print(f"[launcher] {msg}", file=sys.stderr, flush=True)
+
+
+class RankResults(list):
+    """`[(proc, (stdout, stderr)), ...]` in rank order, with the
+    supervision report attached — existing callers keep unpacking the
+    list; resilience callers read `.report`."""
+
+    report: LaunchReport
+
+
 def spawn_ranks(
     argv,
     nprocs: int = 2,
     timeout: float = 240,
     init_timeout_s: int = 60,
+    inject_fault: str | None = None,
+    heartbeat_s: float = 10.0,
+    peer_grace_s: float = 20.0,
 ):
     """Spawn `nprocs` ranks of `[sys.executable] + argv` under the RMT_*
-    launcher contract; return [(proc, (stdout, stderr)), ...] in rank
-    order. Callers judge returncodes (a killed-at-timeout rank reports
-    its signal code with whatever it flushed)."""
+    launcher contract; return RankResults of (proc, (stdout, stderr)) in
+    rank order, with `.report` carrying first-failure/heartbeat data.
+    Callers judge returncodes (a killed-at-timeout or killed-after-peer-
+    failure rank reports its signal code with whatever it flushed)."""
     port = _free_port()
     base = os.environ.copy()
     # Ranks size their own device count (--cpu-devices); an inherited
@@ -69,6 +107,8 @@ def spawn_ranks(
                 + ([base["PYTHONPATH"]] if "PYTHONPATH" in base else [])
             ),
         )
+        if inject_fault:
+            env["RMT_INJECT_FAULT"] = inject_fault
         procs.append(
             subprocess.Popen(
                 [sys.executable] + [str(a) for a in argv],
@@ -80,6 +120,8 @@ def spawn_ranks(
             )
         )
     outs: list = [None] * nprocs
+    report = LaunchReport()
+    done = threading.Event()
 
     def drain(i: int, p) -> None:
         # Any failure records SOMETHING into outs[i]: callers unpack
@@ -99,17 +141,64 @@ def spawn_ranks(
             p.kill()
             outs[i] = ("", f"rank {i} drain failed: {exc!r}")
 
+    def supervise() -> None:
+        """Heartbeat rank liveness; on the first nonzero exit, give hung
+        peers `peer_grace_s` to finish on their own, then kill them —
+        a gloo collective never completes once a participant is dead."""
+        t0 = time.monotonic()
+        next_beat = t0 + heartbeat_s
+        failure_t = None
+        while not done.is_set():
+            now = time.monotonic()
+            alive = [i for i, p in enumerate(procs) if p.poll() is None]
+            if not alive:
+                return
+            if report.first_failure is None:
+                for i, p in enumerate(procs):
+                    rc = p.poll()
+                    if rc is not None and rc != 0:
+                        failure_t = now
+                        report.first_failure = (i, rc, now - t0)
+                        report.note(
+                            f"first failure: rank {i} rc={rc} at "
+                            f"{now - t0:.1f}s; peers get {peer_grace_s}s "
+                            "grace"
+                        )
+                        break
+            elif failure_t is not None and now - failure_t >= peer_grace_s:
+                for i in alive:
+                    if procs[i].poll() is None:
+                        procs[i].kill()
+                        report.killed_after_failure.append(i)
+                report.note(
+                    f"killed hung peer rank(s) {report.killed_after_failure}"
+                    f" {peer_grace_s}s after rank "
+                    f"{report.first_failure[0]} failed"
+                )
+                return
+            if heartbeat_s and now >= next_beat:
+                report.note(
+                    f"heartbeat at {now - t0:.1f}s: ranks {alive} alive"
+                )
+                next_beat = now + heartbeat_s
+            done.wait(0.25)
+
     threads = [
         threading.Thread(target=drain, args=(i, p), daemon=True)
         for i, p in enumerate(procs)
     ]
+    monitor = threading.Thread(target=supervise, daemon=True)
     try:
         for t in threads:
             t.start()
+        monitor.start()
         for t in threads:
             t.join()
     finally:
+        done.set()
         for p in procs:
             if p.poll() is None:
                 p.kill()
-    return list(zip(procs, outs))
+    results = RankResults(zip(procs, outs))
+    results.report = report
+    return results

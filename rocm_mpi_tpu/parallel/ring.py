@@ -15,7 +15,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from rocm_mpi_tpu.utils.compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 
@@ -26,7 +26,9 @@ def ring_exchange(x, axis_name: str, shift: int = 1):
     `Sendrecv!(send, dst=rank+1, …, src=rank-1)` ring of
     rocmaware_test_selectdevice.jl:11-22 as a single XLA collective.
     """
-    n = lax.axis_size(axis_name)
+    from rocm_mpi_tpu.utils.compat import axis_size
+
+    n = axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis_name, perm)
 

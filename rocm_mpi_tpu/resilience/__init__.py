@@ -1,0 +1,27 @@
+"""Fault tolerance: supervised runs, checkpoint integrity, fault
+injection (docs/RESILIENCE.md).
+
+The layer spans three levels, matching where failure actually strikes:
+
+* `supervisor.run_supervised` — process-level retry/backoff around the
+  segmented checkpointed advance (crash → restore latest VALID step);
+* `utils.checkpoint` — per-save integrity manifests +
+  `latest_valid_step` fallback (torn/corrupt checkpoints are skipped,
+  never restored);
+* `faults` — deterministic fault injection (crash/kill/truncate/delay at
+  exact steps), wired through `run_segmented`, the launcher, and the
+  apps' `--inject-fault` flag, so every recovery path above is exercised
+  by tests (tests/test_resilience.py), not just by outages.
+"""
+
+from rocm_mpi_tpu.resilience.faults import (  # noqa: F401
+    FaultPlan,
+    InjectedCrash,
+    fault_point,
+    install,
+    install_from_env,
+)
+from rocm_mpi_tpu.resilience.supervisor import (  # noqa: F401
+    default_retryable,
+    run_supervised,
+)

@@ -1,0 +1,240 @@
+"""Deterministic fault injection (docs/RESILIENCE.md §3).
+
+Three rounds of accelerator outage (165 failed probes over ~11.5 h,
+docs/chip_watcher_r5.log) made failure this framework's most common
+input — so failure must be INJECTABLE, deterministically, at the exact
+points the resilience layer defends, or its recovery paths are dead code
+until the next real outage tests them in production.
+
+A fault plan is a comma-separated spec, from the `--inject-fault` app
+flag or the RMT_INJECT_FAULT env var (the launcher forwards it to every
+rank):
+
+    crash@step=K            raise InjectedCrash at the step-K fault point
+    crash@segment=N         raise at the Nth completed segment (1-based)
+    kill@step=K             os._exit(RC_INJECTED_KILL) at step K — the
+                            no-cleanup SIGKILL analog (mid-collective
+                            peers are left hanging; the launcher's
+                            first-failure reporting is the defense)
+    truncate-latest         after the next completed save, truncate the
+                            largest file of the newest checkpoint step
+    delay=S@step=K          sleep S seconds at step K (flapping-tunnel
+                            stall analog; exercises heartbeat reporting)
+
+Any clause may be rank-scoped with `rank=R`:
+
+    kill@step=4,rank=1      only process R injects (other ranks run clean)
+
+Every trigger is exact-match ("crash at step K", not "at or after"):
+a supervisor retry that re-runs past the same step must NOT re-fire the
+fault, so `fault_point` arms each clause at most MAX_FIRES times per
+process (default once). Determinism is the whole point: no randomness,
+no wall-clock dependence (delays excepted, by definition).
+
+Instrumented fault points:
+    "segment"  — utils/checkpoint.run_segmented, after each completed
+                 save (step = absolute step count, directory = ckpt dir)
+    "init"     — parallel/distributed.maybe_initialize_distributed,
+                 before jax.distributed.initialize (step = None)
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+RC_INJECTED_KILL = 43  # distinctive rc: a killed rank is diagnosable
+ENV_VAR = "RMT_INJECT_FAULT"
+
+
+class InjectedCrash(RuntimeError):
+    """The injected failure run_supervised retries around."""
+
+
+class FaultClause:
+    __slots__ = ("kind", "step", "segment", "rank", "delay_s", "fires")
+
+    def __init__(self, kind, step=None, segment=None, rank=None,
+                 delay_s=0.0):
+        self.kind = kind
+        self.step = step
+        self.segment = segment
+        self.rank = rank
+        self.delay_s = delay_s
+        self.fires = 0
+
+    def __repr__(self):
+        parts = [self.kind]
+        if self.step is not None:
+            parts.append(f"step={self.step}")
+        if self.segment is not None:
+            parts.append(f"segment={self.segment}")
+        if self.rank is not None:
+            parts.append(f"rank={self.rank}")
+        if self.delay_s:
+            parts.append(f"delay={self.delay_s}")
+        return f"FaultClause({', '.join(parts)})"
+
+
+def _parse_clause(raw: str) -> FaultClause:
+    head, *mods = [p.strip() for p in raw.split(",")]
+    kind, _, trigger = head.partition("@")
+    kind = kind.strip()
+    delay_s = 0.0
+    if kind.startswith("delay="):
+        delay_s = float(kind[len("delay="):])
+        kind = "delay"
+    if kind not in ("crash", "kill", "truncate-latest", "delay"):
+        raise ValueError(f"unknown fault kind {kind!r} in {raw!r}")
+    clause = FaultClause(kind, delay_s=delay_s)
+    triggers = [t for t in [trigger.strip()] + mods if t]
+    for t in triggers:
+        key, _, val = t.partition("=")
+        key = key.strip()
+        if key == "step":
+            clause.step = int(val)
+        elif key == "segment":
+            clause.segment = int(val)
+        elif key == "rank":
+            clause.rank = int(val)
+        else:
+            raise ValueError(f"unknown fault trigger {t!r} in {raw!r}")
+    if kind in ("crash", "kill", "delay") and clause.step is None \
+            and clause.segment is None:
+        raise ValueError(
+            f"{kind} fault needs a step=K or segment=N trigger: {raw!r}"
+        )
+    return clause
+
+
+class FaultPlan:
+    """Parsed, armed fault clauses; fault_point() consults the installed
+    plan. MAX_FIRES guards the retry path: a recovered-and-re-run step
+    must not re-fire its fault."""
+
+    MAX_FIRES = 1
+
+    def __init__(self, clauses):
+        self.clauses = list(clauses)
+        self._segments_seen = 0
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        # Clause separator is ';' so ',' stays free for modifiers.
+        clauses = [
+            _parse_clause(part)
+            for part in spec.split(";")
+            if part.strip()
+        ]
+        return cls(clauses)
+
+    def __bool__(self):
+        return bool(self.clauses)
+
+
+_PLAN: FaultPlan | None = None
+_ENV_CONSUMED = False  # the env spec installs at most once per process
+
+
+def _rank() -> int:
+    """This process's rank — parallel.distributed.process_id, which never
+    forces backend init (fault bookkeeping must not be what initializes
+    a backend). Lazy import: distributed's init path calls fault_point."""
+    from rocm_mpi_tpu.parallel.distributed import process_id
+
+    return process_id()
+
+
+def install(spec: str | None) -> FaultPlan | None:
+    """Install (or with None/'' clear) the process-wide fault plan. An
+    explicit install wins over — and permanently supersedes — the env
+    spec (a cleared plan stays cleared)."""
+    global _PLAN, _ENV_CONSUMED
+    _ENV_CONSUMED = True
+    _PLAN = FaultPlan.parse(spec) if spec else None
+    return _PLAN
+
+
+def install_from_env() -> FaultPlan | None:
+    """Install the plan from RMT_INJECT_FAULT, at most once per process;
+    cheap when the var is unset (the common case pays one getenv)."""
+    global _ENV_CONSUMED
+    if _ENV_CONSUMED:
+        return _PLAN
+    spec = os.environ.get(ENV_VAR, "").strip()
+    if spec:
+        install(spec)
+    else:
+        _ENV_CONSUMED = True
+    return _PLAN
+
+
+def active_plan() -> FaultPlan | None:
+    return _PLAN
+
+
+def _truncate_latest(directory) -> None:
+    """Truncate the largest file of the NEWEST checkpoint step dir —
+    the torn-write the integrity manifest must catch. Pure pathlib (no
+    checkpoint-module import: checkpoint imports us)."""
+    import pathlib
+
+    root = pathlib.Path(directory)
+    step_dirs = sorted(
+        (d for d in root.iterdir() if d.is_dir() and d.name.isdigit()),
+        key=lambda d: int(d.name),
+    )
+    if not step_dirs:
+        return
+    files = sorted(
+        (f for f in step_dirs[-1].rglob("*") if f.is_file()),
+        key=lambda f: f.stat().st_size,
+    )
+    if not files:
+        return
+    target = files[-1]
+    size = target.stat().st_size
+    with target.open("r+b") as fh:
+        fh.truncate(max(size // 2, 0))
+
+
+def fault_point(name: str, step=None, directory=None) -> None:
+    """Instrumentation hook: a no-op without an installed/env plan.
+
+    `name` identifies the instrumented site; `step` the absolute step
+    count where meaningful; `directory` the checkpoint dir (needed by
+    truncate-latest).
+    """
+    plan = install_from_env()
+    if not plan:
+        return
+    if name == "segment":
+        plan._segments_seen += 1
+    rank = _rank()
+    for clause in plan.clauses:
+        if clause.fires >= plan.MAX_FIRES:
+            continue
+        if clause.rank is not None and clause.rank != rank:
+            continue
+        hit = False
+        if clause.step is not None:
+            hit = step is not None and int(step) == clause.step
+        elif clause.segment is not None:
+            hit = name == "segment" and plan._segments_seen == clause.segment
+        elif clause.kind == "truncate-latest":
+            hit = name == "segment" and directory is not None
+        if not hit:
+            continue
+        clause.fires += 1
+        if clause.kind == "delay":
+            time.sleep(clause.delay_s)
+        elif clause.kind == "truncate-latest":
+            if directory is not None:
+                _truncate_latest(directory)
+        elif clause.kind == "kill":
+            os._exit(RC_INJECTED_KILL)  # noqa: SLF001 — the point: no cleanup
+        elif clause.kind == "crash":
+            raise InjectedCrash(
+                f"injected crash at fault point {name!r} "
+                f"(step={step}, rank={rank})"
+            )

@@ -55,6 +55,45 @@ def enable_persistent_cache() -> None:
             pass
 
 
+def set_cpu_device_count(n: int) -> None:
+    """Request `n` virtual CPU devices, portably across jax versions.
+
+    Newer jax has the `jax_num_cpu_devices` config knob; 0.4.37 (this
+    image) does not, so the fallback appends XLA's
+    `--xla_force_host_platform_device_count=N` to XLA_FLAGS — which the
+    CPU client reads at backend creation, so it still works after
+    `import jax` as long as no backend has initialized yet. One shim,
+    all five call sites (tests/conftest, tests/distributed_worker,
+    apps/_common, apps/ici_ring_test, __graft_entry__) — the quirk must
+    not be re-solved per entry point.
+
+    Best-effort once a backend is up: the config path raises (newer jax)
+    but the XLA_FLAGS path is silently inert after initialization, so
+    callers that REQUIRE the count must assert `len(jax.devices())`
+    afterwards (tests/conftest.py does).
+    """
+    import jax
+
+    n = int(n)
+    if n < 1:
+        raise ValueError(f"device count must be >= 1, got {n}")
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+        return
+    except AttributeError:
+        pass  # jax 0.4.x: no knob — fall back to the XLA flag
+    flag = f"--xla_force_host_platform_device_count={n}"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        # Replace any prior count (ours or inherited) — last write wins
+        # in XLA's parser is not guaranteed, so scrub first.
+        flags = " ".join(
+            f for f in flags.split()
+            if "xla_force_host_platform_device_count" not in f
+        )
+    os.environ["XLA_FLAGS"] = (flags + " " + flag).strip()
+
+
 def require_accelerator(script: str) -> None:
     """Exit 2 when jax resolved to the CPU fallback.
 

@@ -1,11 +1,14 @@
-"""Checkpoint/resume (SURVEY.md §5.4, upgraded beyond matched scope).
+"""Checkpoint/resume with integrity manifests (SURVEY.md §5.4 upgraded;
+resilience layer: docs/RESILIENCE.md).
 
 The reference persists nothing but a final PNG (its §5.4 row is "none");
-round 3 matched that with `--save-field`. This module adds the real
+round 3 matched that with `--save-field`. This module is the real
 subsystem a long run needs: periodic sharded checkpoints via orbax (the
-TPU-ecosystem checkpoint library), with resume-from-latest — so a
-multi-hour run survives preemption, the exact failure mode the flapping
-chip tunnel demonstrates (BASELINE.md outage log).
+TPU-ecosystem checkpoint library), resume-from-latest, and — the PR-1
+resilience upgrade — a per-save INTEGRITY MANIFEST so a resumed run can
+tell a good checkpoint from a truncated or corrupt one and fall back to
+the previous kept step instead of restarting (or worse, silently
+continuing) from garbage.
 
 Design: the timed loop stays ONE jitted `advance(state..., n)` program —
 checkpointing never reaches inside it. `run_segmented` splits the step
@@ -14,11 +17,43 @@ saves, and a resumed run continues from the latest saved step with the
 SAME compiled program (the segment lengths differ only in the traced `n`).
 State arrays keep their NamedSharding: orbax saves/restores per-shard, so
 a sharded run checkpoints without gathering to one host.
+
+Two donation hazards this module owns (measured on the installed
+jax 0.4.37 CPU stack, pinned by tests/test_resilience.py):
+
+* SAVE: orbax saves asynchronously, but the framework's advance donates
+  its state argument — an in-flight async save reads the very buffer the
+  next segment's advance reuses, and the checkpoint lands full of
+  garbage (every mid-run save corrupted, measured). `run_segmented`
+  therefore waits for each save to complete before advancing; the wait
+  is also what makes the manifest sound (it hashes the files the save
+  actually wrote).
+* RESTORE: orbax-restored arrays can alias buffers XLA does not own
+  exclusively; donating them straight into the jitted advance produces
+  garbage. `restore_state` returns a defensive on-device copy, so its
+  output is always donation-safe.
+
+Manifest format (manifest-<step>.json next to orbax's step dir):
+    {"step": int,
+     "treedef": str(jax.tree_util.tree_structure(state)),
+     "leaves": [{"shape": [...], "dtype": "...", "crc32": int|null}, ...],
+     "files": {"<relpath under the step dir>": size_bytes, ...}}
+crc32 is over the leaf's row-major host bytes; null for non-fully-
+addressable (multi-host) leaves, where no single process sees the data.
+Validation (latest_valid_step / verify_step) re-walks the step dir and
+compares the file inventory — a truncated or missing file changes a size
+— and restore_state(verify=True) re-hashes the restored leaves.
 """
 
 from __future__ import annotations
 
+import json
 import pathlib
+import zlib
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """A checkpoint failed integrity validation (manifest mismatch)."""
 
 
 def _manager(directory, keep: int = 3):
@@ -31,19 +66,132 @@ def _manager(directory, keep: int = 3):
     )
 
 
-def save_state(directory, step: int, state, keep: int = 3) -> None:
-    """Save `state` (any pytree of jax arrays — sharded arrays keep their
-    sharding) labeled by absolute step count."""
-    import orbax.checkpoint as ocp
+def _manifest_path(directory, step: int) -> pathlib.Path:
+    return pathlib.Path(directory) / f"manifest-{int(step)}.json"
 
-    mgr = _manager(directory, keep)
-    mgr.save(step, args=ocp.args.StandardSave(state))
-    mgr.wait_until_finished()
-    mgr.close()
+
+def _step_dir(directory, step: int) -> pathlib.Path:
+    """Orbax CheckpointManager lays out saves as <directory>/<step>/."""
+    return pathlib.Path(directory) / str(int(step))
+
+
+def _leaf_entries(state):
+    """Per-leaf (shape, dtype, crc32) records; crc32 None where no single
+    process holds the whole array (multi-host shards)."""
+    import jax
+    import numpy as np
+
+    entries = []
+    for leaf in jax.tree_util.tree_leaves(state):
+        if getattr(leaf, "is_fully_addressable", True):
+            arr = np.asarray(leaf)
+            entries.append(
+                {
+                    "shape": list(arr.shape),
+                    "dtype": str(arr.dtype),
+                    "crc32": zlib.crc32(np.ascontiguousarray(arr).tobytes()),
+                }
+            )
+        else:
+            entries.append(
+                {
+                    "shape": list(leaf.shape),
+                    "dtype": str(leaf.dtype),
+                    "crc32": None,
+                }
+            )
+    return entries
+
+
+def _file_inventory(step_dir: pathlib.Path) -> dict:
+    return {
+        str(p.relative_to(step_dir)): p.stat().st_size
+        for p in sorted(step_dir.rglob("*"))
+        if p.is_file()
+    }
+
+
+def write_manifest(directory, step: int, state) -> None:
+    """Record the integrity manifest for a COMPLETED save at `step`.
+
+    Must run after the save is durable (run_segmented waits first): the
+    file inventory hashes what orbax actually wrote. Process-0-only on
+    multi-host runs — one writer, one manifest.
+    """
+    import jax
+
+    if jax.process_index() != 0:
+        return
+    manifest = {
+        "step": int(step),
+        "treedef": str(jax.tree_util.tree_structure(state)),
+        "leaves": _leaf_entries(state),
+        "files": _file_inventory(_step_dir(directory, step)),
+    }
+    path = _manifest_path(directory, step)
+    tmp = path.with_suffix(".json.tmp")
+    tmp.write_text(json.dumps(manifest, indent=1))
+    tmp.replace(path)  # atomic: a crash mid-write cannot half-publish
+
+
+def _prune_stale_manifests(directory) -> None:
+    """Drop manifests whose step dir orbax already garbage-collected
+    (max_to_keep): a manifest must never outlive — or vouch for — a
+    checkpoint that is gone."""
+    root = pathlib.Path(directory)
+    for path in root.glob("manifest-*.json"):
+        step = path.stem.rpartition("-")[2]
+        if step.isdigit() and not (root / step).is_dir():
+            try:
+                path.unlink()
+            except OSError:
+                pass
+
+
+def read_manifest(directory, step: int) -> dict | None:
+    path = _manifest_path(directory, step)
+    if not path.is_file():
+        return None
+    try:
+        return json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None  # unreadable/truncated manifest = no manifest
+
+
+def verify_step(directory, step: int) -> tuple[bool, str]:
+    """Validate the checkpoint at `step` against its manifest WITHOUT
+    restoring it: the step dir must exist and its file inventory must
+    match the manifest byte-for-byte in names and sizes (a truncated,
+    missing, or extra file all change the inventory). Returns
+    (ok, reason). A step with no manifest reports ok=False with reason
+    'no manifest' — latest_valid_step decides the legacy policy.
+    """
+    step_dir = _step_dir(directory, step)
+    if not step_dir.is_dir():
+        return False, f"step dir {step_dir} missing"
+    manifest = read_manifest(directory, step)
+    if manifest is None:
+        return False, "no manifest"
+    if manifest.get("step") != int(step):
+        return False, f"manifest step field {manifest.get('step')} != {step}"
+    want = manifest.get("files", {})
+    have = _file_inventory(step_dir)
+    if want != have:
+        missing = sorted(set(want) - set(have))
+        extra = sorted(set(have) - set(want))
+        resized = sorted(
+            k for k in set(want) & set(have) if want[k] != have[k]
+        )
+        return False, (
+            f"file inventory mismatch (missing={missing[:3]}, "
+            f"extra={extra[:3]}, resized={resized[:3]})"
+        )
+    return True, "ok"
 
 
 def latest_step(directory) -> int | None:
-    """The newest checkpointed step in `directory`, or None."""
+    """The newest checkpointed step in `directory` (no validation), or
+    None. Prefer latest_valid_step for resume decisions."""
     path = pathlib.Path(directory)
     if not path.is_dir():
         return None
@@ -53,12 +201,79 @@ def latest_step(directory) -> int | None:
     return step
 
 
-def restore_state(directory, step: int, like):
+def all_steps(directory) -> list:
+    path = pathlib.Path(directory)
+    if not path.is_dir():
+        return []
+    mgr = _manager(path)
+    steps = sorted(mgr.all_steps())
+    mgr.close()
+    return steps
+
+
+def latest_valid_step(directory, log=None) -> int | None:
+    """The newest checkpointed step that passes integrity validation,
+    falling back through older kept steps past corrupt/truncated ones.
+
+    Policy for manifest-less steps: when the directory has NO manifests
+    at all it predates the integrity layer — every step is trusted
+    (legacy behavior, = latest_step). When any manifest exists, a step
+    without one is an incomplete save (the manifest is written after the
+    save completes) and is skipped.
+
+    `log` (callable, e.g. log0) receives one line per rejected step, so
+    a fallback is never silent.
+    """
+    steps = all_steps(directory)
+    if not steps:
+        return None
+    legacy = not any(
+        _manifest_path(directory, s).is_file() for s in steps
+    )
+    for step in reversed(steps):
+        ok, reason = verify_step(directory, step)
+        if ok or (legacy and reason == "no manifest"):
+            return step
+        if log is not None:
+            log(
+                f"checkpoint step {step} failed validation ({reason}); "
+                "falling back to the previous kept step"
+            )
+    return None
+
+
+def save_state(directory, step: int, state, keep: int = 3) -> None:
+    """Save `state` (any pytree of jax arrays — sharded arrays keep their
+    sharding) labeled by absolute step count, then record its manifest."""
+    import orbax.checkpoint as ocp
+
+    mgr = _manager(directory, keep)
+    mgr.save(step, args=ocp.args.StandardSave(state))
+    mgr.wait_until_finished()
+    mgr.close()
+    write_manifest(directory, step, state)
+    _prune_stale_manifests(directory)
+
+
+def restore_state(directory, step: int, like, verify: bool = True):
     """Restore the pytree saved at `step`, placed/sharded like the
     abstract template `like` (pass the freshly-initialized state — shapes,
     dtypes, and shardings are taken from it, so a restored run lands
-    exactly where the initializer would have put it)."""
+    exactly where the initializer would have put it).
+
+    verify=True re-hashes every fully-addressable restored leaf against
+    the manifest's crc32 (when a manifest exists) and raises
+    CheckpointCorruptionError on mismatch — bit rot between save and
+    restore cannot silently continue the run.
+
+    The returned pytree is a defensive on-device copy: orbax-restored
+    arrays can alias buffers XLA does not own exclusively, and donating
+    such an array into a jitted advance produced garbage on this stack
+    (measured; tests/test_resilience.py pins the safe behavior).
+    """
     import jax
+    import jax.numpy as jnp
+    import numpy as np
     import orbax.checkpoint as ocp
 
     mgr = _manager(directory)
@@ -68,7 +283,29 @@ def restore_state(directory, step: int, like):
     )
     out = mgr.restore(step, args=ocp.args.StandardRestore(template))
     mgr.close()
-    return out
+    if verify:
+        manifest = read_manifest(directory, step)
+        if manifest is not None:
+            leaves = jax.tree_util.tree_leaves(out)
+            want = manifest.get("leaves", [])
+            if len(want) != len(leaves):
+                raise CheckpointCorruptionError(
+                    f"step {step}: manifest records {len(want)} leaves, "
+                    f"restored {len(leaves)}"
+                )
+            for i, (leaf, rec) in enumerate(zip(leaves, want)):
+                if rec.get("crc32") is None:
+                    continue
+                if not getattr(leaf, "is_fully_addressable", True):
+                    continue
+                arr = np.asarray(leaf)
+                crc = zlib.crc32(np.ascontiguousarray(arr).tobytes())
+                if crc != rec["crc32"]:
+                    raise CheckpointCorruptionError(
+                        f"step {step} leaf {i}: crc32 {crc} != manifest "
+                        f"{rec['crc32']} — restored data is corrupt"
+                    )
+    return jax.tree_util.tree_map(jnp.copy, out)
 
 
 def run_segmented(
@@ -86,21 +323,32 @@ def run_segmented(
     contract — so every segment reuses one compiled program. Returns the
     final state.
 
+    Each save COMPLETES (wait_until_finished) before the next segment
+    runs: the framework's advance donates its state buffer, and on this
+    stack an in-flight async save reads the donated-and-reused buffer —
+    every mid-run checkpoint was measured corrupt under the old
+    overlapped design. The completed save is then manifested, which is
+    what latest_valid_step validates on resume.
+
+    Fault-injection hook: resilience.faults.fault_point("segment", ...)
+    fires after every completed save, so crash-at-step-k and
+    truncate-latest faults exercise this exact loop (tests/
+    test_resilience.py).
+
     Resume idiom (what the apps' --resume flag does):
 
-        start = latest_step(dir) or 0
+        start = latest_valid_step(dir) or 0
         state = restore_state(dir, start, init_state) if start else init_state
         state = run_segmented(advance, state, nt, dir, every, start)
     """
     import orbax.checkpoint as ocp
 
+    from rocm_mpi_tpu.resilience import faults
+
     if every < 1:
         raise ValueError(f"checkpoint interval must be >= 1, got {every}")
     if not 0 <= start_step <= nt:
         raise ValueError(f"need 0 <= start_step <= nt, got {start_step}, {nt}")
-    # ONE manager for the whole run: orbax saves asynchronously, so each
-    # segment's write overlaps the next segment's compute; the single
-    # wait_until_finished at the end is the only forced sync.
     mgr = _manager(directory, keep)
     try:
         step = start_step
@@ -109,7 +357,10 @@ def run_segmented(
             state = advance(state, n)
             step += n
             mgr.save(step, args=ocp.args.StandardSave(state))
-        mgr.wait_until_finished()
+            mgr.wait_until_finished()
+            write_manifest(directory, step, state)
+            _prune_stale_manifests(directory)
+            faults.fault_point("segment", step=step, directory=directory)
     finally:
         mgr.close()
     return state

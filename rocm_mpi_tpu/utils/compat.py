@@ -1,0 +1,73 @@
+"""jax version compatibility shims (installed: 0.4.37; code targets newer).
+
+One module owns every "where does this live / what is it called in this
+jax" question, so version drift is fixed in exactly one place:
+
+* `shard_map` — newer jax exports it at the top level and calls the
+  replication-check kwarg `check_vma`; 0.4.x has it under
+  `jax.experimental.shard_map` with the kwarg named `check_rep`. The
+  wrapper resolves the import once and renames the kwarg to whatever the
+  resolved implementation actually accepts (either direction, so the
+  call sites stay written against the modern API).
+
+`utils.backend.set_cpu_device_count` is the same idea for the
+virtual-CPU-device knob.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # newer jax: top-level export
+    from jax import shard_map as _shard_map_impl
+except ImportError:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+_SHARD_MAP_PARAMS = frozenset(
+    inspect.signature(_shard_map_impl).parameters
+)
+
+
+def shard_map(*args, **kwargs):
+    """`jax.shard_map` with the replication-check kwarg renamed to match
+    the installed implementation (`check_vma` <-> `check_rep`)."""
+    for ours, theirs in (("check_vma", "check_rep"), ("check_rep", "check_vma")):
+        if ours in kwargs and ours not in _SHARD_MAP_PARAMS:
+            kwargs[theirs] = kwargs.pop(ours)
+    return _shard_map_impl(*args, **kwargs)
+
+
+def axis_size(axis_name) -> int:
+    """`lax.axis_size` (newer jax) for 0.4.x too: `psum(1, name)` of the
+    static literal 1 constant-folds to the mesh axis size at trace time —
+    a Python int, usable to build ppermute permutations."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def cost_analysis_dict(compiled) -> dict:
+    """`compiled.cost_analysis()` as a flat dict: 0.4.x returns a
+    one-dict-per-partition LIST (take the first), newer jax the dict
+    itself; both normalize to {} when analysis is unavailable."""
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return dict(cost or {})
+
+
+def out_struct_like(shape, exemplar):
+    """ShapeDtypeStruct matching `exemplar`'s dtype and (where the
+    installed jax tracks it) mesh-varying axes: under jax>=0.9 check_vma,
+    pallas_call outputs inside shard_map must declare which mesh axes
+    they vary over, so propagate the input's vma set; 0.4.x has no vma
+    tracking and takes the plain struct."""
+    import jax
+
+    if hasattr(jax, "typeof"):
+        return jax.ShapeDtypeStruct(
+            shape, exemplar.dtype, vma=jax.typeof(exemplar).vma
+        )
+    return jax.ShapeDtypeStruct(shape, exemplar.dtype)

@@ -21,7 +21,10 @@ timing windows of many steps.
 
 from __future__ import annotations
 
+import dataclasses
+import json
 import math
+import os
 import time
 
 import jax
@@ -85,3 +88,65 @@ def t_eff_gbs(shape, itemsize: int, wtime_it: float, n_passes: int = 3) -> float
 def gpts_per_s(shape, wtime_it: float) -> float:
     """Grid points processed per second [Gpts/s] — the driver's metric."""
     return math.prod(shape) / wtime_it / 1e9
+
+
+# ---------------------------------------------------------------------------
+# Structured run events (resilience layer, docs/RESILIENCE.md §2).
+#
+# The supervisor's retry/backoff decisions must leave a machine-readable
+# trail — "the run recovered twice" is an operational fact the same way
+# T_eff is a performance fact. Events accumulate in-process (the tests'
+# and supervisor-caller's view) and, when RMT_EVENT_LOG names a path,
+# append as JSON lines (the post-mortem view: the file survives the
+# process the way the chip watcher's log survived the outage rounds).
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class RunEvent:
+    """One structured resilience event (retry, restore, give-up...)."""
+
+    kind: str            # e.g. "attempt-failed", "backoff", "restored"
+    t: float             # time.time() at emission
+    attempt: int | None = None
+    step: int | None = None
+    wait_s: float | None = None
+    error: str | None = None
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {k: v for k, v in dataclasses.asdict(self).items()
+             if v is not None}
+        )
+
+
+_EVENTS: list[RunEvent] = []
+
+
+def record_event(kind: str, *, attempt=None, step=None, wait_s=None,
+                 error=None) -> RunEvent:
+    """Append a structured event; best-effort tee to RMT_EVENT_LOG."""
+    ev = RunEvent(
+        kind=kind, t=time.time(), attempt=attempt, step=step,
+        wait_s=wait_s, error=error,
+    )
+    _EVENTS.append(ev)
+    path = os.environ.get("RMT_EVENT_LOG")
+    if path:
+        try:
+            with open(path, "a") as fh:
+                fh.write(ev.to_json() + "\n")
+        except OSError:
+            pass  # the event log must never be what kills a run
+    return ev
+
+
+def events(kind: str | None = None) -> list[RunEvent]:
+    """The in-process event trail (optionally filtered by kind)."""
+    if kind is None:
+        return list(_EVENTS)
+    return [e for e in _EVENTS if e.kind == kind]
+
+
+def clear_events() -> None:
+    _EVENTS.clear()

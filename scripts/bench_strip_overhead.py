@@ -53,7 +53,9 @@ def main(argv=None) -> int:
     import jax
     import jax.numpy as jnp
     import numpy as np
-    from jax import lax, shard_map
+    from jax import lax
+
+    from rocm_mpi_tpu.utils.compat import shard_map
 
     from rocm_mpi_tpu.config import DiffusionConfig
     from rocm_mpi_tpu.models import HeatDiffusion

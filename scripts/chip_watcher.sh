@@ -89,12 +89,16 @@ run_headline() {
 group_log() { echo "docs/tpu_tier_${1}_r5.txt"; }
 
 group_done() {
-  # Promoted only on pytest rc=0 with a pass count and no skips (a
-  # mid-window CPU fallback would green-skip the whole group).
+  # Promoted only on pytest rc=0 with a pass count and no NO-ACCELERATOR
+  # skip (a mid-window CPU fallback would green-skip the whole group; the
+  # -rs run prints each skip's reason, so the backend-guard reason from
+  # tests_tpu/conftest.py is grep-able). A conditional skip added for any
+  # OTHER reason must not make the group permanently unpromotable
+  # (ADVICE r5 #2).
   local log; log="$(group_log "$1")"
   [ -s "$log" ] \
     && grep -qE "[0-9]+ passed" "$log" \
-    && ! grep -qE "[0-9]+ skipped" "$log" \
+    && ! grep -q "needs a TPU backend" "$log" \
     && ! grep -q "^INCOMPLETE" "$log"
 }
 
@@ -115,10 +119,12 @@ run_tier_groups() {
     fi
     log="$(group_log "$g")"
     echo "[watcher] tier $g starting at $(date -u +%H:%M:%S)"
-    timeout -k 15 2400 python -m pytest tests_tpu/ -m "$g" -q 2>&1 | tee "${log}.part"
+    # -rs: print skip reasons, so promotion can tell the fatal
+    # no-accelerator skip from a benign conditional one (group_done).
+    timeout -k 15 2400 python -m pytest tests_tpu/ -m "$g" -q -rs 2>&1 | tee "${log}.part"
     rc=${PIPESTATUS[0]}
     if [ "$rc" -eq 0 ] && grep -qE "[0-9]+ passed" "${log}.part" \
-        && ! grep -qE "[0-9]+ skipped" "${log}.part"; then
+        && ! grep -q "needs a TPU backend" "${log}.part"; then
       mv "${log}.part" "$log"
     else
       { echo "INCOMPLETE rc=$rc at $(date -u +%FT%TZ)"

@@ -90,8 +90,10 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(skip)
 
 
+from rocm_mpi_tpu.utils.backend import set_cpu_device_count  # noqa: E402
+
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+set_cpu_device_count(8)  # compat shim: jax 0.4.37 has no jax_num_cpu_devices
 jax.config.update("jax_enable_x64", True)
 
 assert len(jax.devices()) == 8, (

@@ -26,8 +26,10 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import jax
 
+from rocm_mpi_tpu.utils.backend import set_cpu_device_count
+
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)  # 2 local × 2 procs = 4 global
+set_cpu_device_count(2)  # 2 local × 2 procs = 4 global
 jax.config.update("jax_enable_x64", True)
 
 

@@ -1,0 +1,54 @@
+"""Gloo-real rank worker for the kill-rank-mid-collective drill (slow
+lane; tests/test_resilience.py drives it via spawn_ranks).
+
+The real multi-process shape of the failure the launcher supervises:
+both ranks join a jax.distributed cluster, then run cross-process
+collective steps with a fault point before each — an injected
+`kill@step=K,rank=R` takes rank R down mid-run and the survivor's next
+collective can never complete. The launcher must record the first
+failure and kill the hung survivor within the peer grace window.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+from rocm_mpi_tpu.utils.backend import set_cpu_device_count
+
+jax.config.update("jax_platforms", "cpu")
+set_cpu_device_count(2)
+jax.config.update("jax_enable_x64", True)
+
+
+def main() -> int:
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from rocm_mpi_tpu.parallel.distributed import maybe_initialize_distributed
+    from rocm_mpi_tpu.resilience import faults
+    from rocm_mpi_tpu.utils import metrics
+
+    assert maybe_initialize_distributed(), "launcher env not detected"
+    mesh = Mesh(np.array(jax.devices()).reshape(-1), ("x",))
+    sharding = NamedSharding(mesh, PartitionSpec("x"))
+    x = jax.device_put(jnp.arange(8.0), sharding)
+
+    @jax.jit
+    def step(v):
+        return v + jnp.sum(v)  # global sum: every rank must participate
+
+    for i in range(1, 9):
+        faults.fault_point("segment", step=i)
+        x = step(x)
+        metrics.force(x)
+    print("GLOO_WORKER_DONE", flush=True)
+    jax.distributed.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,49 @@
+"""Rank worker for the launcher's failure-path drills (run via
+spawn_ranks; tests/test_resilience.py is the driver).
+
+Each rank steps through `--steps` fault points (the instrumented-site
+shape run_segmented uses), so an injected `kill@step=K,rank=R` spec —
+forwarded by the launcher through RMT_INJECT_FAULT — kills exactly rank
+R at exactly step K. Surviving ranks then block in `--hang-after` mode
+(stand-in for a collective that can never complete once a peer is dead),
+which is precisely the state the launcher's first-failure supervision
+must detect and put down within the peer grace window — instead of every
+survivor burning the full timeout.
+
+jax-free on purpose: the drill measures LAUNCHER supervision semantics
+(heartbeat, first-failure record, peer kill) deterministically and in
+seconds; the gloo-real analog lives in the slow lane
+(tests/test_resilience.py::test_kill_rank_mid_collective_gloo).
+"""
+
+import argparse
+import sys
+import time
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--step-s", type=float, default=0.05)
+    p.add_argument(
+        "--hang-after", action="store_true",
+        help="after the step loop, block ~forever (the hung-collective "
+        "stand-in the launcher must kill)",
+    )
+    args = p.parse_args()
+
+    from rocm_mpi_tpu.parallel.distributed import process_id
+    from rocm_mpi_tpu.resilience import faults
+
+    rank = process_id()
+    for step in range(1, args.steps + 1):
+        faults.fault_point("segment", step=step)
+        time.sleep(args.step_s)
+    print(f"WORKER_DONE rank={rank}", flush=True)
+    if args.hang_after:
+        time.sleep(3600)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -184,9 +184,10 @@ def test_prime_cache_no_accelerator_is_clean_noop():
 def test_child_kernel_form_ladder_picks_winner(monkeypatch, capsys):
     """Stage 2.5's first real execution is the driver's chip run — pin the
     ladder's CONTROL FLOW in-process so a crash there can never be
-    discovered on the scored run: every candidate is timed, the winner's
-    constants are installed for the long window, the long-window emit is
-    labeled with the winning form, and the best rate is what lands on
+    discovered on the scored run: every candidate is timed as an EXPLICIT
+    trace-time kwarg pair (never a mutated pk global — ADVICE r5 #1), the
+    winner's kwargs are what the long window runs, the long-window emit
+    is labeled with the winning form, and the best rate is what lands on
     stdout. Stub model; no accelerator needed."""
     bench = _import_bench()
     import rocm_mpi_tpu.ops.pallas_kernels as pk
@@ -198,6 +199,7 @@ def test_child_kernel_form_ladder_picks_winner(monkeypatch, capsys):
         ("eqc", True): 110.0,
         ("conly", True): 150.0,
     }
+    calls = []
 
     class _Res:
         def __init__(self, gpts):
@@ -209,23 +211,31 @@ def test_child_kernel_form_ladder_picks_winner(monkeypatch, capsys):
         def __init__(self, nt, warmup):
             pass
 
-        def run_vmem_resident(self, chunk=None):
+        def run_vmem_resident(self, chunk=None, body_form=None,
+                              pad_pow2=None):
+            # None defaults to the module constants, exactly as the real
+            # fused_multi_step resolves them.
+            form = pk.EQC_BODY_FORM if body_form is None else body_form
+            pad = pk.VMEM_PAD_POW2 if pad_pow2 is None else pad_pow2
+            calls.append((chunk, form, pad))
             if chunk == 16:  # the floor stage
                 return _Res(50.0)
-            return _Res(rates[(pk.EQC_BODY_FORM, pk.VMEM_PAD_POW2)])
+            return _Res(rates[(form, pad)])
 
     monkeypatch.setattr(bench, "_accelerated", lambda: True)
     monkeypatch.setattr(bench, "_apply_platform_override", lambda: None)
     monkeypatch.setattr(bench, "_setup_compilation_cache", lambda: None)
     monkeypatch.setattr(bench, "_bench_model", lambda nt, wu: _Model(nt, wu))
-    monkeypatch.setattr(pk, "EQC_BODY_FORM", "eqc")
-    monkeypatch.setattr(pk, "VMEM_PAD_POW2", False)
 
     rc = bench.child_main(budget_s=300.0)
     out = capsys.readouterr()
     assert rc == bench.RC_OK
-    # Winner installed for the long window and named in the record.
-    assert (pk.EQC_BODY_FORM, pk.VMEM_PAD_POW2) == ("conly", True)
+    # The ladder passed every candidate explicitly and the module
+    # constants were never touched (the measured hardware defaults).
+    assert (pk.EQC_BODY_FORM, pk.VMEM_PAD_POW2) == ("eqc", False)
+    assert {(f, p) for _, f, p in calls} == set(rates)
+    # The long window (the last call) rides the winner's kwargs.
+    assert calls[-1][1:] == ("conly", True)
     assert "kernel-form ladder winner: conly+pad256" in out.err
     assert "conly+pad256 x" in out.err  # long-window label carries the form
     # stdout's last emitted line is the best rate (the long window re-runs

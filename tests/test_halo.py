@@ -5,7 +5,7 @@ shard-vs-global oracle, and the host-staged transport oracle
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from rocm_mpi_tpu.utils.compat import shard_map
 from jax.sharding import PartitionSpec
 
 from rocm_mpi_tpu.config import DiffusionConfig

@@ -22,7 +22,7 @@ hypothesis = pytest.importorskip(
     "hypothesis", reason="property tests need the hypothesis package"
 )
 from hypothesis import given, settings, strategies as st  # noqa: E402
-from jax import shard_map  # noqa: E402
+from rocm_mpi_tpu.utils.compat import shard_map  # noqa: E402
 
 from rocm_mpi_tpu.parallel import exchange_halo, init_global_grid  # noqa: E402
 

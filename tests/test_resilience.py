@@ -1,0 +1,367 @@
+"""Failure paths, exercised deterministically (docs/RESILIENCE.md).
+
+The resilience layer's claims, each pinned by injection — never by
+waiting for the next real outage:
+
+  * integrity: a truncated/corrupt latest checkpoint is skipped and the
+    previous kept step restores instead (manifest validation);
+  * supervision: a crash at the segment midpoint recovers through
+    run_supervised to a final state BITWISE-equal to the uninterrupted
+    run (same compiled program, same segment arithmetic);
+  * bounded retries with exponential backoff, every decision recorded as
+    a structured utils.metrics event;
+  * launcher: an injected rank kill is detected as the first failure and
+    hung peers are put down within the grace window (the bare-timeout
+    kill this PR replaces).
+
+The 2D heat model on the virtual 8-device CPU mesh keeps every scenario
+sharded — orbax saves per-shard, so integrity validation covers the
+multi-file checkpoint layout, not a toy single array.
+"""
+
+import pathlib
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rocm_mpi_tpu.config import DiffusionConfig
+from rocm_mpi_tpu.models import HeatDiffusion
+from rocm_mpi_tpu.parallel.launcher import spawn_ranks
+from rocm_mpi_tpu.resilience import faults, run_supervised
+from rocm_mpi_tpu.resilience.faults import RC_INJECTED_KILL, InjectedCrash
+from rocm_mpi_tpu.utils import checkpoint as ckpt
+from rocm_mpi_tpu.utils import metrics
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+NT, EVERY = 32, 8
+
+
+@pytest.fixture(autouse=True)
+def _clean_events_and_faults():
+    metrics.clear_events()
+    yield
+    faults.install(None)
+    metrics.clear_events()
+
+
+def _model(dims=(2, 4)):
+    cfg = DiffusionConfig(
+        global_shape=(32, 32), lengths=(10.0, 10.0), nt=NT, warmup=0,
+        dtype="f64", dims=dims,
+    )
+    model = HeatDiffusion(cfg)
+    T, Cp = model.init_state()
+    advance = model.advance_fn("perf")
+    # 1-tuple state: orbax wants container structure, and the apps'
+    # checkpointed_run wraps the same way.
+    adv = lambda s, n: (advance(s[0], Cp, n),)
+    return model, adv, (T,)
+
+
+def _ref(adv, state, nt=NT):
+    return adv((jnp.copy(state[0]),), nt)
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint integrity: manifests, validation, fallback
+# ---------------------------------------------------------------------------
+
+
+def test_segmented_run_writes_valid_manifests(tmp_path):
+    _, adv, state = _model()
+    ckpt.run_segmented(adv, state, NT, tmp_path, every=EVERY)
+    steps = ckpt.all_steps(tmp_path)
+    assert steps, "no checkpoints written"
+    for step in steps:
+        ok, reason = ckpt.verify_step(tmp_path, step)
+        assert ok, f"step {step}: {reason}"
+        manifest = ckpt.read_manifest(tmp_path, step)
+        assert manifest["step"] == step
+        assert manifest["leaves"][0]["dtype"] == "float64"
+        assert manifest["files"], "empty file inventory"
+    assert ckpt.latest_valid_step(tmp_path) == ckpt.latest_step(tmp_path)
+
+
+def test_truncated_latest_falls_back_to_previous_step(tmp_path):
+    _, adv, state = _model()
+    ckpt.run_segmented(adv, state, NT, tmp_path, every=EVERY)
+    latest = ckpt.latest_step(tmp_path)
+    prev = ckpt.all_steps(tmp_path)[-2]
+    faults._truncate_latest(tmp_path)
+    ok, reason = ckpt.verify_step(tmp_path, latest)
+    assert not ok and "mismatch" in reason
+    msgs = []
+    assert ckpt.latest_valid_step(tmp_path, log=msgs.append) == prev
+    assert any("failed validation" in m for m in msgs), msgs
+
+
+def test_missing_manifest_is_invalid_when_others_exist(tmp_path):
+    _, adv, state = _model()
+    ckpt.run_segmented(adv, state, NT, tmp_path, every=EVERY)
+    latest = ckpt.latest_step(tmp_path)
+    prev = ckpt.all_steps(tmp_path)[-2]
+    # An unmanifested step = a save that never completed (the manifest is
+    # written after wait_until_finished): not trustworthy.
+    (tmp_path / f"manifest-{latest}.json").unlink()
+    assert ckpt.latest_valid_step(tmp_path) == prev
+
+
+def test_legacy_dir_without_any_manifests_trusts_latest(tmp_path):
+    _, adv, state = _model()
+    ckpt.run_segmented(adv, state, NT, tmp_path, every=EVERY)
+    for p in tmp_path.glob("manifest-*.json"):
+        p.unlink()
+    assert ckpt.latest_valid_step(tmp_path) == ckpt.latest_step(tmp_path)
+
+
+def test_restore_verify_catches_checksum_mismatch(tmp_path):
+    import json
+
+    _, adv, state = _model()
+    ckpt.run_segmented(adv, state, NT, tmp_path, every=EVERY)
+    latest = ckpt.latest_step(tmp_path)
+    mpath = tmp_path / f"manifest-{latest}.json"
+    manifest = json.loads(mpath.read_text())
+    manifest["leaves"][0]["crc32"] ^= 0xFFFF  # simulated bit rot
+    mpath.write_text(json.dumps(manifest))
+    _, _, like = _model()
+    with pytest.raises(ckpt.CheckpointCorruptionError, match="crc32"):
+        ckpt.restore_state(tmp_path, latest, like)
+
+
+def test_restored_state_is_donation_safe(tmp_path):
+    """The measured 0.4.37 hazard this module defends: restoring then
+    immediately donating into the jitted advance must NOT read garbage
+    (restore_state returns an XLA-owned copy)."""
+    _, adv, state = _model()
+    ref = _ref(adv, state)
+    ckpt.run_segmented(adv, state, NT // 2, tmp_path, every=EVERY)
+    _, _, like = _model()
+    restored = ckpt.restore_state(tmp_path, NT // 2, like)
+    out = ckpt.run_segmented(adv, restored, NT, tmp_path, every=EVERY,
+                             start_step=NT // 2)
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(ref[0]))
+
+
+def test_mid_run_checkpoints_are_uncorrupted(tmp_path):
+    """Each save completes before the next segment's donating advance
+    reuses the buffer — under the old overlapped design every mid-run
+    checkpoint restored as garbage (measured)."""
+    _, adv, state = _model()
+    mid = _ref(adv, state, 2 * EVERY)
+    ckpt.run_segmented(adv, state, NT, tmp_path, every=EVERY)
+    _, _, like = _model()
+    # 2*EVERY is the oldest KEPT step (max_to_keep=3 prunes the first).
+    assert ckpt.all_steps(tmp_path)[0] == 2 * EVERY
+    first = ckpt.restore_state(tmp_path, 2 * EVERY, like)
+    np.testing.assert_array_equal(np.asarray(first[0]), np.asarray(mid[0]))
+
+
+# ---------------------------------------------------------------------------
+# Supervision: crash recovery, bounded retries, backoff events
+# ---------------------------------------------------------------------------
+
+
+def test_supervised_crash_at_midpoint_bitwise_equals_straight(tmp_path):
+    _, adv, state = _model()
+    ref = _ref(adv, state)
+    faults.install(f"crash@step={NT // 2}")
+    waits = []
+    out = run_supervised(adv, state, NT, tmp_path, EVERY,
+                         sleep=waits.append)
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(ref[0]))
+    assert waits == [0.5]
+    kinds = [e.kind for e in metrics.events()]
+    for k in ("attempt-failed", "backoff", "restored", "recovered"):
+        assert k in kinds, kinds
+    restored = metrics.events("restored")[0]
+    assert restored.step == NT // 2  # latest valid step, not step 0
+
+
+def test_supervised_recovers_past_truncated_checkpoint(tmp_path):
+    """Crash + torn save together: the supervisor must fall back past
+    the truncated latest checkpoint to the previous kept step AND still
+    land bitwise-equal."""
+    _, adv, state = _model()
+    ref = _ref(adv, state)
+    # Crash at the midpoint AND truncate the just-written midpoint save:
+    # exactly what a process dying mid-write leaves behind.
+    faults.install(
+        f"truncate-latest@step={NT // 2};crash@step={NT // 2}"
+    )
+    out = run_supervised(adv, state, NT, tmp_path, EVERY, sleep=lambda _: None)
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(ref[0]))
+    restored = metrics.events("restored")[0]
+    assert restored.step == NT // 2 - EVERY, (
+        "should have fallen back past the truncated midpoint save"
+    )
+
+
+def test_supervised_cold_restart_before_first_checkpoint(tmp_path):
+    """A crash BEFORE any checkpoint exists must still be retryable: the
+    framework's advance donates its state, so the retry cannot reuse the
+    buffers attempt 0 consumed — the supervisor hands each cold start a
+    fresh copy (a deleted-buffer error here would abort supervision as
+    non-retryable exactly when it matters most)."""
+    _, adv, state = _model()
+    ref = _ref(adv, state)
+    flaky = {"fails": 1}
+
+    def adv_flaky_then_ok(s, n):
+        out = adv(s, n)  # donate FIRST, then fail: worst-case ordering
+        if flaky["fails"]:
+            flaky["fails"] -= 1
+            raise RuntimeError("transient backend error (simulated)")
+        return out
+
+    out = run_supervised(adv_flaky_then_ok, state, NT, tmp_path, EVERY,
+                         sleep=lambda _: None)
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(ref[0]))
+    assert metrics.events("backoff"), "the crash must have been retried"
+
+
+def test_supervised_retries_bounded_with_exponential_backoff(tmp_path):
+    calls = []
+
+    def always_fails(state, n):
+        calls.append(n)
+        raise RuntimeError("backend fell over (simulated)")
+
+    waits = []
+    with pytest.raises(RuntimeError, match="fell over"):
+        run_supervised(always_fails, (jnp.zeros((4,)),), 8, tmp_path, 4,
+                       max_retries=3, sleep=waits.append)
+    assert len(calls) == 4  # 1 attempt + 3 retries, then give up
+    assert waits == [0.5, 1.0, 2.0]  # base * factor**attempt
+    assert len(metrics.events("attempt-failed")) == 4
+    assert len(metrics.events("backoff")) == 3
+    assert len(metrics.events("gave-up")) == 1
+
+
+def test_supervised_does_not_retry_programming_errors(tmp_path):
+    def broken(state, n):
+        raise ValueError("bad argument — retrying cannot help")
+
+    with pytest.raises(ValueError):
+        run_supervised(broken, (jnp.zeros((4,)),), 8, tmp_path, 4,
+                       sleep=lambda _: None)
+    assert metrics.events("backoff") == []
+
+
+def test_injected_crash_fires_exactly_once():
+    plan = faults.install("crash@step=5")
+    with pytest.raises(InjectedCrash):
+        faults.fault_point("segment", step=5)
+    # The retry re-runs the same step: the armed clause must NOT re-fire.
+    faults.fault_point("segment", step=5)
+    assert plan.clauses[0].fires == 1
+
+
+def test_fault_spec_parsing_errors():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        faults.FaultPlan.parse("explode@step=3")
+    with pytest.raises(ValueError, match="needs a step"):
+        faults.FaultPlan.parse("crash")
+    with pytest.raises(ValueError, match="unknown fault trigger"):
+        faults.FaultPlan.parse("crash@when=now")
+    plan = faults.FaultPlan.parse("delay=1.5@step=2,rank=1;kill@step=4")
+    assert plan.clauses[0].kind == "delay"
+    assert plan.clauses[0].delay_s == 1.5
+    assert plan.clauses[0].rank == 1
+    assert plan.clauses[1].kind == "kill"
+
+
+# ---------------------------------------------------------------------------
+# Launcher: first-failure reporting, peer grace kill, fault forwarding
+# ---------------------------------------------------------------------------
+
+
+def test_launcher_reports_first_failure_and_kills_hung_peer():
+    results = spawn_ranks(
+        [str(ROOT / "tests" / "resilience_worker.py"), "--hang-after"],
+        nprocs=2,
+        timeout=120,
+        inject_fault="kill@step=3,rank=1",
+        heartbeat_s=1.0,
+        peer_grace_s=3.0,
+    )
+    (p0, (out0, _)), (p1, (out1, _)) = results
+    assert p1.returncode == RC_INJECTED_KILL, (p1.returncode, out1)
+    assert "WORKER_DONE" not in out1
+    report = results.report
+    assert report.first_failure is not None
+    rank, rc, _ = report.first_failure
+    assert (rank, rc) == (1, RC_INJECTED_KILL)
+    # Rank 0 survived its own steps, then hung; the launcher must have
+    # put it down in the grace window, not at the 120 s timeout.
+    assert report.killed_after_failure == [0]
+    assert p0.returncode != 0
+    assert "WORKER_DONE rank=0" in out0
+
+
+def test_launcher_clean_run_reports_nothing():
+    results = spawn_ranks(
+        [str(ROOT / "tests" / "resilience_worker.py")],
+        nprocs=2, timeout=120, peer_grace_s=3.0,
+    )
+    for pid, (p, (out, err)) in enumerate(results):
+        assert p.returncode == 0, (pid, err[-500:])
+    assert results.report.first_failure is None
+    assert results.report.killed_after_failure == []
+
+
+@pytest.mark.slow
+def test_kill_rank_mid_collective_gloo():
+    """The gloo-real drill: rank 1 dies between cross-process
+    collectives; rank 0's next collective can never complete, and the
+    launcher's supervision — not the bare timeout — ends it."""
+    results = spawn_ranks(
+        [str(ROOT / "tests" / "resilience_gloo_worker.py")],
+        nprocs=2,
+        timeout=180,
+        inject_fault="kill@step=4,rank=1",
+        peer_grace_s=10.0,
+    )
+    (p0, (out0, _)), (p1, (out1, _)) = results
+    assert p1.returncode == RC_INJECTED_KILL, (p1.returncode, out1)
+    report = results.report
+    assert report.first_failure is not None and report.first_failure[0] == 1
+    assert p0.returncode != 0, "rank 0 cannot finish without its peer"
+    assert "GLOO_WORKER_DONE" not in out0
+
+
+# ---------------------------------------------------------------------------
+# App wiring: the ladder gets supervision through the shared flags
+# ---------------------------------------------------------------------------
+
+
+def test_app_supervised_crash_recovers_bitwise(tmp_path):
+    import subprocess
+    import sys
+
+    d = tmp_path / "ck"
+    straight = tmp_path / "straight.npy"
+    recovered = tmp_path / "recovered.npy"
+    common = [
+        sys.executable, "apps/diffusion_2d_perf.py", "--cpu-devices", "4",
+        "--nx", "24", "--ny", "24", "--nt", "24", "--warmup", "0",
+    ]
+
+    def run(extra):
+        proc = subprocess.run(
+            common + extra, capture_output=True, text=True, timeout=600,
+            cwd=ROOT,
+        )
+        assert proc.returncode == 0, proc.stderr
+        return proc.stdout
+
+    run(["--save-field", str(straight)])
+    out = run([
+        "--checkpoint", str(d), "--ckpt-every", "6", "--retries", "2",
+        "--inject-fault", "crash@step=12",
+        "--save-field", str(recovered),
+    ])
+    assert "supervisor: restored step 12" in out, out
+    np.testing.assert_array_equal(np.load(recovered), np.load(straight))

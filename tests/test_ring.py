@@ -4,7 +4,7 @@ rocmaware_test_selectdevice.jl capability proof (SURVEY.md §3.5, §4.1)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from rocm_mpi_tpu.utils.compat import shard_map
 from jax.sharding import PartitionSpec
 
 from rocm_mpi_tpu.parallel import init_global_grid
