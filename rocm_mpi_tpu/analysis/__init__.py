@@ -1,0 +1,49 @@
+"""graftlint — AST-based shard-safety static analysis for this repo.
+
+Five rule families, each grounded in a bug class this codebase has
+actually shipped (rule catalog: docs/ANALYSIS.md):
+
+    GL01 donation-safety        read-after-donate / async-save overlap
+    GL02 trace-time-purity      module-global mutation visible to traces
+    GL03 compat-drift           raw jax APIs outside utils/compat+backend
+    GL04 pallas-hygiene         bare refs, skipped f32 upcast, grid/BlockSpec
+    GL05 collective-axis        axis names missing from the mesh
+
+Run the gate:  python -m rocm_mpi_tpu.analysis rocm_mpi_tpu apps bench.py
+Suppress:      # graftlint: disable=GL01   (also disable-next=, disable-file=)
+
+stdlib-only by design: the pinned jax-0.4.37 image runs it with no
+optional deps, and a repo-wide walk stays under the tier-1 5 s budget.
+"""
+
+from rocm_mpi_tpu.analysis.core import (
+    PARSE_RULE,
+    Finding,
+    Rule,
+    all_rules,
+    gate_exit_code,
+    lint_file,
+    lint_paths,
+    lint_source,
+)
+from rocm_mpi_tpu.analysis.report import (
+    counts_by_rule,
+    rule_table,
+    to_json,
+    to_text,
+)
+
+__all__ = [
+    "PARSE_RULE",
+    "Finding",
+    "Rule",
+    "all_rules",
+    "counts_by_rule",
+    "gate_exit_code",
+    "lint_file",
+    "lint_paths",
+    "lint_source",
+    "rule_table",
+    "to_json",
+    "to_text",
+]

@@ -1,0 +1,63 @@
+"""CLI: python -m rocm_mpi_tpu.analysis [paths...] [options].
+
+Exit codes: 0 clean, 1 non-suppressed error-severity findings, 2 usage /
+missing path. Parse failures (GL00) are reported as warnings and never
+fail the gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from rocm_mpi_tpu.analysis import core, report
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m rocm_mpi_tpu.analysis",
+        description="graftlint: AST-based shard-safety analyzer "
+                    "(rule catalog: docs/ANALYSIS.md)",
+    )
+    parser.add_argument("paths", nargs="*", help="files or directories")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the versioned JSON document")
+    parser.add_argument("--select", default=None, metavar="GL01,GL02",
+                        help="run only these rule ids")
+    parser.add_argument("--show-suppressed", action="store_true",
+                        help="include suppressed findings in text output")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in core.all_rules():
+            print(f"{rule.id} {rule.name} [{rule.severity}]")
+            print(f"    {rule.rationale}")
+        return 0
+    if not args.paths:
+        parser.print_usage(sys.stderr)
+        print(
+            "error: no paths given (the repo gate runs: "
+            "python -m rocm_mpi_tpu.analysis rocm_mpi_tpu apps bench.py)",
+            file=sys.stderr,
+        )
+        return 2
+
+    select = args.select.split(",") if args.select else None
+    try:
+        findings, files_scanned = core.lint_paths(args.paths, select=select)
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(report.to_json(findings, files_scanned))
+    else:
+        print(report.to_text(findings, files_scanned,
+                             show_suppressed=args.show_suppressed))
+    return core.gate_exit_code(findings)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

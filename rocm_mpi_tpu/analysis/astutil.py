@@ -1,0 +1,300 @@
+"""Shared AST machinery for graftlint rules (stdlib `ast` only).
+
+Everything here is resolution *heuristics*, deliberately scoped to the
+idioms this codebase actually uses (see docs/ANALYSIS.md "What the
+analyzer can and cannot see"): names are resolved within one module,
+`functools.partial` chains one level deep, and anything unresolvable is
+silently skipped — a lint rule must miss a contrived case rather than
+spray false positives over real code.
+
+No third-party imports (the pinned image must run the gate with nothing
+but the stdlib), and no jax import (the analyzer must run in <5 s on CPU
+as a pre-test gate).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+
+# ---------------------------------------------------------------------------
+# Name / attribute helpers
+# ---------------------------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """'a.b.c' for a Name/Attribute chain, None for anything else."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> str:
+    """Dotted callee name of a call ('' when not a plain name chain)."""
+    return dotted_name(call.func) or ""
+
+
+def tail_name(dotted: str) -> str:
+    """Last component of a dotted name ('jax.jit' -> 'jit')."""
+    return dotted.rpartition(".")[2]
+
+
+def str_const(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def int_const(node: ast.AST) -> int | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    return None
+
+
+def int_tuple(node: ast.AST) -> tuple[int, ...] | None:
+    """Literal int, or tuple/list of literal ints, as a tuple; else None."""
+    n = int_const(node)
+    if n is not None:
+        return (n,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            v = int_const(elt)
+            if v is None:
+                return None
+            out.append(v)
+        return tuple(out)
+    return None
+
+
+def str_args(node: ast.AST) -> list[str]:
+    """String literals in `node` if it is a str constant or a tuple/list
+    of them (the axis-name argument shapes of jax collectives)."""
+    s = str_const(node)
+    if s is not None:
+        return [s]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [s for elt in node.elts if (s := str_const(elt)) is not None]
+    return []
+
+
+# ---------------------------------------------------------------------------
+# Import table
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ImportTable:
+    """What each top-level-bound name refers to.
+
+    module_aliases: local name -> imported module path, for names that are
+      certainly modules (`import x`, `import x.y as z`, and
+      `from pkg import mod` when the source module is a known package
+      prefix we care about).
+    from_imports: local name -> 'module.attr' for `from module import attr`.
+    """
+
+    module_aliases: dict[str, str] = field(default_factory=dict)
+    from_imports: dict[str, str] = field(default_factory=dict)
+
+    def origin(self, local: str) -> str:
+        """Dotted origin of a local name ('' when not import-bound)."""
+        if local in self.module_aliases:
+            return self.module_aliases[local]
+        return self.from_imports.get(local, "")
+
+
+# `from PKG import name` binds a submodule (not a function/class) often
+# enough for these prefixes that graftlint treats the bound name as a
+# module alias for GL02's cross-module-mutation check.
+_MODULE_SOURCE_PREFIXES = (
+    "jax.experimental",
+    "rocm_mpi_tpu",
+)
+
+
+def collect_imports(tree: ast.Module) -> ImportTable:
+    table = ImportTable()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                local = alias.asname or alias.name.partition(".")[0]
+                table.module_aliases[local] = (
+                    alias.name if alias.asname else alias.name.partition(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for alias in node.names:
+                local = alias.asname or alias.name
+                full = f"{node.module}.{alias.name}"
+                table.from_imports[local] = full
+                if node.module.startswith(_MODULE_SOURCE_PREFIXES):
+                    table.module_aliases.setdefault(local, full)
+    return table
+
+
+# ---------------------------------------------------------------------------
+# Function indexing and partial resolution
+# ---------------------------------------------------------------------------
+
+
+def index_functions(tree: ast.Module) -> dict[str, ast.FunctionDef]:
+    """name -> FunctionDef for every def in the module, nested included
+    (last definition wins on collision — a heuristic, documented)."""
+    out: dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[node.name] = node
+    return out
+
+
+def resolve_callable_name(node: ast.AST, assignments: dict[str, ast.AST]) -> str | None:
+    """Resolve an expression to the simple name of the function it wraps.
+
+    Handles: a plain Name (chasing one level of `x = functools.partial(f, …)`
+    / `x = f` assignment in the same module), and a direct
+    `functools.partial(f, …)` call.
+    """
+    for _ in range(4):  # bounded chase
+        if isinstance(node, ast.Name):
+            if node.id in assignments:
+                node = assignments[node.id]
+                continue
+            return node.id
+        if isinstance(node, ast.Call) and tail_name(call_name(node)) == "partial":
+            if node.args:
+                node = node.args[0]
+                continue
+            return None
+        return None
+    return None
+
+
+def collect_assignments(tree: ast.Module) -> dict[str, ast.AST]:
+    """name -> RHS expression for simple single-target assignments anywhere
+    in the module (used only to chase partial/alias chains)."""
+    out: dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            out[node.targets[0].id] = node.value
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Traced-body discovery (jit / shard_map / pallas kernels)
+# ---------------------------------------------------------------------------
+
+_JIT_NAMES = frozenset({"jit", "pjit"})
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """True for expressions that produce a jitted transform: `jax.jit`,
+    `jit`, `pjit`, `jax.jit(...)`, `functools.partial(jax.jit, ...)`."""
+    name = dotted_name(node)
+    if name is not None:
+        return tail_name(name) in _JIT_NAMES
+    if isinstance(node, ast.Call):
+        cname = tail_name(call_name(node))
+        if cname in _JIT_NAMES:
+            return True
+        if cname == "partial" and node.args:
+            return _is_jit_expr(node.args[0])
+    return False
+
+
+def jit_decorators(fn: ast.FunctionDef) -> list[ast.AST]:
+    return [d for d in fn.decorator_list if _is_jit_expr(d)]
+
+
+@dataclass
+class TracedBody:
+    fn: ast.FunctionDef
+    kind: str  # "jit" | "shard_map" | "pallas"
+    call: ast.Call | None = None  # the wrapping call, when discovered via one
+
+
+def traced_bodies(tree: ast.Module) -> list[TracedBody]:
+    """Functions whose bodies run at trace time under jit / shard_map /
+    pallas_call — by decorator, or by being passed (by name, possibly
+    through a `functools.partial`) into such a call in this module.
+    Nested defs inside a traced body are traced too.
+    """
+    functions = index_functions(tree)
+    assignments = collect_assignments(tree)
+    found: dict[ast.FunctionDef, TracedBody] = {}
+
+    for name, fn in functions.items():
+        if jit_decorators(fn):
+            found[fn] = TracedBody(fn, "jit")
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = tail_name(call_name(node))
+        if callee in ("shard_map", "pallas_call") or callee in _JIT_NAMES:
+            kind = {"shard_map": "shard_map", "pallas_call": "pallas"}.get(
+                callee, "jit"
+            )
+            if not node.args:
+                continue
+            target = resolve_callable_name(node.args[0], assignments)
+            fn = functions.get(target) if target else None
+            if fn is not None and fn not in found:
+                found[fn] = TracedBody(fn, kind, node)
+
+    # Close over nested defs: anything defined inside a traced body traces.
+    out = dict(found)
+    for body in list(found.values()):
+        for node in ast.walk(body.fn):
+            if isinstance(node, ast.FunctionDef) and node is not body.fn \
+                    and node not in out:
+                out[node] = TracedBody(node, body.kind)
+    return list(out.values())
+
+
+def pallas_kernel_functions(tree: ast.Module) -> list[tuple[ast.FunctionDef, ast.Call]]:
+    """(kernel FunctionDef, pallas_call Call) pairs resolvable in-module."""
+    functions = index_functions(tree)
+    assignments = collect_assignments(tree)
+    out = []
+    seen = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if tail_name(call_name(node)) != "pallas_call" or not node.args:
+            continue
+        target = resolve_callable_name(node.args[0], assignments)
+        fn = functions.get(target) if target else None
+        if fn is not None and fn.name not in seen:
+            seen.add(fn.name)
+            out.append((fn, node))
+    return out
+
+
+def call_kwarg(call: ast.Call, name: str) -> ast.AST | None:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def walk_no_nested_functions(node: ast.AST):
+    """ast.walk that does not descend into nested FunctionDef/Lambda."""
+    stack = [node]
+    first = True
+    while stack:
+        cur = stack.pop()
+        if not first and isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        first = False
+        yield cur
+        stack.extend(ast.iter_child_nodes(cur))

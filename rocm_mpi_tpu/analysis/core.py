@@ -1,0 +1,278 @@
+"""graftlint core: findings, suppressions, the rule registry, file walking.
+
+The analyzer is a pre-test gate (scripts/lint.sh, tests/test_self_lint.py)
+so the whole pipeline is stdlib-only and cached: one `ast.parse` per
+(path, mtime, size), rules share the parsed tree, and a repo-wide run
+stays well under the 5 s budget the tier-1 wiring assumes.
+
+Suppressions (all take a comma-separated rule list or `all`):
+
+    x = risky()          # graftlint: disable=GL01
+    # graftlint: disable-next=GL02,GL03
+    x = risky()
+    # graftlint: disable-file=GL05      (anywhere in the file)
+
+Suppressed findings are still produced (marked ``suppressed=True``) so
+reporters can show them; only non-suppressed findings gate the exit code.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from pathlib import Path
+
+PARSE_RULE = "GL00"  # pseudo-rule for unparseable-file warnings
+
+
+@dataclasses.dataclass
+class Finding:
+    file: str
+    line: int
+    col: int
+    rule: str
+    severity: str  # "error" | "warning"
+    message: str
+    hint: str = ""
+    suppressed: bool = False
+
+    def location(self) -> str:
+        return f"{self.file}:{self.line}:{self.col}"
+
+
+@dataclasses.dataclass
+class ModuleContext:
+    """Everything a rule gets to look at for one file."""
+
+    path: str  # as given / repo-relative for reporting
+    posix_path: str  # normalized forward-slash form for allowlists
+    source: str
+    tree: ast.Module
+
+    def finding(self, node: ast.AST, rule, message: str, hint: str = "",
+                severity: str | None = None) -> Finding:
+        return Finding(
+            file=self.path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0) + 1,
+            rule=rule.id,
+            severity=severity or rule.severity,
+            message=message,
+            hint=hint or rule.hint,
+        )
+
+
+class Rule:
+    """One rule family. Subclasses set id/name/severity/hint and implement
+    check(ctx) -> iterable[Finding]."""
+
+    id: str = "GL??"
+    name: str = ""
+    severity: str = "error"
+    hint: str = ""
+    # One-line rationale shown by --list-rules and the docs generator.
+    rationale: str = ""
+
+    def check(self, ctx: ModuleContext):  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+def all_rules() -> list[Rule]:
+    """The registered rule families, GL-id order."""
+    from rocm_mpi_tpu.analysis.rules_collective import AxisConsistencyRule
+    from rocm_mpi_tpu.analysis.rules_compat import CompatDriftRule
+    from rocm_mpi_tpu.analysis.rules_donation import DonationSafetyRule
+    from rocm_mpi_tpu.analysis.rules_pallas import PallasHygieneRule
+    from rocm_mpi_tpu.analysis.rules_purity import TraceTimePurityRule
+
+    return [
+        DonationSafetyRule(),
+        TraceTimePurityRule(),
+        CompatDriftRule(),
+        PallasHygieneRule(),
+        AxisConsistencyRule(),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Suppressions
+# ---------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*(disable(?:-next|-file)?)\s*=\s*([A-Za-z0-9_,\s]+)"
+)
+
+
+@dataclasses.dataclass
+class Suppressions:
+    by_line: dict[int, set[str]]
+    file_wide: set[str]
+
+    def covers(self, finding: Finding) -> bool:
+        rules = self.by_line.get(finding.line, set()) | self.file_wide
+        return "ALL" in rules or finding.rule in rules
+
+
+def _comment_tokens(source: str):
+    """(lineno, text) of real COMMENT tokens only — a docstring that merely
+    *documents* a directive must not install one. On tokenize failure
+    (rare for ast-parseable source) no suppressions apply: the safe
+    direction is findings staying live, never silently vanishing."""
+    try:
+        return [
+            (tok.start[0], tok.string)
+            for tok in tokenize.generate_tokens(io.StringIO(source).readline)
+            if tok.type == tokenize.COMMENT
+        ]
+    except (tokenize.TokenError, IndentationError, SyntaxError, ValueError):
+        return []
+
+
+def parse_suppressions(source: str) -> Suppressions:
+    by_line: dict[int, set[str]] = {}
+    file_wide: set[str] = set()
+    for lineno, comment in _comment_tokens(source):
+        m = _SUPPRESS_RE.search(comment)
+        if not m:
+            continue
+        directive = m.group(1)
+        rules = {r.strip().upper() for r in m.group(2).split(",") if r.strip()}
+        if directive == "disable-file":
+            file_wide |= rules
+        elif directive == "disable-next":
+            by_line.setdefault(lineno + 1, set()).update(rules)
+        else:
+            by_line.setdefault(lineno, set()).update(rules)
+    return Suppressions(by_line=by_line, file_wide=file_wide)
+
+
+# ---------------------------------------------------------------------------
+# Linting
+# ---------------------------------------------------------------------------
+
+
+def _selected(rules: list[Rule], select) -> list[Rule]:
+    if not select:
+        return rules
+    wanted = {s.strip().upper() for s in select}
+    return [r for r in rules if r.id in wanted]
+
+
+def lint_source(source: str, path: str = "<string>", select=None,
+                rules: list[Rule] | None = None) -> list[Finding]:
+    """Lint one source string. Unparseable source yields a single GL00
+    warning instead of raising — the gate must never crash on an input."""
+    rules = _selected(rules if rules is not None else all_rules(), select)
+    # Normalized absolute form so the chokepoint allowlists (GL03) match
+    # regardless of cwd, `..` segments, or how the gate spelled the path.
+    posix = Path(os.path.normpath(os.path.abspath(path))).as_posix()
+    try:
+        tree = ast.parse(source, filename=path)
+    except (SyntaxError, ValueError, RecursionError) as e:
+        return [
+            Finding(
+                file=path,
+                line=getattr(e, "lineno", 1) or 1,
+                col=(getattr(e, "offset", 1) or 1),
+                rule=PARSE_RULE,
+                severity="warning",
+                message=f"could not parse file ({type(e).__name__}: {e}); "
+                        "skipped",
+                hint="graftlint gates only what it can parse — fix the "
+                     "syntax error to restore coverage",
+            )
+        ]
+    ctx = ModuleContext(path=path, posix_path=posix, source=source, tree=tree)
+    suppressions = parse_suppressions(source)
+    findings: list[Finding] = []
+    for rule in rules:
+        for f in rule.check(ctx):
+            f.suppressed = suppressions.covers(f)
+            findings.append(f)
+    findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
+    return findings
+
+
+# (path, mtime_ns, size) -> findings; makes the repo-wide tier-1 run a
+# near-no-op when invoked twice in one process (tests + gate).
+_CACHE: dict[tuple[str, int, int], list[Finding]] = {}
+
+
+def lint_file(path: Path, select=None, rules=None,
+              display_path: str | None = None) -> list[Finding]:
+    try:
+        stat = path.stat()
+        key = (str(path), display_path, stat.st_mtime_ns, stat.st_size)
+    except OSError:
+        key = None
+    if key is not None and select is None and rules is None and key in _CACHE:
+        # deep-ish copies: a caller mutating a Finding (reporters toggling
+        # flags) must not poison later cache hits
+        return [dataclasses.replace(f) for f in _CACHE[key]]
+    try:
+        source = path.read_text(encoding="utf-8", errors="replace")
+    except OSError as e:
+        return [
+            Finding(
+                file=display_path or str(path), line=1, col=1,
+                rule=PARSE_RULE, severity="warning",
+                message=f"could not read file ({e}); skipped",
+            )
+        ]
+    findings = lint_source(
+        source, display_path or str(path), select=select, rules=rules
+    )
+    if key is not None and select is None and rules is None:
+        _CACHE[key] = [dataclasses.replace(f) for f in findings]
+    return findings
+
+
+_SKIP_DIRS = {
+    ".git", "__pycache__", ".jax_cache", "node_modules", ".venv", "venv",
+    "analysis_fixtures",
+}
+
+
+def iter_python_files(paths) -> list[Path]:
+    out: list[Path] = []
+    for raw in paths:
+        p = Path(raw)
+        if p.is_dir():
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(
+                    d for d in dirnames if d not in _SKIP_DIRS
+                )
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(Path(dirpath) / fn)
+        elif p.suffix == ".py":
+            out.append(p)
+    return out
+
+
+def lint_paths(paths, select=None) -> tuple[list[Finding], int]:
+    """Lint files/dirs. Returns (findings, files_scanned). Nonexistent
+    paths raise FileNotFoundError (a mistyped gate path must fail loudly,
+    not silently lint nothing)."""
+    for raw in paths:
+        if not Path(raw).exists():
+            raise FileNotFoundError(f"lint path does not exist: {raw}")
+    files = iter_python_files(paths)
+    findings: list[Finding] = []
+    for f in files:
+        findings.extend(lint_file(f, select=select))
+    return findings, len(files)
+
+
+def gate_exit_code(findings) -> int:
+    """0 when no non-suppressed error-severity finding remains, else 1.
+    Parse warnings (GL00) never fail the gate — a broken file is reported
+    but must not wedge CI on code the analyzer cannot see anyway."""
+    for f in findings:
+        if not f.suppressed and f.severity == "error":
+            return 1
+    return 0

@@ -1,0 +1,94 @@
+"""graftlint reporters: human text (with a per-rule findings table) and a
+versioned JSON document for tooling (tests/test_analysis_rules.py pins the
+schema).
+
+JSON schema (version 1):
+
+    {"version": 1,
+     "files_scanned": int,
+     "counts": {"GL01": int, ...},          # non-suppressed, per rule
+     "suppressed": int,
+     "findings": [{"file": str, "line": int, "col": int, "rule": str,
+                   "severity": "error"|"warning", "message": str,
+                   "hint": str, "suppressed": bool}, ...]}
+"""
+
+from __future__ import annotations
+
+import json
+
+from rocm_mpi_tpu.analysis.core import PARSE_RULE, Finding, all_rules
+
+
+def counts_by_rule(findings) -> dict[str, int]:
+    """Non-suppressed finding count per registered rule id (zero rows
+    included so a regression report always names every rule)."""
+    counts = {r.id: 0 for r in all_rules()}
+    counts[PARSE_RULE] = 0
+    for f in findings:
+        if not f.suppressed:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+    return counts
+
+
+def to_json(findings, files_scanned: int) -> str:
+    doc = {
+        "version": 1,
+        "files_scanned": files_scanned,
+        "counts": counts_by_rule(findings),
+        "suppressed": sum(1 for f in findings if f.suppressed),
+        "findings": [
+            {
+                "file": f.file,
+                "line": f.line,
+                "col": f.col,
+                "rule": f.rule,
+                "severity": f.severity,
+                "message": f.message,
+                "hint": f.hint,
+                "suppressed": f.suppressed,
+            }
+            for f in findings
+        ],
+    }
+    return json.dumps(doc, indent=1)
+
+
+def rule_table(findings) -> str:
+    """The per-rule findings table (printed by the self-lint test so a
+    regression names the rule that fired)."""
+    counts = counts_by_rule(findings)
+    names = {r.id: r.name for r in all_rules()}
+    names[PARSE_RULE] = "parse-warning"
+    width = max(len(n) for n in names.values()) + 2
+    lines = ["rule   " + "name".ljust(width) + "findings"]
+    for rule_id in sorted(counts):
+        lines.append(
+            f"{rule_id:6s} {names.get(rule_id, '?').ljust(width)}"
+            f"{counts[rule_id]}"
+        )
+    return "\n".join(lines)
+
+
+def format_finding(f: Finding) -> str:
+    tag = " [suppressed]" if f.suppressed else ""
+    hint = f"\n    hint: {f.hint}" if f.hint else ""
+    return (
+        f"{f.location()}: {f.rule} {f.severity}{tag}: {f.message}{hint}"
+    )
+
+
+def to_text(findings, files_scanned: int, show_suppressed: bool = False) -> str:
+    shown = [f for f in findings if show_suppressed or not f.suppressed]
+    lines = [format_finding(f) for f in shown]
+    active = [f for f in findings if not f.suppressed]
+    n_sup = sum(1 for f in findings if f.suppressed)
+    summary = (
+        f"graftlint: {files_scanned} file(s), {len(active)} finding(s)"
+        + (f", {n_sup} suppressed" if n_sup else "")
+    )
+    if active:
+        lines.append("")
+        lines.append(rule_table(findings))
+    lines.append(summary)
+    return "\n".join(lines)

@@ -1,0 +1,336 @@
+"""GL01 — donation safety.
+
+The PR-1 race class, measured on the pinned jax-0.4.37 stack (see
+utils/checkpoint.py's module docstring): a buffer donated into a jitted
+call is reused by XLA, so any later read of the donated name sees garbage;
+and an orbax save is asynchronous, so rebinding the saved state (the
+donating advance reusing its buffer) while the save is still in flight
+corrupts every mid-run checkpoint.
+
+Two statically-checkable patterns, one rule id:
+
+* **donated-reread** — a name is passed at a donated position of a call to
+  a `donate_argnums`/`donate_argnames` jitted callable (resolved within
+  the module: decorated defs and `f = jax.jit(g, donate_argnums=…)`
+  assignments) and then *read* again in the same scope before being
+  rebound.
+* **save-overlap** — a name captured by an orbax CheckpointManager
+  `.save(...)` is *rebound* (i.e. its old buffer handed back to a donating
+  advance) before `.wait_until_finished()` / `.close()` on the same
+  manager. Managers are recognized by assignment from a call whose name
+  contains "manager" (`_manager(...)`, `CheckpointManager(...)`).
+
+Both are flow-sensitive over a small abstract state (poisoned names +
+in-flight saves); branches merge by union, loop bodies run twice so the
+back edge is observed (the `while step < nt:` save/advance overlap is
+exactly a back-edge bug).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from rocm_mpi_tpu.analysis import astutil
+from rocm_mpi_tpu.analysis.core import ModuleContext, Rule
+
+
+def _donated_positions(call: ast.Call):
+    """(argnums, argnames) declared on a jit call expression, or None."""
+    nums: tuple[int, ...] = ()
+    names: tuple[str, ...] = ()
+    found = False
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            got = astutil.int_tuple(kw.value)
+            if got:
+                nums, found = got, True
+        elif kw.arg == "donate_argnames":
+            got = astutil.str_args(kw.value)
+            if got:
+                names, found = tuple(got), True
+    return (nums, names) if found else None
+
+
+def _jit_call_donations(expr: ast.AST):
+    """Donation spec from `jax.jit(...)` / `functools.partial(jax.jit, ...)`
+    expressions (decorators or RHS of assignments)."""
+    if not isinstance(expr, ast.Call):
+        return None
+    callee = astutil.tail_name(astutil.call_name(expr))
+    if callee in ("jit", "pjit"):
+        return _donated_positions(expr)
+    if callee == "partial" and expr.args:
+        inner = astutil.dotted_name(expr.args[0])
+        if inner and astutil.tail_name(inner) in ("jit", "pjit"):
+            return _donated_positions(expr)
+    return None
+
+
+def _collect_donating_callables(tree: ast.Module) -> dict:
+    """local callable name -> (argnums, argnames)."""
+    out: dict[str, tuple] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                spec = _jit_call_donations(dec)
+                if spec:
+                    out[node.name] = spec
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            spec = _jit_call_donations(node.value)
+            if spec:
+                out[node.targets[0].id] = spec
+    return out
+
+
+def _is_manager_ctor(expr: ast.AST) -> bool:
+    if not isinstance(expr, ast.Call):
+        return False
+    return "manager" in astutil.tail_name(astutil.call_name(expr)).lower()
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    return {
+        n.id
+        for n in ast.walk(node)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+    }
+
+
+class _State:
+    __slots__ = ("poisoned", "inflight")
+
+    def __init__(self):
+        self.poisoned: dict[str, ast.AST] = {}  # name -> donating call node
+        self.inflight: dict[str, dict[str, ast.AST]] = {}  # mgr -> {name: save}
+
+    def copy(self) -> "_State":
+        s = _State()
+        s.poisoned = dict(self.poisoned)
+        s.inflight = {k: dict(v) for k, v in self.inflight.items()}
+        return s
+
+    def merge(self, other: "_State") -> None:
+        self.poisoned.update(other.poisoned)
+        for mgr, names in other.inflight.items():
+            self.inflight.setdefault(mgr, {}).update(names)
+
+
+class _FunctionChecker:
+    def __init__(self, rule, ctx: ModuleContext, donating: dict):
+        self.rule = rule
+        self.ctx = ctx
+        self.donating = donating
+        self.managers: set[str] = set()
+        self.findings: list = []
+        self._reported: set[tuple] = set()
+
+    # ---- expression traversal (evaluation order, approximately) --------
+
+    def expr(self, node: ast.AST, state: _State) -> None:
+        """Visit an expression: check loads of poisoned names, apply
+        donation / save / wait effects of calls."""
+        if node is None:
+            return
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            if node.id in state.poisoned:
+                don = state.poisoned[node.id]
+                self._report(
+                    node,
+                    f"'{node.id}' is read after being donated to the jitted "
+                    f"call on line {don.lineno}; donated buffers are reused "
+                    "by XLA and may hold garbage",
+                    "rebind the name from the call's result (x = f(x, ...)) "
+                    "or drop donate_argnums for values read afterwards",
+                )
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        if isinstance(node, ast.Call):
+            self._call(node, state)
+            return
+        for child in ast.iter_child_nodes(node):
+            self.expr(child, state)
+
+    def _call(self, call: ast.Call, state: _State) -> None:
+        # The callee and arguments evaluate first — reading the name *in*
+        # the donating call is the donation itself, not a re-read (but a
+        # method call on a donated array, e.g. x.block_until_ready(), IS
+        # a re-read and gets caught by the func traversal).
+        for child in ast.iter_child_nodes(call.func):
+            self.expr(child, state)
+        for arg in call.args:
+            self.expr(arg, state)
+        for kw in call.keywords:
+            self.expr(kw.value, state)
+
+        # Donation effect.
+        if isinstance(call.func, ast.Name) and call.func.id in self.donating:
+            nums, names = self.donating[call.func.id]
+            for i in nums:
+                if i < len(call.args) and isinstance(call.args[i], ast.Name):
+                    state.poisoned[call.args[i].id] = call
+            for kw in call.keywords:
+                if kw.arg in names and isinstance(kw.value, ast.Name):
+                    state.poisoned[kw.value.id] = call
+        # Async-save bookkeeping on recognized checkpoint managers.
+        if isinstance(call.func, ast.Attribute) and \
+                isinstance(call.func.value, ast.Name):
+            recv = call.func.value.id
+            if recv in self.managers:
+                if call.func.attr == "save":
+                    # arg 0 is the step LABEL (a host int), not a buffer
+                    names = set()
+                    for arg in call.args[1:]:
+                        names |= _names_in(arg)
+                    for kw in call.keywords:
+                        names |= _names_in(kw.value)
+                    state.inflight.setdefault(recv, {}).update(
+                        {n: call for n in names}
+                    )
+                elif call.func.attr in ("wait_until_finished", "close"):
+                    state.inflight.pop(recv, None)
+
+    # ---- statement traversal ------------------------------------------
+
+    def stmts(self, body, state: _State) -> None:
+        for stmt in body:
+            self.stmt(stmt, state)
+
+    def stmt(self, node: ast.stmt, state: _State) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # separate runtime scope
+        if isinstance(node, ast.Assign):
+            self.expr(node.value, state)
+            if _is_manager_ctor(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.managers.add(t.id)
+            for t in node.targets:
+                self._store_target(t, state)
+        elif isinstance(node, ast.AugAssign):
+            self.expr(node.value, state)
+            if isinstance(node.target, ast.Name):
+                # aug-assign reads the old value too
+                if node.target.id in state.poisoned:
+                    self.expr(
+                        ast.copy_location(
+                            ast.Name(id=node.target.id, ctx=ast.Load()),
+                            node.target,
+                        ),
+                        state,
+                    )
+                self._store_name(node.target, state)
+        elif isinstance(node, ast.AnnAssign):
+            if node.value is not None:
+                self.expr(node.value, state)
+                if isinstance(node.target, ast.Name):
+                    self._store_name(node.target, state)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    state.poisoned.pop(t.id, None)
+        elif isinstance(node, (ast.If,)):
+            self.expr(node.test, state)
+            a = state.copy()
+            self.stmts(node.body, a)
+            b = state.copy()
+            self.stmts(node.orelse, b)
+            state.poisoned = {}
+            state.inflight = {}
+            state.merge(a)
+            state.merge(b)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            self.expr(node.iter, state)
+            for _ in range(2):  # second pass observes the back edge
+                self._store_target(node.target, state)
+                self.stmts(node.body, state)
+            self.stmts(node.orelse, state)
+        elif isinstance(node, ast.While):
+            for _ in range(2):
+                self.expr(node.test, state)
+                self.stmts(node.body, state)
+            self.expr(node.test, state)
+            self.stmts(node.orelse, state)
+        elif isinstance(node, ast.Try):
+            self.stmts(node.body, state)
+            for handler in node.handlers:
+                h = state.copy()
+                self.stmts(handler.body, h)
+                state.merge(h)
+            self.stmts(node.orelse, state)
+            self.stmts(node.finalbody, state)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                self.expr(item.context_expr, state)
+                if item.optional_vars is not None:
+                    self._store_target(item.optional_vars, state)
+            self.stmts(node.body, state)
+        elif isinstance(node, ast.Return):
+            self.expr(node.value, state)
+        elif isinstance(node, ast.Expr):
+            self.expr(node.value, state)
+        else:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr):
+                    self.expr(child, state)
+
+    def _store_target(self, target: ast.AST, state: _State) -> None:
+        if isinstance(target, ast.Name):
+            self._store_name(target, state)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._store_target(elt, state)
+        elif isinstance(target, (ast.Subscript, ast.Attribute)):
+            self.expr(target.value, state)
+
+    def _store_name(self, target: ast.Name, state: _State) -> None:
+        state.poisoned.pop(target.id, None)
+        for mgr, names in state.inflight.items():
+            if target.id in names:
+                save = names[target.id]
+                self._report(
+                    target,
+                    f"'{target.id}' is rebound while the async save on line "
+                    f"{save.lineno} may still be reading its buffer (the "
+                    "donating advance reuses it) — every mid-run checkpoint "
+                    "of the old overlapped design was measured corrupt",
+                    f"call {mgr}.wait_until_finished() after the save and "
+                    "before advancing the state again",
+                )
+
+    def _report(self, node, message, hint) -> None:
+        key = (node.lineno, node.col_offset, message)
+        if key in self._reported:
+            return
+        self._reported.add(key)
+        self.findings.append(self.ctx.finding(node, self.rule, message, hint))
+
+
+class DonationSafetyRule(Rule):
+    id = "GL01"
+    name = "donation-safety"
+    severity = "error"
+    rationale = (
+        "donated buffers are reused by XLA; reading one after the donating "
+        "call — or letting an async orbax save race the donating advance — "
+        "silently yields garbage (both measured in PR 1)"
+    )
+    hint = "see docs/ANALYSIS.md#gl01"
+
+    def check(self, ctx: ModuleContext):
+        donating = _collect_donating_callables(ctx.tree)
+        scopes: list = [ctx.tree]
+        scopes += [
+            n for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        findings = []
+        for scope in scopes:
+            checker = _FunctionChecker(self, ctx, donating)
+            body = scope.body
+            checker.stmts(body, _State())
+            findings.extend(checker.findings)
+        return findings

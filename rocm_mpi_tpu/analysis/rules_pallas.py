@@ -1,0 +1,327 @@
+"""GL04 — Pallas kernel hygiene.
+
+Grounded in this repo's kernel conventions (ops/pallas_kernels.py,
+ops/wave_kernels.py, ops/swe_kernels.py) and the pallas_guide.md rules
+they encode:
+
+* **raw-ref use** — a Ref parameter (named ``*_ref`` by repo convention)
+  used bare: passed to a jnp/host op or combined in arithmetic without
+  ``ref[...]`` indexing or ``pl.load``/``pl.store``. Refs are memory
+  handles, not arrays; host ops on them are undefined under Mosaic.
+* **raw-precision arithmetic** — arithmetic on values loaded from refs
+  without first routing through the f32 upcast chokepoint
+  (``_upcast_for_compute`` / ``.astype``). bf16 is STORAGE-ONLY in this
+  kernel family (r4, measured: per-step bf16 rounding froze the 252²
+  trajectory); every kernel must upcast before computing.
+* **index_map arity** — a BlockSpec index_map lambda whose parameter count
+  differs from the pallas_call's literal grid rank (each grid axis feeds
+  one index argument; a mismatch is a TypeError at trace time on TPU but
+  silently untested on CPU paths that never take the compiled branch).
+* **grid under-coverage** — with fully literal grid/block/out shapes,
+  grid[i] * block[i] < shape[i] leaves cells unwritten.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from rocm_mpi_tpu.analysis import astutil
+from rocm_mpi_tpu.analysis.core import ModuleContext, Rule
+
+# Attribute reads that are fine on a bare ref (metadata, not data).
+_REF_META_ATTRS = {"shape", "dtype", "ndim", "at", "size"}
+# Callees that legitimately take a bare ref argument (pl.* memory ops;
+# jnp helpers like zeros_like must take ref[...] loads, not bare refs).
+_REF_OK_CALLEES = {"load", "store", "swap", "dslice", "ds"}
+# Callees that launder taint (explicit precision control).
+_UNTAINT_CALLEES = {"_upcast_for_compute", "astype"}
+_ARITH_OPS = (ast.Add, ast.Sub, ast.Mult, ast.Div, ast.FloorDiv, ast.Mod,
+              ast.Pow, ast.MatMult)
+
+
+def _ref_params(fn: ast.FunctionDef) -> set[str]:
+    return {
+        a.arg for a in fn.args.args + fn.args.posonlyargs
+        if a.arg.endswith("_ref")
+    }
+
+
+class _KernelChecker:
+    def __init__(self, rule, ctx, fn, module_has_upcast: bool):
+        self.rule = rule
+        self.ctx = ctx
+        self.fn = fn
+        self.refs = _ref_params(fn)
+        self.module_has_upcast = module_has_upcast
+        self.tainted: set[str] = set()
+        self.findings: list = []
+
+    def run(self):
+        if not self.refs:
+            return []
+        for node in astutil.walk_no_nested_functions(self.fn):
+            if isinstance(node, ast.Name) and node.id in self.refs and \
+                    isinstance(node.ctx, ast.Load):
+                if not self._ref_use_ok(node):
+                    self.findings.append(self.ctx.finding(
+                        node, self.rule,
+                        f"Ref '{node.id}' used bare in kernel "
+                        f"'{self.fn.name}' — refs are memory handles; "
+                        "host/jnp ops on them are undefined under Mosaic",
+                        "read with ref[...] / pl.load and write with "
+                        "ref[...] = / pl.store",
+                    ))
+        self._check_precision()
+        return self.findings
+
+    def _parent_map(self):
+        parents = {}
+        for node in astutil.walk_no_nested_functions(self.fn):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        return parents
+
+    def _ref_use_ok(self, name: ast.Name) -> bool:
+        parents = getattr(self, "_parents", None)
+        if parents is None:
+            parents = self._parents = self._parent_map()
+        parent = parents.get(name)
+        if isinstance(parent, ast.Subscript) and parent.value is name:
+            return True
+        if isinstance(parent, ast.Attribute) and parent.value is name:
+            return parent.attr in _REF_META_ATTRS
+        if isinstance(parent, ast.Call):
+            callee = astutil.tail_name(astutil.call_name(parent))
+            if callee in _REF_OK_CALLEES:
+                return True
+        return False
+
+    # ---- storage-only-bf16 taint check ---------------------------------
+
+    def _is_ref_load(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Subscript) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id in self.refs:
+            return True
+        call = node if isinstance(node, ast.Call) else None
+        if call and astutil.tail_name(astutil.call_name(call)) == "load":
+            return any(
+                isinstance(a, ast.Name) and a.id in self.refs
+                for a in call.args
+            )
+        return False
+
+    def _taint_of(self, node: ast.AST) -> bool:
+        """Does evaluating `node` carry raw (never-upcast) ref data?"""
+        if self._is_ref_load(node):
+            return True
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Call):
+            callee = astutil.tail_name(astutil.call_name(node))
+            if callee in _UNTAINT_CALLEES:
+                return False
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            return any(self._taint_of(a) for a in args)
+        if isinstance(node, ast.Attribute):
+            if node.attr == "astype":
+                return False
+            return self._taint_of(node.value)
+        if isinstance(node, (ast.Subscript, ast.Starred, ast.UnaryOp)):
+            inner = node.value if not isinstance(node, ast.UnaryOp) \
+                else node.operand
+            return self._taint_of(inner)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self._taint_of(e) for e in node.elts)
+        if isinstance(node, ast.BinOp):
+            return self._taint_of(node.left) or self._taint_of(node.right)
+        if isinstance(node, ast.IfExp):
+            return self._taint_of(node.body) or self._taint_of(node.orelse)
+        return False
+
+    def _stmts_in_order(self, body):
+        """Statements in source order, compound bodies inline, nested
+        function defs skipped (separate scope)."""
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            yield stmt
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr, None)
+                if isinstance(sub, list):
+                    yield from self._stmts_in_order(
+                        [s for s in sub if isinstance(s, ast.stmt)]
+                    )
+            for handler in getattr(stmt, "handlers", []):
+                yield from self._stmts_in_order(handler.body)
+
+    def _expr_roots(self, stmt: ast.stmt):
+        """The expressions a statement evaluates itself (compound bodies
+        are separate statements and excluded)."""
+        if isinstance(stmt, ast.Assign):
+            return [stmt.value]
+        if isinstance(stmt, ast.AugAssign):
+            return [stmt.value, stmt.target]
+        if isinstance(stmt, ast.AnnAssign):
+            return [stmt.value] if stmt.value is not None else []
+        if isinstance(stmt, (ast.Expr, ast.Return)):
+            return [stmt.value] if stmt.value is not None else []
+        if isinstance(stmt, (ast.If, ast.While)):
+            return [stmt.test]
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            return [stmt.iter]
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return [i.context_expr for i in stmt.items]
+        return [c for c in ast.iter_child_nodes(stmt)
+                if isinstance(c, ast.expr)]
+
+    def _check_precision(self):
+        # Only meaningful in modules that follow the upcast convention at
+        # all — a module with no _upcast_for_compute/astype anywhere is a
+        # plain-f32 experiment and gets a pass (documented heuristic;
+        # probed once per module by PallasHygieneRule.check).
+        if not self.module_has_upcast:
+            return
+        reported = set()
+        for stmt in self._stmts_in_order(self.fn.body):
+            # Check arithmetic against the CURRENT taint state first …
+            for root in self._expr_roots(stmt):
+                for node in astutil.walk_no_nested_functions(root):
+                    if not (isinstance(node, ast.BinOp) and
+                            isinstance(node.op, _ARITH_OPS)):
+                        continue
+                    if not (self._taint_of(node.left) or
+                            self._taint_of(node.right)):
+                        continue
+                    key = (node.lineno, node.col_offset)
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    self.findings.append(self.ctx.finding(
+                        node, self.rule,
+                        f"arithmetic on raw ref data in kernel "
+                        f"'{self.fn.name}' without the f32 upcast — bf16 "
+                        "is storage-only in this kernel family (per-step "
+                        "bf16 rounding measurably froze the 252² "
+                        "trajectory, r4)",
+                        "route operands through _upcast_for_compute (or "
+                        ".astype(jnp.float32)) before computing, and "
+                        ".astype(out_ref.dtype) once at the store",
+                    ))
+            # … then apply the statement's taint effects.
+            if isinstance(stmt, ast.Assign):
+                tainted = self._taint_of(stmt.value)
+                for t in stmt.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            if tainted:
+                                self.tainted.add(n.id)
+                            else:
+                                self.tainted.discard(n.id)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                tainted = self._taint_of(stmt.iter)
+                for n in ast.walk(stmt.target):
+                    if isinstance(n, ast.Name) and tainted:
+                        self.tainted.add(n.id)
+
+
+class PallasHygieneRule(Rule):
+    id = "GL04"
+    name = "pallas-hygiene"
+    severity = "error"
+    rationale = (
+        "hand-written kernels are where correctness quietly dies "
+        "(HipKittens, arXiv:2511.08083): bare-Ref host ops, skipped f32 "
+        "upcasts, and grid/BlockSpec mismatches all pass CPU tests and "
+        "fail (or silently corrupt) on the chip"
+    )
+    hint = "see docs/ANALYSIS.md#gl04"
+
+    def check(self, ctx: ModuleContext):
+        findings = []
+        module_has_upcast = any(
+            astutil.tail_name(astutil.call_name(n)) in _UNTAINT_CALLEES
+            for n in ast.walk(ctx.tree)
+            if isinstance(n, ast.Call)
+        )
+        for fn, _call in astutil.pallas_kernel_functions(ctx.tree):
+            findings.extend(
+                _KernelChecker(self, ctx, fn, module_has_upcast).run()
+            )
+        # Spec checks run on EVERY pallas_call, including ones whose
+        # kernel body could not be resolved (or is shared with another
+        # call that has a different grid).
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and \
+                    astutil.tail_name(astutil.call_name(node)) == \
+                    "pallas_call":
+                findings.extend(self._check_specs(ctx, node))
+        return findings
+
+    # ---- grid / BlockSpec structural checks ----------------------------
+
+    def _check_specs(self, ctx: ModuleContext, call: ast.Call):
+        findings = []
+        grid_node = astutil.call_kwarg(call, "grid")
+        if grid_node is None:
+            return findings
+        grid = astutil.int_tuple(grid_node)
+        grid_rank = None
+        if isinstance(grid_node, (ast.Tuple, ast.List)):
+            grid_rank = len(grid_node.elts)
+        elif grid is not None:
+            grid_rank = len(grid)
+
+        specs = []  # (spec node, is_out) — coverage vs out_shape is only
+        # meaningful for out_specs (input blocks may broadcast/reduce)
+        for kw_name in ("in_specs", "out_specs"):
+            node = astutil.call_kwarg(call, kw_name)
+            if node is None:
+                continue
+            elts = node.elts if isinstance(node, (ast.Tuple, ast.List)) \
+                else [node]
+            specs.extend((e, kw_name == "out_specs") for e in elts)
+
+        out_shape = None
+        shape_node = astutil.call_kwarg(call, "out_shape")
+        if isinstance(shape_node, ast.Call):
+            if shape_node.args:
+                out_shape = astutil.int_tuple(shape_node.args[0])
+
+        for spec, is_out in specs:
+            if not (isinstance(spec, ast.Call) and
+                    astutil.tail_name(astutil.call_name(spec)) ==
+                    "BlockSpec"):
+                continue
+            index_map = None
+            if len(spec.args) >= 2:
+                index_map = spec.args[1]
+            km = astutil.call_kwarg(spec, "index_map")
+            if km is not None:
+                index_map = km
+            if grid_rank is not None and isinstance(index_map, ast.Lambda):
+                arity = len(index_map.args.args)
+                if arity != grid_rank:
+                    findings.append(ctx.finding(
+                        index_map, self,
+                        f"BlockSpec index_map takes {arity} argument(s) "
+                        f"but the grid has {grid_rank} axis/axes — each "
+                        "grid axis feeds exactly one index argument",
+                        "match the lambda's arity to len(grid)",
+                    ))
+            block = astutil.int_tuple(spec.args[0]) if spec.args else None
+            if is_out and block and grid and out_shape and \
+                    len(block) == len(grid) == len(out_shape):
+                for g, b, s in zip(grid, block, out_shape):
+                    if g * b < s:
+                        findings.append(ctx.finding(
+                            spec, self,
+                            f"grid {grid} × block {block} covers only "
+                            f"{tuple(g_ * b_ for g_, b_ in zip(grid, block))}"
+                            f" of out_shape {out_shape} — trailing cells "
+                            "are never written",
+                            "size the grid as ceil(shape/block) per axis",
+                        ))
+                        break
+        return findings

@@ -1,0 +1,94 @@
+"""GL02 — trace-time purity.
+
+The old `bench.py` hazard: the kernel-form ladder mutated
+`rocm_mpi_tpu.ops.pallas_kernels` module globals (`pk.EQC_BODY_FORM = …`)
+to steer a trace. A cached or reused jitted program silently ignores the
+mutated global — the knob looks applied and is not (fixed in PR 1 by
+passing `body_form`/`pad_pow2` as explicit trace-time kwargs).
+
+Two patterns:
+
+* **cross-module mutation** — assignment (or `setattr`) to an attribute of
+  an imported module, anywhere in the file. Writing another module's
+  globals is exactly the silently-ignored-by-cached-traces hazard, and has
+  no legitimate in-tree use (monkeypatching belongs in tests, which are
+  outside the gate's scope).
+* **global write in a traced body** — a `global` declaration inside a
+  function that jit / shard_map / pallas_call traces (by decorator or by
+  being passed into such a call). The write executes once at trace time,
+  then never again — state that *looks* per-step and is not.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from rocm_mpi_tpu.analysis import astutil
+from rocm_mpi_tpu.analysis.core import ModuleContext, Rule
+
+
+class TraceTimePurityRule(Rule):
+    id = "GL02"
+    name = "trace-time-purity"
+    severity = "error"
+    rationale = (
+        "module-global state mutated at trace time is baked into (or "
+        "silently ignored by) the cached compiled program — the bench.py "
+        "kernel-form ladder shipped this bug; pass trace-time switches as "
+        "explicit kwargs instead"
+    )
+    hint = "see docs/ANALYSIS.md#gl02"
+
+    def check(self, ctx: ModuleContext):
+        findings = []
+        imports = astutil.collect_imports(ctx.tree)
+        module_aliases = set(imports.module_aliases)
+
+        # -- cross-module attribute mutation (anywhere in the file) -------
+        for node in ast.walk(ctx.tree):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id in module_aliases:
+                    findings.append(ctx.finding(
+                        t,
+                        self,
+                        f"assignment to '{t.value.id}.{t.attr}' mutates "
+                        f"module '{imports.module_aliases[t.value.id]}' "
+                        "globals — a cached/reused jitted program silently "
+                        "ignores the mutated value",
+                        "pass the switch as an explicit trace-time kwarg "
+                        "(the bench.py body_form/pad_pow2 fix) or move the "
+                        "knob behind a function API",
+                    ))
+            if isinstance(node, ast.Call) and \
+                    astutil.tail_name(astutil.call_name(node)) == "setattr" \
+                    and node.args and isinstance(node.args[0], ast.Name) \
+                    and node.args[0].id in module_aliases:
+                findings.append(ctx.finding(
+                    node,
+                    self,
+                    f"setattr on module '{node.args[0].id}' mutates another "
+                    "module's globals — invisible to cached traces",
+                ))
+
+        # -- `global` writes inside traced bodies -------------------------
+        for traced in astutil.traced_bodies(ctx.tree):
+            for node in astutil.walk_no_nested_functions(traced.fn):
+                if isinstance(node, ast.Global):
+                    findings.append(ctx.finding(
+                        node,
+                        self,
+                        f"'global {', '.join(node.names)}' inside "
+                        f"{traced.kind}-traced '{traced.fn.name}': the "
+                        "write runs once at trace time, not per step, and "
+                        "is dead in the compiled program",
+                        "hoist the state out of the traced body or thread "
+                        "it through the function's arguments/results",
+                    ))
+        return findings

@@ -31,8 +31,8 @@ import math
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+from rocm_mpi_tpu.utils.compat import pallas as pl
+from rocm_mpi_tpu.utils.compat import pallas_tpu as pltpu
 
 # Whole-block kernels hold ~5 block-sized buffers in VMEM; stay well under
 # the ~16 MB/core budget (pallas_guide.md "Memory Hierarchy").

@@ -21,8 +21,8 @@ import functools
 
 import jax.numpy as jnp
 from jax import lax
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+from rocm_mpi_tpu.utils.compat import pallas as pl
+from rocm_mpi_tpu.utils.compat import pallas_tpu as pltpu
 
 from rocm_mpi_tpu.ops.pallas_kernels import (
     _VMEM_BLOCK_BUDGET_BYTES,

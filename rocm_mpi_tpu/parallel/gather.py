@@ -22,7 +22,7 @@ def gather_to_host0(x) -> np.ndarray | None:
     """Return the full global array as numpy on process 0 (None elsewhere)."""
     if jax.process_count() == 1:
         return np.asarray(jax.device_get(x))
-    from jax.experimental import multihost_utils
+    from rocm_mpi_tpu.utils.compat import multihost_utils
 
     full = multihost_utils.process_allgather(x, tiled=True)
     if jax.process_index() == 0:

@@ -37,8 +37,8 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+from rocm_mpi_tpu.utils.compat import pallas as pl
+from rocm_mpi_tpu.utils.compat import pallas_tpu as pltpu
 
 import rocm_mpi_tpu.ops.pallas_kernels as pk
 from rocm_mpi_tpu.utils import metrics

@@ -45,8 +45,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+from rocm_mpi_tpu.utils.compat import pallas as pl
+from rocm_mpi_tpu.utils.compat import pallas_tpu as pltpu
 
 from rocm_mpi_tpu.ops.pallas_kernels import edge_masked_cm
 from rocm_mpi_tpu.utils import metrics

@@ -24,6 +24,15 @@
 set -u
 cd "$(dirname "$0")/.."
 
+# Pre-flight: the graftlint gate (scripts/lint.sh, <5 s, no device). A
+# donation/purity/compat finding means the measurement code is carrying a
+# known-corrupting bug class — bank nothing until it's fixed: a whole
+# healthy window spent measuring a racy program is worse than a late start.
+if ! bash scripts/lint.sh; then
+  echo "[watcher] graftlint gate FAILED — fix findings before measuring" >&2
+  exit 2
+fi
+
 # Children honor this dir via utils.backend.enable_persistent_cache() /
 # tests_tpu/conftest.py (which also set the persist-everything thresholds
 # themselves — no point exporting those here, they'd be overridden).

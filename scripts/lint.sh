@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# graftlint gate: the repo's own shard-safety analyzer over the gate scope
+# (rule catalog: docs/ANALYSIS.md; engine: rocm_mpi_tpu/analysis/).
+#
+# Fast (<5 s, stdlib-only AST walk) — run it BEFORE the test suite: it
+# catches the donation-race / trace-purity / compat-drift bug classes that
+# unit tests only see under the exact interleaving that bites.
+#
+# Exit codes: 0 clean, 1 non-suppressed findings, 2 usage/internal error.
+# Extra args pass through (e.g. scripts/lint.sh --json, --select GL03).
+set -u
+cd "$(dirname "$0")/.."
+# The gate never needs a device and must not hang on a flaky chip tunnel.
+exec env JAX_PLATFORMS=cpu python -m rocm_mpi_tpu.analysis \
+  rocm_mpi_tpu apps bench.py "$@"

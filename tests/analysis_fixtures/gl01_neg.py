@@ -1,0 +1,37 @@
+"""GL01 true negatives: the safe rebinding and save-then-wait idioms."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def advance(u_prev, u_curr, n):
+    return u_curr, u_prev + n
+
+
+def safe_rebinding(u_prev, u_curr):
+    u_prev, u_curr = advance(u_prev, u_curr, 4)  # rebound: donation is fine
+    u_prev, u_curr = advance(u_prev, u_curr, 8)
+    return u_curr
+
+
+def safe_segmented(advance_fn, state, directory):
+    mgr = make_manager(directory)
+    for step in range(10):
+        state = advance_fn(state, 1)
+        mgr.save(step, args=state)
+        mgr.wait_until_finished()  # guard: save completes before reuse
+    return state
+
+
+def branches_do_not_leak(state, flag):
+    if flag:
+        out = advance(state, state, 2)
+    else:
+        out = (state, state)
+    return out
+
+
+def make_manager(directory):
+    return object()

@@ -1,0 +1,41 @@
+"""GL01 true positives: read-after-donate and save/advance overlap.
+
+Never imported — parsed only (tests/test_analysis_rules.py).
+"""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def advance(state, n):
+    return state + n
+
+
+def reads_after_donate(state):
+    out = advance(state, 4)
+    return out + state.sum()  # GL01: state was donated
+
+
+def rebinds_during_async_save(advance_fn, state, directory):
+    mgr = make_manager(directory)
+    for step in range(10):
+        state = advance_fn(state, 1)  # GL01 (pass 2): save still in flight
+        mgr.save(step, args=state)
+    return state
+
+
+def make_manager(directory):
+    return CheckpointManager(directory)
+
+
+class CheckpointManager:
+    def __init__(self, directory):
+        self.directory = directory
+
+    def save(self, step, args=None):
+        pass
+
+    def wait_until_finished(self):
+        pass

@@ -1,0 +1,27 @@
+"""GL02 true negatives: module-own globals in plain (untraced) functions,
+instance attribute writes, and explicit trace-time kwargs."""
+
+import jax
+
+_PLAN = None
+_ENV_CONSUMED = False
+
+
+def configure(plan):  # plain host-side function: module-own global is fine
+    global _PLAN, _ENV_CONSUMED
+    _PLAN = plan
+    _ENV_CONSUMED = True
+
+
+class Holder:
+    def __init__(self):
+        self.knob = "eqc"
+
+    def set_knob(self, value):
+        self.knob = value  # instance attr, not a module
+
+
+@jax.jit
+def pure_step(x, *, body_form="eqc"):
+    # the PR-1 fix idiom: the switch is a trace-time kwarg, no global
+    return x * (2 if body_form == "eqc" else 3)

@@ -1,0 +1,32 @@
+"""GL02 true positives: cross-module mutation + global write in traced body."""
+
+import functools
+
+import jax
+import rocm_mpi_tpu.ops.pallas_kernels as pk
+
+_CALLS = 0
+
+pk.EQC_BODY_FORM = "conly"  # GL02: the old bench.py ladder hazard
+
+
+def flip_knob(form):
+    pk.VMEM_PAD_POW2 = form  # GL02: cross-module mutation in a helper too
+    setattr(pk, "EQC_BODY_FORM", form)  # GL02: same via setattr
+
+
+@jax.jit
+def traced_counter(x):
+    global _CALLS  # GL02: runs once at trace time, not per call
+    _CALLS = _CALLS + 1
+    return x * 2
+
+
+def make_step():
+    @functools.partial(jax.jit, donate_argnums=0)
+    def step(x):
+        global _CALLS  # GL02: traced body via partial-jit decorator
+        _CALLS += 1
+        return x + 1
+
+    return step

@@ -1,0 +1,20 @@
+"""GL03 true negatives: everything routed through the chokepoints."""
+
+import jax
+from rocm_mpi_tpu.utils.backend import set_cpu_device_count
+from rocm_mpi_tpu.utils.compat import (
+    axis_size,
+    cost_analysis_dict,
+    out_struct_like,
+    pallas as pl,
+    shard_map,
+)
+
+
+def clean(compiled, mesh, specs, exemplar):
+    cost = cost_analysis_dict(compiled)
+    set_cpu_device_count(8)
+    n = axis_size("gx")
+    struct = out_struct_like((8, 8), exemplar)
+    jax.config.update("jax_platforms", "cpu")  # a knob compat does not own
+    return cost, n, struct

@@ -1,0 +1,16 @@
+"""GL03 true positives: every raw spelling the compat chokepoints own."""
+
+import jax
+from jax import lax
+from jax import shard_map  # GL03: version-specific home
+from jax.experimental import pallas as pl  # GL03
+from jax.experimental.shard_map import shard_map as sm  # GL03
+
+
+def drifted(compiled, mesh, specs):
+    cost = compiled.cost_analysis()  # GL03: list on 0.4.x, dict on newer
+    jax.config.update("jax_num_cpu_devices", 8)  # GL03: no knob on 0.4.x
+    n = lax.axis_size("gx")  # GL03: missing on 0.4.x
+    struct = jax.ShapeDtypeStruct((8, 8), "float32", vma={"gx"})  # GL03
+    f = jax.experimental.pjit  # GL03: attribute chain
+    return cost, n, struct, f

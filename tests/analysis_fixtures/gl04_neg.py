@@ -1,0 +1,38 @@
+"""GL04 true negatives: the repo's kernel conventions, followed."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from rocm_mpi_tpu.utils.compat import pallas as pl
+from rocm_mpi_tpu.utils.compat import pallas_tpu as pltpu
+
+
+def _upcast_for_compute(*arrays):
+    if arrays[0].dtype == jnp.bfloat16:
+        return tuple(a.astype(jnp.float32) for a in arrays)
+    return arrays
+
+
+def _good_kernel(a_ref, b_ref, o_ref, *, scale):
+    a, b = _upcast_for_compute(a_ref[:], b_ref[:])
+    zg = jnp.zeros_like(a)  # helper built from the upcast value
+    ndim = len(a_ref.shape)  # .shape on a bare ref is metadata, fine
+    combined = jnp.concatenate([a, zg], axis=0)
+    o_ref[:] = (combined[: a.shape[0]] + scale * b * ndim).astype(
+        o_ref.dtype
+    )
+
+
+def launch(a, b):
+    return pl.pallas_call(
+        functools.partial(_good_kernel, scale=2.0),
+        grid=(4,),
+        in_specs=[
+            pl.BlockSpec((8,), lambda i: (i,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((8,), lambda i: (i,), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((8,), lambda i: (i,),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((32,), "float32"),
+    )(a, b)

@@ -1,0 +1,63 @@
+"""GL04 true positives: bare refs, skipped upcast, arity/coverage bugs."""
+
+import functools
+
+import jax.numpy as jnp
+from rocm_mpi_tpu.utils.compat import pallas as pl
+from rocm_mpi_tpu.utils.compat import pallas_tpu as pltpu
+
+
+def _upcast_for_compute(*arrays):
+    return tuple(a.astype(jnp.float32) for a in arrays)
+
+
+def _bad_bare_ref_kernel(x_ref, o_ref):
+    o_ref[:] = jnp.tanh(x_ref)  # GL04: ref passed bare to a jnp op
+
+
+def _bad_raw_precision_kernel(a_ref, b_ref, o_ref):
+    # GL04: arithmetic straight off the refs, no f32 upcast (bf16 inputs
+    # would quantize per step — the r4 frozen-trajectory bug)
+    o_ref[:] = (a_ref[:] + b_ref[:]).astype(o_ref.dtype)
+
+
+def _ok_kernel(a_ref, o_ref):
+    (a,) = _upcast_for_compute(a_ref[:])
+    o_ref[:] = (a * 2.0).astype(o_ref.dtype)
+
+
+def launch(x, a, b):
+    one = pl.pallas_call(
+        _bad_bare_ref_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)
+    two = pl.pallas_call(
+        _bad_raw_precision_kernel,
+        out_shape=jax.ShapeDtypeStruct(a.shape, a.dtype),
+    )(a, b)
+    # GL04: index_map arity 1 vs grid rank 2
+    three = pl.pallas_call(
+        functools.partial(_ok_kernel),
+        grid=(4, 4),
+        in_specs=[
+            pl.BlockSpec((8, 8), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((8, 8), lambda i, j: (i, j),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((32, 32), "float32"),
+    )(a)
+    # GL04: grid (2,) x block (8,) covers 16 of 32 rows
+    four = pl.pallas_call(
+        functools.partial(_ok_kernel),
+        grid=(2,),
+        in_specs=[pl.BlockSpec((8,), lambda i: (i,),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((8,), lambda i: (i,),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((32,), "float32"),
+    )(a)
+    return one, two, three, four
+
+
+import jax  # noqa: E402  (fixture: parsed, never imported)

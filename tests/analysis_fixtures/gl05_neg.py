@@ -1,0 +1,23 @@
+"""GL05 true negatives: matching literals, and variables (not judged)."""
+
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from rocm_mpi_tpu.utils.compat import shard_map
+
+
+def build(devices, x, axis_names):
+    mesh = Mesh(np.array(devices), ("gx", "gy"))
+
+    def body(block):
+        total = lax.psum(block, "gy")  # literal, in the mesh
+        rolled = lax.ppermute(
+            block, axis_names[0], [(0, 1)]
+        )  # variable axis: skipped
+        return total + rolled
+
+    return shard_map(
+        body, mesh, in_specs=(P("gx", "gy"),), out_specs=P("gx", "gy"),
+        check_vma=False,
+    )(x)

@@ -1,0 +1,20 @@
+"""GL05 true positive: collective over an axis name missing from the mesh."""
+
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from rocm_mpi_tpu.utils.compat import shard_map
+
+
+def build(devices, x):
+    mesh = Mesh(np.array(devices), ("gx",))
+
+    def body(block):
+        total = lax.psum(block, "gy")  # GL05: mesh only has 'gx'
+        idx = lax.axis_index("gx")  # fine
+        return total + idx
+
+    return shard_map(
+        body, mesh, in_specs=(P("gx"),), out_specs=P("gx"), check_vma=False
+    )(x)

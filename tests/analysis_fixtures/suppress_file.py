@@ -1,0 +1,6 @@
+"""File-wide suppression fixture."""
+# graftlint: disable-file=GL03
+
+from jax.experimental import pallas as pl
+from jax.experimental import multihost_utils
+from jax import shard_map  # GL02/GL01 etc would still fire; GL03 cannot

@@ -1,0 +1,245 @@
+"""graftlint rule-engine tests: one true-positive and one true-negative
+fixture per rule family (tests/analysis_fixtures/), suppression
+directives, the JSON reporter schema, and CLI exit codes.
+
+The fixtures are PARSED, never imported — some deliberately contain the
+bugs the rules exist to catch.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+import pytest
+
+from rocm_mpi_tpu.analysis import (
+    PARSE_RULE,
+    all_rules,
+    gate_exit_code,
+    lint_paths,
+    lint_source,
+    to_json,
+)
+from rocm_mpi_tpu.analysis.__main__ import main as cli_main
+
+FIXTURES = pathlib.Path(__file__).parent / "analysis_fixtures"
+
+
+def lint_fixture(name: str):
+    path = FIXTURES / name
+    return lint_source(path.read_text(), str(path))
+
+
+def live_rules(findings) -> set[str]:
+    return {f.rule for f in findings if not f.suppressed}
+
+
+# ---------------------------------------------------------------------------
+# Per-rule true positive / true negative
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("rule_id", ["GL01", "GL02", "GL03", "GL04", "GL05"])
+def test_rule_true_positive(rule_id):
+    findings = lint_fixture(f"{rule_id.lower()}_pos.py")
+    assert rule_id in live_rules(findings), (
+        f"{rule_id} did not fire on its positive fixture; "
+        f"got {[(f.rule, f.line) for f in findings]}"
+    )
+    # positives are findings of the rule under test, not collateral noise
+    assert live_rules(findings) == {rule_id}
+
+
+@pytest.mark.parametrize("rule_id", ["GL01", "GL02", "GL03", "GL04", "GL05"])
+def test_rule_true_negative(rule_id):
+    findings = lint_fixture(f"{rule_id.lower()}_neg.py")
+    assert rule_id not in live_rules(findings), (
+        f"{rule_id} false-positive on its negative fixture: "
+        f"{[(f.line, f.message) for f in findings if f.rule == rule_id]}"
+    )
+
+
+def test_gl01_flags_both_patterns():
+    """Read-after-donate AND save/advance overlap each produce a finding."""
+    findings = [f for f in lint_fixture("gl01_pos.py") if f.rule == "GL01"]
+    messages = " | ".join(f.message for f in findings)
+    assert "donated" in messages
+    assert "async save" in messages
+
+
+def test_gl02_flags_cross_module_and_traced_global():
+    findings = [f for f in lint_fixture("gl02_pos.py") if f.rule == "GL02"]
+    messages = " | ".join(f.message for f in findings)
+    assert "mutates module" in messages
+    assert "trace time" in messages
+
+
+# ---------------------------------------------------------------------------
+# Suppressions
+# ---------------------------------------------------------------------------
+
+
+def test_inline_and_next_line_suppressions():
+    findings = lint_fixture("suppressions.py")
+    suppressed = [f for f in findings if f.suppressed]
+    live = [f for f in findings if not f.suppressed]
+    assert len(suppressed) == 2  # disable= and disable-next=
+    assert len(live) == 1  # the undirected violation stays live
+    assert all(f.rule == "GL03" for f in findings)
+
+
+def test_file_wide_suppression():
+    findings = lint_fixture("suppress_file.py")
+    gl03 = [f for f in findings if f.rule == "GL03"]
+    assert gl03 and all(f.suppressed for f in gl03)
+    assert gate_exit_code(findings) == 0
+
+
+def test_suppressed_findings_do_not_gate():
+    findings = lint_fixture("suppress_file.py")
+    assert gate_exit_code(findings) == 0
+    findings = lint_fixture("gl03_pos.py")
+    assert gate_exit_code(findings) == 1
+
+
+def test_docstring_directive_text_does_not_suppress():
+    """A docstring that merely DOCUMENTS a suppression must not install
+    one (directives are comment tokens, not string content)."""
+    src = (
+        '"""Docs: silence with `# graftlint: disable-file=GL03`."""\n'
+        "from jax.experimental import pallas\n"
+    )
+    findings = lint_source(src, "doc.py")
+    assert [f.rule for f in findings] == ["GL03"]
+    assert not findings[0].suppressed
+    assert gate_exit_code(findings) == 1
+
+
+def test_gl03_allowlist_matches_unnormalized_chokepoint_paths():
+    """compat.py must stay exempt however the gate spells its path."""
+    repo = FIXTURES.parents[1]
+    compat = repo / "rocm_mpi_tpu" / "utils" / "compat.py"
+    twisted = str(compat.parent / ".." / "utils" / "compat.py")
+    findings = lint_source(compat.read_text(), twisted)
+    assert [f for f in findings if f.rule == "GL03"] == []
+
+
+def test_gl04_coverage_ignores_broadcast_in_specs():
+    """An input block smaller than out_shape (broadcast/reduction input)
+    is legitimate; only out_specs blocks are judged against out_shape."""
+    src = (
+        "from rocm_mpi_tpu.utils.compat import pallas as pl\n"
+        "import jax\n"
+        "def _k(a_ref, o_ref):\n"
+        "    o_ref[:] = a_ref[:]\n"
+        "def launch(a):\n"
+        "    return pl.pallas_call(\n"
+        "        _k, grid=(4,),\n"
+        "        in_specs=[pl.BlockSpec((1,), lambda i: (0,))],\n"
+        "        out_specs=pl.BlockSpec((8,), lambda i: (i,)),\n"
+        "        out_shape=jax.ShapeDtypeStruct((32,), 'float32'),\n"
+        "    )(a)\n"
+    )
+    assert lint_source(src, "bcast.py") == []
+
+
+def test_lint_file_cache_returns_fresh_copies(tmp_path):
+    """Mutating a returned Finding must not poison later cache hits, and
+    display_path must not be served from another label's entry."""
+    p = tmp_path / "dirty.py"
+    p.write_text("from jax.experimental import pallas\n")
+    from rocm_mpi_tpu.analysis.core import lint_file
+
+    first = lint_file(p)
+    assert first and not first[0].suppressed
+    first[0].suppressed = True
+    again = lint_file(p)
+    assert not again[0].suppressed
+    relabeled = lint_file(p, display_path="label.py")
+    assert relabeled[0].file == "label.py"
+
+
+# ---------------------------------------------------------------------------
+# Robustness: unparseable input warns, never crashes the gate
+# ---------------------------------------------------------------------------
+
+
+def test_unparseable_source_warns_and_passes_gate():
+    findings = lint_source("def broken(:\n", "broken.py")
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == PARSE_RULE
+    assert f.severity == "warning"
+    assert "skipped" in f.message
+    assert gate_exit_code(findings) == 0  # warnings never wedge CI
+
+
+def test_unparseable_file_in_tree_does_not_crash(tmp_path):
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    (tmp_path / "broken.py").write_text("def broken(:\n")
+    findings, scanned = lint_paths([str(tmp_path)])
+    assert scanned == 2
+    assert {f.rule for f in findings} == {PARSE_RULE}
+    assert gate_exit_code(findings) == 0
+
+
+def test_missing_path_fails_loudly():
+    with pytest.raises(FileNotFoundError):
+        lint_paths(["no/such/dir"])
+
+
+# ---------------------------------------------------------------------------
+# JSON reporter schema (version 1 — pinned)
+# ---------------------------------------------------------------------------
+
+
+def test_json_reporter_schema():
+    findings = lint_fixture("gl03_pos.py") + lint_fixture("suppressions.py")
+    doc = json.loads(to_json(findings, files_scanned=2))
+    assert doc["version"] == 1
+    assert doc["files_scanned"] == 2
+    assert isinstance(doc["suppressed"], int) and doc["suppressed"] == 2
+    # counts: every registered rule id present, plus GL00
+    rule_ids = {r.id for r in all_rules()} | {PARSE_RULE}
+    assert set(doc["counts"]) == rule_ids
+    assert doc["counts"]["GL03"] == len(
+        [f for f in findings if not f.suppressed]
+    )
+    required = {
+        "file", "line", "col", "rule", "severity", "message", "hint",
+        "suppressed",
+    }
+    for entry in doc["findings"]:
+        assert set(entry) == required
+        assert entry["severity"] in ("error", "warning")
+        assert isinstance(entry["line"], int) and entry["line"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# CLI contract
+# ---------------------------------------------------------------------------
+
+
+def test_cli_exit_codes(capsys):
+    assert cli_main([str(FIXTURES / "gl03_neg.py")]) == 0
+    assert cli_main([str(FIXTURES / "gl03_pos.py")]) == 1
+    assert cli_main(["definitely/not/a/path"]) == 2
+    assert cli_main([]) == 2  # no paths = usage error, not a silent pass
+    capsys.readouterr()
+
+
+def test_cli_select_and_json(capsys):
+    rc = cli_main([str(FIXTURES / "gl03_pos.py"), "--select", "GL01",
+                   "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0  # GL03 findings filtered out by --select
+    doc = json.loads(out)
+    assert doc["findings"] == []
+
+
+def test_cli_list_rules(capsys):
+    assert cli_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in ("GL01", "GL02", "GL03", "GL04", "GL05"):
+        assert rule_id in out

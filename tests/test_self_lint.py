@@ -1,0 +1,109 @@
+"""Tier-1 self-lint gate: the repo's own gate scope must be graftlint-clean,
+fast, and the analyzer must provably catch the two measured historical bug
+classes (the acceptance oracle for the whole subsystem):
+
+* GL01 — deleting the PR-1 donation guard (`mgr.wait_until_finished()`)
+  from utils/checkpoint.py's run_segmented re-creates the async-save/
+  donated-buffer overlap that corrupted every mid-run checkpoint.
+* GL02 — re-adding a `pk.<KNOB> = …` module-global write to bench.py
+  re-creates the trace-time mutation the old kernel-form ladder shipped.
+
+The repo-wide run prints the per-rule findings table so a regression
+names the rule that fired, and is budgeted (<5 s target, hard-capped
+well above to keep CI unflaky) — safe for `not slow` tier-1.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import time
+
+from rocm_mpi_tpu.analysis import (
+    gate_exit_code,
+    lint_paths,
+    lint_source,
+    rule_table,
+)
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+GATE_SCOPE = [
+    str(REPO / "rocm_mpi_tpu"),
+    str(REPO / "apps"),
+    str(REPO / "bench.py"),
+]
+
+
+def test_repo_is_lint_clean_and_fast():
+    t0 = time.monotonic()
+    findings, scanned = lint_paths(GATE_SCOPE)
+    elapsed = time.monotonic() - t0
+    print(f"\ngraftlint self-lint: {scanned} files in {elapsed:.2f}s")
+    print(rule_table(findings))
+    live = [f for f in findings if not f.suppressed]
+    assert gate_exit_code(findings) == 0, (
+        "graftlint gate scope is dirty:\n"
+        + "\n".join(f"{f.location()}: {f.rule}: {f.message}" for f in live)
+    )
+    assert scanned >= 40, f"gate scope shrank to {scanned} files?"
+    # <5 s is the design target; the hard cap leaves headroom for slow CI
+    # boxes without letting an accidental O(n²) regress unnoticed forever.
+    assert elapsed < 30.0, f"self-lint took {elapsed:.1f}s"
+
+
+def test_second_walk_hits_the_ast_cache():
+    lint_paths(GATE_SCOPE)  # warm (or already warm from the test above)
+    t0 = time.monotonic()
+    lint_paths(GATE_SCOPE)
+    cached = time.monotonic() - t0
+    assert cached < 2.0, f"cached repo walk took {cached:.2f}s"
+
+
+# ---------------------------------------------------------------------------
+# The two historical bug classes, provably caught
+# ---------------------------------------------------------------------------
+
+
+def test_gl01_catches_deleted_checkpoint_donation_guard():
+    path = REPO / "rocm_mpi_tpu" / "utils" / "checkpoint.py"
+    src = path.read_text()
+    assert "mgr.wait_until_finished()" in src, (
+        "the PR-1 donation guard moved — update this oracle"
+    )
+    mutated = "\n".join(
+        line for line in src.splitlines()
+        if "wait_until_finished" not in line
+    )
+    assert mutated != src
+    before = [f for f in lint_source(src, str(path))
+              if f.rule == "GL01" and not f.suppressed]
+    after = [f for f in lint_source(mutated, str(path))
+             if f.rule == "GL01" and not f.suppressed]
+    assert before == [], "pristine checkpoint.py must be GL01-clean"
+    assert after, (
+        "deleting mgr.wait_until_finished() must re-create the measured "
+        "async-save donation race and GL01 must catch it"
+    )
+    assert any("async save" in f.message for f in after)
+
+
+def test_gl02_catches_restored_bench_global_mutation():
+    path = REPO / "bench.py"
+    src = path.read_text()
+    mutated = src + (
+        "\n\nimport rocm_mpi_tpu.ops.pallas_kernels as pk\n"
+        'pk.EQC_BODY_FORM = "conly"  # the pre-PR-1 ladder hazard\n'
+    )
+    before = [f for f in lint_source(src, "bench.py")
+              if f.rule == "GL02" and not f.suppressed]
+    after = [f for f in lint_source(mutated, "bench.py")
+             if f.rule == "GL02" and not f.suppressed]
+    assert before == [], "pristine bench.py must be GL02-clean"
+    assert after and any("mutates module" in f.message for f in after)
+
+
+def test_fixture_dir_is_excluded_from_directory_walks():
+    # The deliberately-buggy fixtures must never leak into a `tests/`-wide
+    # lint invocation (e.g. someone running the CLI over the whole repo).
+    findings, _ = lint_paths([str(REPO / "tests")])
+    files = {f.file for f in findings}
+    assert not any("analysis_fixtures" in f for f in files), files
