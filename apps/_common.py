@@ -97,8 +97,35 @@ def make_parser(
         "--save-field", default=None, metavar="PATH.npy",
         help="dump the final gathered field as .npy (process 0)",
     )
+    add_telemetry_flag(p)
     add_checkpoint_flags(p)
     return p
+
+
+def add_telemetry_flag(p) -> None:
+    """The shared --telemetry block (docs/TELEMETRY.md): every workload
+    app and the weak-scaling harness expose the same knob."""
+    p.add_argument(
+        "--telemetry", default=None, metavar="DIR",
+        help="collect structured telemetry (spans/counters/events) into "
+        "DIR as telemetry-rank{k}.jsonl; merge and inspect with "
+        "`python -m rocm_mpi_tpu.telemetry summarize DIR` "
+        "(RMT_TELEMETRY_DIR is the env spelling the launcher forwards)",
+    )
+
+
+def setup_telemetry(args, jax) -> None:
+    """Enable telemetry when --telemetry DIR was given (env-configured
+    collection — the launcher's RMT_TELEMETRY_DIR — needs no call here;
+    events reads the env at import). Called after distributed init so
+    the rank stamp is the real process index."""
+    if getattr(args, "telemetry", None):
+        from rocm_mpi_tpu import telemetry
+
+        telemetry.configure(
+            directory=args.telemetry, enabled=True,
+            rank=jax.process_index(),
+        )
 
 
 def add_checkpoint_flags(p) -> None:
@@ -146,9 +173,8 @@ def checkpointed_run(args, advance, init_state, log0, quantum: int = 1):
     `quantum` is the schedule's step granularity (the deep schedule
     advances k steps per sweep): the save interval is rounded UP to a
     multiple of it, so segment lengths never truncate a sweep."""
-    import time
-
     from rocm_mpi_tpu.utils import checkpoint as ckpt
+    from rocm_mpi_tpu.utils.metrics import Timer
 
     every = args.ckpt_every or max(args.nt // 4, 1)
     if every % quantum:
@@ -193,25 +219,30 @@ def checkpointed_run(args, advance, init_state, log0, quantum: int = 1):
         log0(f"--resume: checkpoint already at step {start} >= nt={args.nt};"
              " nothing to run")
         return state, 0, 0.0
-    t0 = time.perf_counter()
-    if supervised:
-        # Crash supervision (resilience.run_supervised): restore, the
-        # nothing-to-run case, and retry restarts are all owned by the
-        # supervisor — the app only pre-resolved `start` for the quantum
-        # guard above and the steps-run accounting below.
-        from rocm_mpi_tpu.resilience import run_supervised
+    # Labeled Timer: the interval lands in the telemetry stream as a
+    # "run.checkpointed" span (durability window: advance + saves — the
+    # per-save attribution comes from checkpoint.py's own spans). The
+    # bare perf_counter() this replaces is now lint-gated (GL06).
+    with Timer(label="run.checkpointed", steps=args.nt - start) as timer:
+        if supervised:
+            # Crash supervision (resilience.run_supervised): restore, the
+            # nothing-to-run case, and retry restarts are all owned by the
+            # supervisor — the app only pre-resolved `start` for the
+            # quantum guard above and the steps-run accounting below.
+            from rocm_mpi_tpu.resilience import run_supervised
 
-        log0(f"supervised run: up to {args.retries} restart(s), "
-             f"resume={'on' if args.resume else 'off'}")
-        state = run_supervised(
-            advance, init_state, args.nt, args.checkpoint, every,
-            max_retries=args.retries, resume=args.resume, log=log0,
-        )
-    else:
-        state = ckpt.run_segmented(
-            advance, state, args.nt, args.checkpoint, every, start_step=start
-        )
-    wtime = time.perf_counter() - t0
+            log0(f"supervised run: up to {args.retries} restart(s), "
+                 f"resume={'on' if args.resume else 'off'}")
+            state = run_supervised(
+                advance, init_state, args.nt, args.checkpoint, every,
+                max_retries=args.retries, resume=args.resume, log=log0,
+            )
+        else:
+            state = ckpt.run_segmented(
+                advance, state, args.nt, args.checkpoint, every,
+                start_step=start,
+            )
+    wtime = timer.elapsed
     ran = max(args.nt - start, 0)
     if ran:
         log0(f"checkpointed {start}→{args.nt} every {every} steps into "
@@ -297,6 +328,7 @@ def setup_jax(args):
     from rocm_mpi_tpu.utils.backend import enable_persistent_cache
 
     enable_persistent_cache()
+    setup_telemetry(args, jax)
     return jax
 
 
@@ -339,6 +371,18 @@ def build_config(args):
     if args.fact:
         cfg = with_fact(cfg, args.fact)
     return cfg
+
+
+def emit_run_gauges(result, variant: str) -> None:
+    """Bank the run's headline rates into the telemetry stream (no-op
+    when collection is off; rate properties divide by the timed window,
+    so a fully-resumed nt=0 run emits nothing)."""
+    from rocm_mpi_tpu import telemetry
+
+    if not telemetry.enabled() or not result.nt or not result.wtime:
+        return
+    telemetry.gauge("run.gpts", result.gpts, variant=variant)
+    telemetry.gauge("run.t_eff_gbs", result.t_eff, variant=variant)
 
 
 def run_app(variant: str, args) -> int:
@@ -403,6 +447,7 @@ def run_app(variant: str, args) -> int:
         with profile_ctx:
             result = runner()
         report_checkpointed_line(result, args, log0)
+        emit_run_gauges(result, variant)
     else:
         log0("Starting the time loop 🚀...", end="")
         with profile_ctx:
@@ -418,6 +463,7 @@ def run_app(variant: str, args) -> int:
             f"(@ T_eff = {result.t_eff:.2f} GB/s aggregate, "
             f"{per_chip:.2f} GB/s/chip, {result.gpts:.4f} Gpts/s)"
         )
+        emit_run_gauges(result, variant)
 
     T_v = (
         gather_to_host0(result.T)

@@ -56,8 +56,9 @@ def make_parser():
         help="dump the final gathered displacement as .npy on process 0 "
         "(the machine-readable artifact, SURVEY.md §5.4)",
     )
-    from _common import add_checkpoint_flags
+    from _common import add_checkpoint_flags, add_telemetry_flag
 
+    add_telemetry_flag(p)
     add_checkpoint_flags(p)
     return p
 

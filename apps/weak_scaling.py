@@ -27,6 +27,50 @@ import sys
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 
+def telemetry_windowed_run(model, variant: str, nt: int, warmup: int,
+                           windows: int):
+    """The --telemetry run path (diffusion): the same warmup/timed
+    protocol as model.run, but the timed loop split into `windows`
+    spanned windows — per-step PERCENTILES need more than the single
+    sample model.run's one timed window gives (aggregate's p50/p90/p99
+    over windows is what catches a straggling stretch the mean hides).
+    Each window boundary costs one device-fetch sync (the span's
+    correctness requirement); windows of many steps amortize it, exactly
+    as tic/toc always did."""
+    from rocm_mpi_tpu.models.diffusion import RunResult
+    from rocm_mpi_tpu.utils import metrics
+
+    if not 0 <= warmup < nt:
+        # Same contract as model.run: a degenerate window must fail
+        # loudly here, not as a later divide-by-zero or a negative rate.
+        raise ValueError(f"need 0 <= warmup < nt, got {warmup}, {nt}")
+    advance = model.advance_fn(variant)
+    T, Cp = model.init_state()
+    from rocm_mpi_tpu import telemetry
+
+    with telemetry.span("warmup", steps=warmup, variant=variant) as sp:
+        if warmup:
+            T = advance(T, Cp, warmup)
+        sp.sync(T)
+    timed = nt - warmup
+    n_windows = max(1, min(windows, timed))
+    base, extra = divmod(timed, n_windows)
+    wtime = 0.0
+    for i in range(n_windows):
+        w = base + (1 if i < extra else 0)
+        if w == 0:
+            continue
+        timer = metrics.Timer(label="step_window", phase="step", steps=w,
+                              variant=variant, window=i,
+                              workload="diffusion")
+        timer.tic(T)
+        T = advance(T, Cp, w)
+        timer.toc(T)
+        wtime += timer.elapsed
+    return RunResult(T=T, wtime=wtime, nt=nt, warmup=warmup,
+                     config=model.config)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--local", type=int, default=252,
@@ -54,9 +98,19 @@ def main(argv=None) -> int:
                    "up to all available)")
     p.add_argument("--json", action="store_true",
                    help="emit one JSON line per count as well")
-    args = p.parse_args(argv)
+    from _common import add_telemetry_flag, setup_jax
 
-    from _common import setup_jax
+    add_telemetry_flag(p)
+    p.add_argument("--telemetry-windows", type=int, default=8, metavar="W",
+                   help="with --telemetry: split the timed loop into W "
+                   "spanned windows (per-step percentiles need more than "
+                   "one sample; default %(default)s)")
+    p.add_argument("--no-probes", dest="probes", action="store_false",
+                   default=True,
+                   help="with --telemetry: skip the halo/interior/"
+                   "checkpoint phase-attribution probes "
+                   "(telemetry.probes)")
+    args = p.parse_args(argv)
 
     jax = setup_jax(args)  # distributed init + --cpu-devices + x64, shared
     from rocm_mpi_tpu.config import DiffusionConfig
@@ -90,6 +144,7 @@ def main(argv=None) -> int:
             counts.append(c)
             c *= 2
     base_per_dev = base_n = None
+    probe_model = None
     # Process-0-gated output: on a multi-host slice every process runs this
     # script, but only one may report (rank-0 printing, SURVEY.md §5.5).
     log0(
@@ -125,12 +180,25 @@ def main(argv=None) -> int:
             "diffusion": (HeatDiffusion, DiffusionConfig),
         }[args.workload]
         model = model_cls(cfg_cls(**common), devices=jax.devices()[:n])
+        from rocm_mpi_tpu import telemetry
+
         if args.variant == "deep":
             # Both models default None to their own depth policy and
             # reject explicit invalid depths loudly.
             r = model.run_deep(block_steps=args.deep_k)
+        elif (telemetry.enabled() and args.workload == "diffusion"
+              and model.config.halo_transport != "host"):
+            # The windowed path drives advance_fn directly; under
+            # halo_transport='host' that would silently measure the
+            # device-collective path while labeling it a host run —
+            # model.run owns the host-staged dispatch and its warning.
+            r = telemetry_windowed_run(
+                model, args.variant, args.nt, args.warmup,
+                args.telemetry_windows,
+            )
         else:
             r = model.run(variant=args.variant)
+        probe_model = model  # the last rung this process participated in
         per_dev = r.gpts / n
         if base_per_dev is None:
             # The efficiency baseline is the smallest count actually run;
@@ -138,6 +206,12 @@ def main(argv=None) -> int:
             # list, so label the baseline explicitly.
             base_per_dev, base_n = per_dev, n
         eff = per_dev / base_per_dev
+        if telemetry.enabled():
+            telemetry.gauge("run.gpts", round(r.gpts, 6), devices=n,
+                            variant=args.variant, workload=args.workload)
+            telemetry.gauge("run.gpts_per_device", round(per_dev, 6),
+                            devices=n)
+            telemetry.gauge("run.efficiency", round(eff, 6), devices=n)
         log0(
             f"n={n:4d} mesh={dims} global={shape}: "
             f"{r.wtime_it * 1e6:9.3f} us/step  {r.gpts:9.4f} Gpts/s "
@@ -159,6 +233,42 @@ def main(argv=None) -> int:
                 # rows omit the key and ARE the claim.
                 row["mechanics_only"] = True
             print(json.dumps(row))
+
+    from rocm_mpi_tpu import telemetry
+
+    if (telemetry.enabled() and args.probes and probe_model is not None
+            and args.workload == "diffusion"):
+        # Phase attribution for the fused step (telemetry/probes.py):
+        # halo-only and interior-only programs over the final rung's
+        # state, plus one save/restore cycle for the checkpoint phase.
+        # Participation bookkeeping: process sets grow monotonically with
+        # the rung's device count, so every process with a probe_model
+        # participated in the final rung and holds that rung's model —
+        # the halo/interior probes are mesh-scoped collectives among
+        # exactly those processes (the same shape every rung already
+        # runs). The ORBAX save is different: its completion barrier is
+        # GLOBAL across all jax processes, so the checkpoint probe only
+        # runs when the probe mesh spans every process — a host whose
+        # devices sat out the whole ladder must not be waited on.
+        from rocm_mpi_tpu.telemetry import events as tel_events
+        from rocm_mpi_tpu.telemetry import probes
+
+        tel_dir = tel_events.directory()
+        mesh_procs = {
+            d.process_index
+            for d in probe_model.grid.mesh.devices.flat
+        }
+        spans_all = mesh_procs == set(range(jax.process_count()))
+        ckpt_dir = (
+            pathlib.Path(tel_dir) / "ckpt-probe"
+            if tel_dir and spans_all else None
+        )
+        log0("telemetry: running halo/interior"
+             + ("/checkpoint" if ckpt_dir else "")
+             + " phase probes")
+        probes.run_diffusion_phase_probes(
+            probe_model, checkpoint_dir=ckpt_dir
+        )
     return 0
 
 

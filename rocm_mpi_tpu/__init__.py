@@ -16,8 +16,12 @@ Layer map (TPU-native analog of reference SURVEY.md §1):
   L4 global grid    -> rocm_mpi_tpu.parallel.mesh/halo       (ref: ImplicitGlobalGrid.jl)
   L5 visualization  -> rocm_mpi_tpu.utils.viz (matplotlib)   (ref: Plots.jl/GR)
   L6 apps           -> apps/diffusion_2d_*.py                (ref: scripts/diffusion_2D_*.jl)
+
+Cross-cutting: rocm_mpi_tpu.telemetry (spans/events/trace/regress —
+docs/TELEMETRY.md, the reference's tic/toc+T_eff printout grown into a
+subsystem) and rocm_mpi_tpu.analysis (graftlint, docs/ANALYSIS.md).
 """
 
 __version__ = "0.1.0"
 
-from rocm_mpi_tpu import parallel, ops, models, utils  # noqa: F401
+from rocm_mpi_tpu import parallel, ops, models, telemetry, utils  # noqa: F401

@@ -1,6 +1,6 @@
 """graftlint — AST-based shard-safety static analysis for this repo.
 
-Five rule families, each grounded in a bug class this codebase has
+Six rule families, each grounded in a bug class this codebase has
 actually shipped (rule catalog: docs/ANALYSIS.md):
 
     GL01 donation-safety        read-after-donate / async-save overlap
@@ -8,6 +8,7 @@ actually shipped (rule catalog: docs/ANALYSIS.md):
     GL03 compat-drift           raw jax APIs outside utils/compat+backend
     GL04 pallas-hygiene         bare refs, skipped f32 upcast, grid/BlockSpec
     GL05 collective-axis        axis names missing from the mesh
+    GL06 raw-timing             perf_counter/time() outside telemetry+metrics
 
 Run the gate:  python -m rocm_mpi_tpu.analysis rocm_mpi_tpu apps bench.py
 Suppress:      # graftlint: disable=GL01   (also disable-next=, disable-file=)

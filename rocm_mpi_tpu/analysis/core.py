@@ -88,6 +88,7 @@ def all_rules() -> list[Rule]:
     from rocm_mpi_tpu.analysis.rules_donation import DonationSafetyRule
     from rocm_mpi_tpu.analysis.rules_pallas import PallasHygieneRule
     from rocm_mpi_tpu.analysis.rules_purity import TraceTimePurityRule
+    from rocm_mpi_tpu.analysis.rules_timing import RawTimingRule
 
     return [
         DonationSafetyRule(),
@@ -95,6 +96,7 @@ def all_rules() -> list[Rule]:
         CompatDriftRule(),
         PallasHygieneRule(),
         AxisConsistencyRule(),
+        RawTimingRule(),
     ]
 
 

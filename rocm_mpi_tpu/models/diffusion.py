@@ -438,7 +438,9 @@ class HeatDiffusion:
             warn_host_transport_ignored(variant)
         T, Cp = self.init_state()
         advance = self.advance_fn(variant)
-        timer = metrics.Timer()
+        timer = metrics.Timer(label="step_window", phase="step",
+                              steps=nt - warmup, variant=variant,
+                              workload="diffusion")
         if warmup:
             T = advance(T, Cp, warmup)
         timer.tic(T)
@@ -486,7 +488,9 @@ class HeatDiffusion:
         def advance(T, Cp, n):
             return multi_step_fn(T, Cp, cfg.lam, dt, cfg.spacing, n, **kw)
 
-        timer = metrics.Timer()
+        timer = metrics.Timer(label="step_window", phase="step",
+                              steps=nt - warmup, variant=key,
+                              workload="diffusion")
         T = advance(T, Cp, warmup)  # n=0 still compiles the shared program
         timer.tic(T)
         T = advance(T, Cp, nt - warmup)
@@ -652,7 +656,9 @@ class HeatDiffusion:
             block_steps=block_steps, nt=nt, warmup=warmup
         )
         T, Cp = self.init_state()
-        timer = metrics.Timer()
+        timer = metrics.Timer(label="step_window", phase="step",
+                              steps=nt - warmup, variant="deep",
+                              workload="diffusion")
         T = advance(T, Cp, warmup)
         timer.tic(T)
         T = advance(T, Cp, nt - warmup)
@@ -676,7 +682,9 @@ class HeatDiffusion:
         T, Cp = self.init_state()
         T_np, Cp_np = np.asarray(T), np.asarray(Cp)
         stepper = HostStagedStepper(self.grid, cfg.lam, cfg.dt)
-        timer = metrics.Timer()
+        timer = metrics.Timer(label="step_window", phase="step",
+                              steps=nt - warmup, variant="shard-host",
+                              workload="diffusion")
         T_np = stepper.run(T_np, Cp_np, warmup)
         timer.tic()
         T_np = stepper.run(T_np, Cp_np, nt - warmup)

@@ -294,7 +294,8 @@ class ShallowWater:
             raise ValueError(f"need 0 <= warmup < nt, got {warmup}, {nt}")
         h, us = self.init_state()
         Mus = self.face_masks()
-        timer = metrics.Timer()
+        timer = metrics.Timer(label="step_window", phase="step",
+                              steps=nt - warmup, workload="swe")
         h, us = advance(h, us, Mus, warmup)
         timer.tic(h)
         h, us = advance(h, us, Mus, nt - warmup)

@@ -259,7 +259,8 @@ class AcousticWave:
         if not 0 <= warmup < nt:
             raise ValueError(f"need 0 <= warmup < nt, got {warmup}, {nt}")
         U, Uprev, C2 = self.init_state()
-        timer = metrics.Timer()
+        timer = metrics.Timer(label="step_window", phase="step",
+                              steps=nt - warmup, workload="wave")
         U, Uprev = advance(U, Uprev, C2, warmup)
         timer.tic(U)
         U, Uprev = advance(U, Uprev, C2, nt - warmup)

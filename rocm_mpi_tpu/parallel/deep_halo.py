@@ -35,6 +35,7 @@ from jax import lax
 
 from rocm_mpi_tpu.utils.compat import shard_map
 
+from rocm_mpi_tpu import telemetry
 from rocm_mpi_tpu.parallel.halo import exchange_halo
 from rocm_mpi_tpu.parallel.mesh import GlobalGrid
 
@@ -134,11 +135,19 @@ def make_deep_sweep(grid: GlobalGrid, k: int, lam, dt, spacing):
             and (n0p // tb_geometry(k)[1]) >= 2
         )
         if _compute_nbytes(Tp) <= _VMEM_BLOCK_BUDGET_BYTES:
+            route = "vmem"
             Tp = multi_step_cm(Tp, Cm, spacing, k)
         elif tb_ok:
+            route = "hbm-tb"
             Tp = multi_step_cm_hbm(Tp, Cm, spacing, k)
         else:
+            route = "jnp"
             Tp = jnp_k_steps(Tp, Cm)
+        if telemetry.enabled():
+            # Trace-time: which local kernel this compiled sweep routed to
+            # (the halo.exchange byte annotation fired inside exchange_halo).
+            telemetry.annotate("deep.sweep", k=k, route=route,
+                               steps_per_exchange=k)
         return Tp[core]
 
     def sweep(T, Cp):

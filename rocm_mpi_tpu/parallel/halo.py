@@ -27,12 +27,35 @@ correctness oracle — "is it the device collective or my math?" (SURVEY.md §4.
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from rocm_mpi_tpu import telemetry
 from rocm_mpi_tpu.parallel.mesh import GlobalGrid
+
+
+def exchange_nbytes(local_shape, itemsize: int, width: int = 1,
+                    axes=None) -> int:
+    """Bytes an interior device SENDS per `exchange_halo` call: two
+    width-`width` edge slices per exchanged axis, sized against the
+    block as it grows (the sequential corner trick means axis k's slices
+    include axis <k's padding). Edge-of-domain devices send less (their
+    ppermute entries are omitted); the interior figure is the per-device
+    capacity number telemetry wants."""
+    shape = list(local_shape)
+    axes = range(len(shape)) if axes is None else axes
+    total = 0
+    for ax in axes:
+        slice_elems = width * math.prod(
+            shape[a] for a in range(len(shape)) if a != ax
+        )
+        total += 2 * slice_elems * itemsize
+        shape[ax] += 2 * width
+    return total
 
 
 def _edge(u, axis: int, side: str, width: int):
@@ -63,7 +86,17 @@ def exchange_halo(u, grid: GlobalGrid, width: int = 1, axes=None):
     `update_halo!(T)` analog: one call per step, all axes
     (diffusion_2D_ap.jl:42).
     """
-    axes = range(grid.ndim) if axes is None else axes
+    axes = tuple(range(grid.ndim) if axes is None else axes)
+    if telemetry.enabled():
+        # Trace-time annotation: shapes are concrete while jax traces, so
+        # "this program moves N bytes per exchange" is recordable exactly
+        # once per compiled program (telemetry.events.annotate dedups).
+        telemetry.annotate(
+            "halo.exchange",
+            bytes=exchange_nbytes(u.shape, u.dtype.itemsize, width, axes),
+            width=width,
+            block=tuple(int(n) for n in u.shape),
+        )
     for ax in axes:
         name = grid.axis_names[ax]
         ghost_lo = neighbor_shift(_edge(u, ax, "hi", width), name, +1)
@@ -144,32 +177,43 @@ class HostStagedStepper:
 
         # Phase 1 — host-staged halo exchange: every shard's padded block is
         # assembled in host memory, ghost slices read from neighbor shards
-        # (zeros at the domain edge, as in exchange_halo).
+        # (zeros at the domain edge, as in exchange_halo). The two phases
+        # here are REAL host-level seams — the one stepper whose halo and
+        # interior costs telemetry can time directly rather than probe.
         padded = {}
-        for coords in np.ndindex(*grid.dims):
-            block = np.zeros(
-                tuple(ln + 2 for ln in local), dtype=T.dtype
-            )
-            inner = tuple(slice(1, -1) for _ in range(ndim))
-            core = self._shard_slices(coords)
-            block[inner] = T[core]
-            for ax in range(ndim):
-                for side, nb_off in (("lo", -1), ("hi", +1)):
-                    nb = list(coords)
-                    nb[ax] += nb_off
-                    if not 0 <= nb[ax] < grid.dims[ax]:
-                        continue  # domain edge: ghost stays zero (unused)
-                    nb_core = self._shard_slices(nb)
-                    src = list(nb_core)
-                    dst = [slice(1, 1 + ln) for ln in local]
-                    if nb_off == -1:  # ghost row 0 <- neighbor's last row
-                        src[ax] = slice(nb_core[ax].stop - 1, nb_core[ax].stop)
-                        dst[ax] = slice(0, 1)
-                    else:  # last ghost row <- neighbor's first row
-                        src[ax] = slice(nb_core[ax].start, nb_core[ax].start + 1)
-                        dst[ax] = slice(local[ax] + 1, local[ax] + 2)
-                    block[tuple(dst)] = T[tuple(src)]
-            padded[coords] = block
+        with telemetry.span("halo.host_staged", phase="halo") as hsp:
+            copied = 0
+            for coords in np.ndindex(*grid.dims):
+                block = np.zeros(
+                    tuple(ln + 2 for ln in local), dtype=T.dtype
+                )
+                inner = tuple(slice(1, -1) for _ in range(ndim))
+                core = self._shard_slices(coords)
+                block[inner] = T[core]
+                for ax in range(ndim):
+                    for side, nb_off in (("lo", -1), ("hi", +1)):
+                        nb = list(coords)
+                        nb[ax] += nb_off
+                        if not 0 <= nb[ax] < grid.dims[ax]:
+                            continue  # domain edge: ghost stays zero (unused)
+                        nb_core = self._shard_slices(nb)
+                        src = list(nb_core)
+                        dst = [slice(1, 1 + ln) for ln in local]
+                        if nb_off == -1:  # ghost row 0 <- neighbor's last row
+                            src[ax] = slice(
+                                nb_core[ax].stop - 1, nb_core[ax].stop
+                            )
+                            dst[ax] = slice(0, 1)
+                        else:  # last ghost row <- neighbor's first row
+                            src[ax] = slice(
+                                nb_core[ax].start, nb_core[ax].start + 1
+                            )
+                            dst[ax] = slice(local[ax] + 1, local[ax] + 2)
+                        ghost = T[tuple(src)]
+                        block[tuple(dst)] = ghost
+                        copied += ghost.nbytes
+                padded[coords] = block
+            hsp.set(bytes=copied)
 
         # Phase 2 — independent per-shard update (fused stencil), global
         # boundary cells Dirichlet-fixed. Multiply by the precomputed
@@ -177,32 +221,33 @@ class HostStagedStepper:
         # engine (native/halostage.cpp) and the Pallas kernels.
         inv_d2 = tuple(1.0 / (d * d) for d in spacing)
         out = np.array(T, copy=True)
-        for coords, block in padded.items():
-            inner = tuple(slice(1, -1) for _ in range(ndim))
-            core = self._shard_slices(coords)
-            lap = np.zeros(local, dtype=T.dtype)
-            for ax in range(ndim):
-                hi_s = tuple(
-                    slice(2, None) if a == ax else slice(1, -1)
-                    for a in range(ndim)
-                )
-                lo_s = tuple(
-                    slice(None, -2) if a == ax else slice(1, -1)
-                    for a in range(ndim)
-                )
-                lap += (
-                    block[hi_s] - 2.0 * block[inner] + block[lo_s]
-                ) * inv_d2[ax]
-            new = T[core] + self.dt * self.lam / Cp[core] * lap
-            # Dirichlet mask: global boundary cells keep their old values.
-            keep = np.zeros(local, dtype=bool)
-            for ax in range(ndim):
-                gidx = coords[ax] * local[ax] + np.arange(local[ax])
-                edge = (gidx == 0) | (gidx == grid.global_shape[ax] - 1)
-                sh = [1] * ndim
-                sh[ax] = local[ax]
-                keep |= edge.reshape(sh)
-            out[core] = np.where(keep, T[core], new)
+        with telemetry.span("interior.host_staged", phase="interior"):
+            for coords, block in padded.items():
+                inner = tuple(slice(1, -1) for _ in range(ndim))
+                core = self._shard_slices(coords)
+                lap = np.zeros(local, dtype=T.dtype)
+                for ax in range(ndim):
+                    hi_s = tuple(
+                        slice(2, None) if a == ax else slice(1, -1)
+                        for a in range(ndim)
+                    )
+                    lo_s = tuple(
+                        slice(None, -2) if a == ax else slice(1, -1)
+                        for a in range(ndim)
+                    )
+                    lap += (
+                        block[hi_s] - 2.0 * block[inner] + block[lo_s]
+                    ) * inv_d2[ax]
+                new = T[core] + self.dt * self.lam / Cp[core] * lap
+                # Dirichlet mask: global boundary cells keep old values.
+                keep = np.zeros(local, dtype=bool)
+                for ax in range(ndim):
+                    gidx = coords[ax] * local[ax] + np.arange(local[ax])
+                    edge = (gidx == 0) | (gidx == grid.global_shape[ax] - 1)
+                    sh = [1] * ndim
+                    sh[ax] = local[ax]
+                    keep |= edge.reshape(sh)
+                out[core] = np.where(keep, T[core], new)
         return out
 
     def run(self, T: np.ndarray, Cp: np.ndarray, nt: int) -> np.ndarray:

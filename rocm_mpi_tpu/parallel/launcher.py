@@ -24,7 +24,12 @@ Robustness contract:
     ranks holding the coordinator port);
   * `inject_fault` forwards a resilience.faults spec to every rank via
     RMT_INJECT_FAULT, so rank-failure paths are drilled in the real
-    multi-process harness (docs/RESILIENCE.md §3).
+    multi-process harness (docs/RESILIENCE.md §3);
+  * `telemetry_dir` turns on per-rank telemetry collection
+    (RMT_TELEMETRY_DIR — each rank appends telemetry-rank{k}.jsonl,
+    docs/TELEMETRY.md) and, after all ranks exit, merges the streams
+    into <dir>/telemetry-summary.json — the launcher is the one place
+    that outlives every rank, so it owns the merge.
 """
 
 from __future__ import annotations
@@ -78,12 +83,15 @@ def spawn_ranks(
     inject_fault: str | None = None,
     heartbeat_s: float = 10.0,
     peer_grace_s: float = 20.0,
+    telemetry_dir=None,
 ):
     """Spawn `nprocs` ranks of `[sys.executable] + argv` under the RMT_*
     launcher contract; return RankResults of (proc, (stdout, stderr)) in
     rank order, with `.report` carrying first-failure/heartbeat data.
     Callers judge returncodes (a killed-at-timeout or killed-after-peer-
-    failure rank reports its signal code with whatever it flushed)."""
+    failure rank reports its signal code with whatever it flushed).
+    With `telemetry_dir` every rank collects telemetry into it and the
+    merged summary is written at exit (see module docstring)."""
     port = _free_port()
     base = os.environ.copy()
     # Ranks size their own device count (--cpu-devices); an inherited
@@ -109,6 +117,10 @@ def spawn_ranks(
         )
         if inject_fault:
             env["RMT_INJECT_FAULT"] = inject_fault
+        if telemetry_dir:
+            os.makedirs(telemetry_dir, exist_ok=True)
+            env["RMT_TELEMETRY"] = "1"
+            env["RMT_TELEMETRY_DIR"] = str(telemetry_dir)
         procs.append(
             subprocess.Popen(
                 [sys.executable] + [str(a) for a in argv],
@@ -199,6 +211,22 @@ def spawn_ranks(
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    if telemetry_dir:
+        # Merge AFTER every rank is dead: the per-rank writers are
+        # append-only, so this reads complete (or cleanly-torn) streams.
+        # Best-effort by the same rule as the event log — observability
+        # must never be what fails a launch.
+        try:
+            from rocm_mpi_tpu.telemetry import aggregate
+
+            summary = aggregate.write_summary(telemetry_dir)
+            report.note(
+                f"telemetry: merged rank streams {summary['ranks']} "
+                f"({summary['records']} records) into "
+                f"{telemetry_dir}/telemetry-summary.json"
+            )
+        except Exception as exc:  # noqa: BLE001
+            report.note(f"telemetry merge failed: {exc!r}")
     results = RankResults(zip(procs, outs))
     results.report = report
     return results

@@ -33,6 +33,7 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 
+from rocm_mpi_tpu import telemetry
 from rocm_mpi_tpu.parallel.halo import exchange_halo, global_boundary_mask
 from rocm_mpi_tpu.parallel.mesh import GlobalGrid
 
@@ -100,6 +101,14 @@ def make_overlap_step(
     bw = effective_b_width(local, b_width)
 
     def local_step(Tl, Cpl, lam, dt, spacing):
+        if telemetry.enabled():
+            # Trace-time: the slab geometry this compiled overlap step
+            # uses (the per-leaf halo.exchange byte annotations fire
+            # inside exchange_halo below).
+            telemetry.annotate(
+                "overlap.step", b_width=tuple(int(b) for b in bw),
+                leaves=len(jax.tree_util.tree_leaves(Tl)),
+            )
         # (1) halo exchange of the current state — edge-slice ppermutes,
         # one exchange per state leaf (SWE: 3 fields; diffusion/wave: 1).
         Tp = jax.tree_util.tree_map(

@@ -28,6 +28,16 @@ def ring_exchange(x, axis_name: str, shift: int = 1):
     """
     from rocm_mpi_tpu.utils.compat import axis_size
 
+    from rocm_mpi_tpu import telemetry
+
+    if telemetry.enabled():
+        # Trace-time: whole-block collective — every device sends its
+        # full shard each call (unlike the halo's edge slices).
+        telemetry.annotate(
+            "ring.exchange",
+            bytes=int(x.size) * x.dtype.itemsize,
+            shift=shift,
+        )
     n = axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis_name, perm)

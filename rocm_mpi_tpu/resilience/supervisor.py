@@ -21,10 +21,13 @@ is `utils/checkpoint.run_segmented` wrapped in a supervision loop:
     capped), exactly like the bench parent's child-retry policy;
   * attempts are bounded; exhaustion re-raises the last failure — a
     supervisor must never convert a persistent failure into silence;
-  * every decision emits a structured `utils.metrics` RunEvent
+  * every decision emits a structured telemetry event
     ("attempt-failed" / "backoff" / "restored" / "recovered" /
-    "gave-up"), so the retry history is machine-readable next to the
-    run's performance metrics.
+    "gave-up") — versioned, monotonic-stamped, written to the rank's
+    telemetry stream when collection is on (docs/TELEMETRY.md), and
+    still visible through the legacy `utils.metrics.events()` view —
+    so the retry history is machine-readable next to the run's
+    performance metrics.
 
 The advance contract is unchanged (`advance(state, n) -> state`, traced
 n) — supervision composes around the compiled program, never inside it.
@@ -34,8 +37,8 @@ from __future__ import annotations
 
 import time
 
+from rocm_mpi_tpu import telemetry
 from rocm_mpi_tpu.utils import checkpoint as ckpt
-from rocm_mpi_tpu.utils import metrics
 
 
 def default_retryable(exc: BaseException) -> bool:
@@ -107,7 +110,7 @@ def run_supervised(
         if start is None:
             return 0, cold_state()
         state = ckpt.restore_state(directory, start, init_state)
-        metrics.record_event("restored", step=start)
+        telemetry.record_event("restored", step=start)
         log(f"supervisor: restored step {start} from {directory}")
         return start, state
 
@@ -128,24 +131,24 @@ def run_supervised(
                     advance, state, nt, directory, every, start_step=start
                 )
             if recovered:
-                metrics.record_event("recovered", attempt=attempt, step=nt)
+                telemetry.record_event("recovered", attempt=attempt, step=nt)
             return final
         except BaseException as exc:  # noqa: BLE001 — classified below
             if not retryable(exc):
                 raise
             err = f"{type(exc).__name__}: {exc}"
-            metrics.record_event(
+            telemetry.record_event(
                 "attempt-failed", attempt=attempt, error=err
             )
             log(f"supervisor: attempt {attempt} failed — {err}")
             if attempt >= max_retries:
-                metrics.record_event(
+                telemetry.record_event(
                     "gave-up", attempt=attempt, error=err
                 )
                 log(f"supervisor: giving up after {attempt + 1} attempts")
                 raise
             wait = min(backoff_s * backoff_factor**attempt, backoff_max_s)
-            metrics.record_event("backoff", attempt=attempt, wait_s=wait)
+            telemetry.record_event("backoff", attempt=attempt, wait_s=wait)
             log(f"supervisor: retrying in {wait:.2f}s")
             sleep(wait)
             attempt += 1

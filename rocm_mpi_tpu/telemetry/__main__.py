@@ -1,0 +1,166 @@
+"""CLI: python -m rocm_mpi_tpu.telemetry {summarize,regress} …
+
+    summarize DIR [--json] [--out FILE] [--trace FILE]
+                  [--straggler-factor F]
+        Merge DIR's telemetry-rank*.jsonl streams; write the summary
+        (default DIR/telemetry-summary.json) and a Chrome trace (default
+        DIR/telemetry-trace.json, openable at ui.perfetto.dev); print a
+        human report (--json prints the summary document instead).
+        Exit 0 on success, 2 when DIR has no rank streams.
+
+    regress SUMMARY --baseline FILE [--tolerance F]
+        Gate SUMMARY (a summary file, or a run directory to summarize on
+        the fly) against a committed baseline. Exit 0 pass, 1 regression,
+        2 missing/unreadable inputs.
+
+    regress --check-schema FILE [FILE…]
+        Validate committed measurement artifacts (BASELINE.json,
+        MULTICHIP_r0*.json, mechanics/telemetry JSONLs, summaries) still
+        parse as a known format. Exit 0 ok, 1 problems.
+
+stdlib-only end to end: the read side of telemetry must run on machines
+that will never import jax (CI, a laptop holding a pod's stream).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+from rocm_mpi_tpu.telemetry import aggregate, regress, trace
+
+
+def _cmd_summarize(args) -> int:
+    streams, skipped = aggregate.load_rank_streams(args.dir)
+    if not streams:
+        print(
+            f"error: no telemetry-rank*.jsonl under {args.dir} "
+            "(run with --telemetry DIR, or RMT_TELEMETRY_DIR=DIR)",
+            file=sys.stderr,
+        )
+        return 2
+    summary = aggregate.summarize(streams, skipped, args.straggler_factor)
+    out = pathlib.Path(
+        args.out or pathlib.Path(args.dir) / "telemetry-summary.json"
+    )
+    aggregate.write_json_atomic(out, summary)
+    trace_path = pathlib.Path(
+        args.trace or pathlib.Path(args.dir) / "telemetry-trace.json"
+    )
+    trace.write_chrome_trace(streams, trace_path)
+    if args.json:
+        print(json.dumps(summary, indent=1))
+    else:
+        print(aggregate.format_summary(summary))
+        print(f"summary: {out}")
+        print(f"chrome trace: {trace_path} (open at ui.perfetto.dev)")
+    return 0
+
+
+def _cmd_regress(args) -> int:
+    if args.check_schema:
+        targets = [args.summary] if args.summary else []
+        targets += args.extra
+        if not targets:
+            print("error: --check-schema needs at least one file",
+                  file=sys.stderr)
+            return 2
+        problems = regress.check_schema(targets)
+        for p in problems:
+            print(p, file=sys.stderr)
+        if not problems:
+            print(f"schema check ok: {len(targets)} file(s)")
+        return 1 if problems else 0
+
+    if not args.summary or not args.baseline:
+        print("error: regress needs SUMMARY and --baseline FILE",
+              file=sys.stderr)
+        return 2
+    summary_path = pathlib.Path(args.summary)
+    if summary_path.is_dir():
+        summary = aggregate.summarize_dir(summary_path)
+        if not summary["ranks"]:
+            print(f"error: no telemetry streams under {summary_path}",
+                  file=sys.stderr)
+            return 2
+    else:
+        summary = regress.load_json(summary_path)
+        if summary is None:
+            print(f"error: cannot read summary {summary_path}",
+                  file=sys.stderr)
+            return 2
+    baseline = regress.load_json(args.baseline)
+    if baseline is None:
+        print(f"error: cannot read baseline {args.baseline}",
+              file=sys.stderr)
+        return 2
+    deltas = regress.compare(summary, baseline, args.tolerance)
+    if not deltas:
+        print(
+            "error: no comparable metrics between summary and baseline "
+            "(a gate that compares nothing must not pass)",
+            file=sys.stderr,
+        )
+        return 2
+    for d in deltas:
+        print(d.describe())
+    bad = regress.regressions(deltas)
+    if bad:
+        print(f"REGRESSION: {len(bad)}/{len(deltas)} metric(s) beyond "
+              f"{args.tolerance:.0%} tolerance", file=sys.stderr)
+        return 1
+    print(f"pass: {len(deltas)} metric(s) within "
+          f"{args.tolerance:.0%} tolerance")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m rocm_mpi_tpu.telemetry",
+        description="telemetry read side: merge rank streams, export "
+                    "Chrome traces, gate on perf baselines "
+                    "(docs/TELEMETRY.md)",
+    )
+    sub = parser.add_subparsers(dest="command")
+
+    p_sum = sub.add_parser("summarize", help="merge per-rank streams")
+    p_sum.add_argument("dir", help="directory of telemetry-rank*.jsonl")
+    p_sum.add_argument("--json", action="store_true",
+                       help="print the summary document instead of the "
+                            "human report")
+    p_sum.add_argument("--out", default=None, metavar="FILE",
+                       help="summary path (default DIR/telemetry-summary.json)")
+    p_sum.add_argument("--trace", default=None, metavar="FILE",
+                       help="Chrome trace path (default "
+                            "DIR/telemetry-trace.json)")
+    p_sum.add_argument("--straggler-factor", type=float,
+                       default=aggregate.DEFAULT_STRAGGLER_FACTOR,
+                       help="rank flagged when phase wall exceeds the "
+                            "median by this factor (default %(default)s)")
+
+    p_reg = sub.add_parser("regress", help="gate a summary vs a baseline")
+    p_reg.add_argument("summary", nargs="?", default=None,
+                       help="summary JSON (or run directory)")
+    p_reg.add_argument("extra", nargs="*", default=[],
+                       help="more files (--check-schema mode)")
+    p_reg.add_argument("--baseline", default=None, metavar="FILE")
+    p_reg.add_argument("--tolerance", type=float,
+                       default=regress.DEFAULT_TOLERANCE,
+                       help="allowed relative slip (default %(default)s)")
+    p_reg.add_argument("--check-schema", action="store_true",
+                       help="only validate the files parse as known "
+                            "measurement formats")
+
+    args = parser.parse_args(argv)
+    if args.command == "summarize":
+        return _cmd_summarize(args)
+    if args.command == "regress":
+        return _cmd_regress(args)
+    parser.print_usage(sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

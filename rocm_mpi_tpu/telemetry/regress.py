@@ -1,0 +1,203 @@
+"""Perf-regression gate: compare a run summary against a committed baseline.
+
+The round-3/4/5 measurement campaigns banked numbers as one-off JSON
+files (BASELINE.json, MULTICHIP_r0*.json, docs/*_mechanics_*.jsonl) with
+no machine that ever re-reads them — a regression was whatever a human
+happened to notice. This module closes the loop:
+
+    python -m rocm_mpi_tpu.telemetry regress SUMMARY --baseline BASE
+        exit 0  within tolerance (or better)
+        exit 1  regression: a metric moved the WRONG way by > tolerance
+        exit 2  missing/unreadable baseline or summary (never silently
+                passes — an absent baseline is a broken gate, not a green
+                one)
+
+Comparable metrics are extracted from the summary schema
+(aggregate.SUMMARY_SCHEMA) with an explicit direction each:
+
+    lower is better    steps.per_step_us.{mean,p50,p90,p99},
+                       phases.{halo,interior,checkpoint}.wall_s
+    higher is better   phases.halo.bytes_per_s, every numeric gauge
+                       (gauges are rates: gpts, t_eff — the driver metric)
+
+A baseline may be (a) a summary from a previous run — the normal flow:
+bank today's summary, gate tomorrow's run against it — or (b) a hand-flat
+``{"metrics": {name: {"value": v, "direction": "lower"|"higher"}}}``
+file for curated budgets. Improvements never fail the gate; only
+directional regressions beyond `tolerance` (default 20% — CPU-mechanics
+runs jitter; chip baselines can gate tighter) do.
+
+``--check-schema`` mode validates that committed measurement artifacts
+still parse and look like a format this repo knows (summary, BASELINE,
+MULTICHIP probe, mechanics/telemetry JSONL) — the cheap CI guard
+(scripts/lint.sh) against a hand-edit quietly bricking the gate's inputs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+
+DEFAULT_TOLERANCE = 0.20
+
+LOWER, HIGHER = "lower", "higher"
+
+
+@dataclasses.dataclass(frozen=True)
+class Delta:
+    """One compared metric; `regressed` when it moved the wrong way by
+    more than the tolerance."""
+
+    name: str
+    direction: str
+    baseline: float
+    current: float
+    change: float  # signed relative change, + = current larger
+    regressed: bool
+
+    def describe(self) -> str:
+        verdict = "REGRESSED" if self.regressed else "ok"
+        return (
+            f"{self.name} [{self.direction} is better]: "
+            f"{self.baseline:g} -> {self.current:g} "
+            f"({self.change:+.1%}) {verdict}"
+        )
+
+
+def extract_metrics(doc: dict) -> dict[str, tuple[float, str]]:
+    """{metric name: (value, direction)} from a summary or a flat
+    metrics file. Zero-valued summary entries are skipped: an unobserved
+    phase is absence of evidence, not a 0-second budget."""
+    out: dict[str, tuple[float, str]] = {}
+    if "metrics" in doc and isinstance(doc["metrics"], dict):
+        for name, spec in doc["metrics"].items():
+            if isinstance(spec, dict) and "value" in spec:
+                direction = spec.get("direction", LOWER)
+                if direction in (LOWER, HIGHER):
+                    try:
+                        out[name] = (float(spec["value"]), direction)
+                    except (TypeError, ValueError):
+                        pass
+        return out
+
+    steps = doc.get("steps", {})
+    for q, v in (steps.get("per_step_us") or {}).items():
+        if isinstance(v, (int, float)) and v > 0:
+            out[f"steps.per_step_us.{q}"] = (float(v), LOWER)
+    for ph, row in (doc.get("phases") or {}).items():
+        wall = row.get("wall_s")
+        if isinstance(wall, (int, float)) and wall > 0:
+            out[f"phases.{ph}.wall_s"] = (float(wall), LOWER)
+        bps = row.get("bytes_per_s")
+        if ph == "halo" and isinstance(bps, (int, float)) and bps > 0:
+            out["phases.halo.bytes_per_s"] = (float(bps), HIGHER)
+    for name, v in (doc.get("gauges") or {}).items():
+        if isinstance(v, (int, float)) and v > 0:
+            out[f"gauges.{name}"] = (float(v), HIGHER)
+    return out
+
+
+def compare(summary: dict, baseline: dict,
+            tolerance: float = DEFAULT_TOLERANCE) -> list[Delta]:
+    """Compare every metric present in BOTH documents. The baseline's
+    direction wins on disagreement (the committed gate is authoritative)."""
+    if tolerance < 0:
+        raise ValueError(f"tolerance must be >= 0, got {tolerance}")
+    cur = extract_metrics(summary)
+    base = extract_metrics(baseline)
+    deltas: list[Delta] = []
+    for name in sorted(set(cur) & set(base)):
+        b_val, direction = base[name]
+        c_val, _ = cur[name]
+        if b_val == 0:
+            continue
+        change = (c_val - b_val) / abs(b_val)
+        worse = change > tolerance if direction == LOWER \
+            else change < -tolerance
+        deltas.append(Delta(
+            name=name, direction=direction, baseline=b_val,
+            current=c_val, change=change, regressed=worse,
+        ))
+    return deltas
+
+
+def regressions(deltas: list[Delta]) -> list[Delta]:
+    return [d for d in deltas if d.regressed]
+
+
+def load_json(path) -> dict | None:
+    """Parse a JSON file; None on any failure (callers turn that into
+    exit 2 — a gate input that cannot be read must fail loudly)."""
+    try:
+        doc = json.loads(pathlib.Path(path).read_text())
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+# ---------------------------------------------------------------------------
+# --check-schema: recognize the repo's committed measurement formats
+# ---------------------------------------------------------------------------
+
+
+def _classify_json(doc: dict) -> str | None:
+    from rocm_mpi_tpu.telemetry.aggregate import SUMMARY_SCHEMA
+
+    if doc.get("schema") == SUMMARY_SCHEMA:
+        return "telemetry summary"
+    if "metrics" in doc and isinstance(doc["metrics"], dict):
+        return "flat metrics baseline"
+    if "metric" in doc and "north_star" in doc:
+        return "BASELINE.json north-star record"
+    if "n_devices" in doc and "rc" in doc:
+        return "multichip probe record"
+    if "metric" in doc:
+        return "bench/mechanics row"
+    return None
+
+
+def check_schema(paths) -> list[str]:
+    """Validate committed measurement artifacts. Returns problem strings
+    (empty = all recognized). `.jsonl` files are checked line-by-line;
+    `.json` files as one document."""
+    problems: list[str] = []
+    for raw in paths:
+        path = pathlib.Path(raw)
+        if not path.is_file():
+            problems.append(f"{raw}: missing")
+            continue
+        try:
+            text = path.read_text()
+        except OSError as e:
+            problems.append(f"{raw}: unreadable ({e})")
+            continue
+        if path.suffix == ".jsonl":
+            for i, line in enumerate(text.splitlines(), 1):
+                if not line.strip():
+                    continue
+                try:
+                    doc = json.loads(line)
+                except ValueError as e:
+                    problems.append(f"{raw}:{i}: bad JSON line ({e})")
+                    continue
+                if not isinstance(doc, dict) or not (
+                    "metric" in doc or ("kind" in doc and "v" in doc)
+                ):
+                    problems.append(
+                        f"{raw}:{i}: unrecognized JSONL record "
+                        "(want a mechanics row or a telemetry event)"
+                    )
+        else:
+            try:
+                doc = json.loads(text)
+            except ValueError as e:
+                problems.append(f"{raw}: bad JSON ({e})")
+                continue
+            if not isinstance(doc, dict) or _classify_json(doc) is None:
+                problems.append(
+                    f"{raw}: unrecognized schema (known: telemetry "
+                    "summary, flat metrics, BASELINE, multichip probe, "
+                    "bench row)"
+                )
+    return problems

@@ -1,0 +1,110 @@
+"""Chrome trace-event export: one track per rank, openable in Perfetto.
+
+Replaces the raw `docs/prof_trace_hide8192_r3`-style dumps with the
+standard trace-event JSON every Chrome/Perfetto build renders
+(https://ui.perfetto.dev, chrome://tracing). Mapping:
+
+* span   -> complete slice  (ph "X"): pid = rank, tid = recording thread,
+            ts/dur in microseconds; Perfetto nests slices on a track by
+            containment, which the per-thread span stack guarantees.
+* counter-> counter sample  (ph "C") on the rank's track.
+* gauge  -> counter sample  (ph "C") — a gauge is a one-point counter.
+* event  -> instant         (ph "i", scope "p"): retries/restores show as
+            pins on the rank that emitted them.
+* trace  -> process metadata: static per-program facts (bytes per halo
+            exchange) land in the rank's metadata args, not on the
+            timeline (they have no duration).
+
+Cross-rank alignment uses the records' WALL timestamps (`t`): each
+process's monotonic origin is arbitrary, so `t_mono` orders within a
+rank but cannot place ranks against each other. The trace origin is the
+earliest wall stamp across all ranks; NTP-grade skew between ranks on
+one host (the launcher case) is microseconds — fine for eyeballing halo
+waits. Durations come from `dur_s` (monotonic-derived), so slice widths
+never inherit wall-clock jumps. stdlib-only, like the whole read side.
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+TRACE_REQUIRED_KEYS = ("name", "ph", "ts", "pid")
+
+
+def to_chrome_trace(streams: dict[int, list[dict]]) -> dict:
+    """Build the trace-event document from per-rank record streams
+    (aggregate.load_rank_streams shape)."""
+    all_recs = [r for recs in streams.values() for r in recs]
+    wall_stamps = [r["t"] for r in all_recs if isinstance(r.get("t"),
+                                                          (int, float))]
+    origin = min(wall_stamps) if wall_stamps else 0.0
+
+    events: list[dict] = []
+    for rk in sorted(streams):
+        events.append({
+            "name": "process_name",
+            "ph": "M",
+            "pid": rk,
+            "ts": 0,
+            "args": {"name": f"rank {rk}"},
+        })
+        for rec in streams[rk]:
+            kind = rec.get("kind")
+            t = rec.get("t")
+            if not isinstance(t, (int, float)):
+                continue
+            ts = (t - origin) * 1e6
+            attrs = rec.get("attrs") or {}
+            if kind == "span":
+                events.append({
+                    "name": rec.get("name", "?"),
+                    "ph": "X",
+                    "ts": ts,
+                    "dur": max(float(rec.get("dur_s", 0.0)) * 1e6, 0.0),
+                    "pid": rk,
+                    "tid": rec.get("tid", 0),
+                    "args": attrs,
+                })
+            elif kind in ("counter", "gauge"):
+                events.append({
+                    "name": rec.get("name", "?"),
+                    "ph": "C",
+                    "ts": ts,
+                    "pid": rk,
+                    "args": {rec.get("name", "?"): rec.get("value", 0)},
+                })
+            elif kind == "event":
+                events.append({
+                    "name": rec.get("name", "?"),
+                    "ph": "i",
+                    "s": "p",
+                    "ts": ts,
+                    "pid": rk,
+                    "tid": rec.get("tid", 0),
+                    "args": {
+                        k: v for k, v in rec.items()
+                        if k in ("attempt", "step", "wait_s", "error")
+                    },
+                })
+            elif kind == "trace":
+                events.append({
+                    "name": f"traced:{rec.get('name', '?')}",
+                    "ph": "M",
+                    "pid": rk,
+                    "ts": 0,
+                    "args": attrs,
+                })
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"source": "rocm_mpi_tpu.telemetry"},
+    }
+
+
+def write_chrome_trace(streams: dict[int, list[dict]], path) -> dict:
+    """Export `streams` as trace-event JSON at `path`; returns the doc."""
+    from rocm_mpi_tpu.telemetry.aggregate import write_json_atomic
+
+    doc = to_chrome_trace(streams)
+    write_json_atomic(pathlib.Path(path), doc, indent=None)
+    return doc

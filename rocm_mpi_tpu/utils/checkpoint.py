@@ -51,6 +51,23 @@ import json
 import pathlib
 import zlib
 
+from rocm_mpi_tpu.telemetry import enabled as _telemetry_enabled
+from rocm_mpi_tpu.telemetry import span
+
+
+def _drain(state) -> None:
+    """Telemetry-enabled runs only: wait out in-flight compute on `state`
+    before a checkpoint span opens — jax dispatch is async, so without
+    the drain the save span would absorb whatever the donating advance
+    left running and report compute time as checkpoint I/O."""
+    if not _telemetry_enabled():
+        return
+    import jax
+
+    from rocm_mpi_tpu.utils.metrics import force
+
+    jax.tree_util.tree_map(force, state)
+
 
 class CheckpointCorruptionError(RuntimeError):
     """A checkpoint failed integrity validation (manifest mismatch)."""
@@ -166,6 +183,11 @@ def verify_step(directory, step: int) -> tuple[bool, str]:
     (ok, reason). A step with no manifest reports ok=False with reason
     'no manifest' — latest_valid_step decides the legacy policy.
     """
+    with span("checkpoint.validate", step=int(step)):
+        return _verify_step(directory, step)
+
+
+def _verify_step(directory, step: int) -> tuple[bool, str]:
     step_dir = _step_dir(directory, step)
     if not step_dir.is_dir():
         return False, f"step dir {step_dir} missing"
@@ -247,12 +269,14 @@ def save_state(directory, step: int, state, keep: int = 3) -> None:
     sharding) labeled by absolute step count, then record its manifest."""
     import orbax.checkpoint as ocp
 
-    mgr = _manager(directory, keep)
-    mgr.save(step, args=ocp.args.StandardSave(state))
-    mgr.wait_until_finished()
-    mgr.close()
-    write_manifest(directory, step, state)
-    _prune_stale_manifests(directory)
+    _drain(state)
+    with span("checkpoint.save", step=int(step)):
+        mgr = _manager(directory, keep)
+        mgr.save(step, args=ocp.args.StandardSave(state))
+        mgr.wait_until_finished()
+        mgr.close()
+        write_manifest(directory, step, state)
+        _prune_stale_manifests(directory)
 
 
 def restore_state(directory, step: int, like, verify: bool = True):
@@ -271,6 +295,11 @@ def restore_state(directory, step: int, like, verify: bool = True):
     such an array into a jitted advance produced garbage on this stack
     (measured; tests/test_resilience.py pins the safe behavior).
     """
+    with span("checkpoint.restore", step=int(step)):
+        return _restore_body(directory, step, like, verify)
+
+
+def _restore_body(directory, step, like, verify):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -356,10 +385,12 @@ def run_segmented(
             n = min(every, nt - step)
             state = advance(state, n)
             step += n
-            mgr.save(step, args=ocp.args.StandardSave(state))
-            mgr.wait_until_finished()
-            write_manifest(directory, step, state)
-            _prune_stale_manifests(directory)
+            _drain(state)
+            with span("checkpoint.save", step=step):
+                mgr.save(step, args=ocp.args.StandardSave(state))
+                mgr.wait_until_finished()
+                write_manifest(directory, step, state)
+                _prune_stale_manifests(directory)
             faults.fault_point("segment", step=step, directory=directory)
     finally:
         mgr.close()

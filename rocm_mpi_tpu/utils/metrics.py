@@ -17,17 +17,26 @@ actually waits. Measured: a 2.5 s computation "synced" with
 block_until_ready times at 0.000 s, with a scalar fetch at 2.49 s. The
 fetch costs one tiny transfer round-trip, which the caller amortizes by
 timing windows of many steps.
+
+Since the telemetry subsystem (rocm_mpi_tpu/telemetry/, docs/TELEMETRY.md)
+this module is the compatibility surface: the structured-event API
+(`record_event`/`events`/`clear_events`) is a thin shim over
+`telemetry.events`, and a *labeled* Timer feeds its interval into the
+telemetry stream. New code should prefer `telemetry.span(...)` directly —
+bare `tic()`/`toc()` remains supported for the models' measurement loops
+but is deprecated in apps, where raw timing is also lint-gated (graftlint
+GL06 flags `time.perf_counter()`/`time.time()` outside this module and
+telemetry/).
 """
 
 from __future__ import annotations
 
-import dataclasses
-import json
 import math
-import os
 import time
 
 import jax
+
+from rocm_mpi_tpu.telemetry import events as _tel
 
 
 def force(x):
@@ -46,16 +55,35 @@ def force(x):
 
 
 class Timer:
-    """tic/toc walltime timer (ImplicitGlobalGrid tic()/toc() analog)."""
+    """tic/toc walltime timer (ImplicitGlobalGrid tic()/toc() analog),
+    also usable as a context manager:
 
-    def __init__(self):
+        with Timer() as timer:
+            state = advance(state, n)   # sync yourself, or...
+            timer.toc(state)            # ...toc explicitly with sync args
+        wtime = timer.elapsed
+
+    __exit__ calls toc() only when the body didn't — an explicit
+    toc(*sync) inside the block keeps the device-fetch sync semantics and
+    wins over the exit stamp. A `label` routes the measured interval into
+    the telemetry stream as a span record (phase attribution for code
+    that already times with Timer), with `attrs` carried along; unlabeled
+    timers stay telemetry-silent, exactly as before.
+    """
+
+    def __init__(self, label: str | None = None, **attrs):
         self._t0 = None
+        self._t0_wall = None
         self.elapsed = None
+        self.label = label
+        self.attrs = attrs
 
     def tic(self, *sync):
         """Start timing. Pass device arrays to sync on first."""
         for x in sync:
             force(x)
+        self.elapsed = None
+        self._t0_wall = time.time()
         self._t0 = time.perf_counter()
 
     def toc(self, *sync) -> float:
@@ -65,7 +93,33 @@ class Timer:
         if self._t0 is None:
             raise RuntimeError("toc() before tic()")
         self.elapsed = time.perf_counter() - self._t0
+        if self.label is not None and _tel.enabled():
+            from rocm_mpi_tpu.telemetry.spans import span_record
+
+            span_record(self.label, self._t0_wall, self.elapsed,
+                        **self.attrs)
         return self.elapsed
+
+    def __enter__(self):
+        self.tic()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self.elapsed is None and self._t0 is not None:
+            if exc_type is None:
+                self.toc()
+            else:
+                # A failing body still gets its interval recorded (no
+                # sync — there may be nothing coherent to sync on): the
+                # hours a run burned before the supervisor gave up must
+                # show in the stream, error-flagged like a failed span.
+                self.elapsed = time.perf_counter() - self._t0
+                if self.label is not None and _tel.enabled():
+                    from rocm_mpi_tpu.telemetry.spans import span_record
+
+                    span_record(self.label, self._t0_wall, self.elapsed,
+                                error=exc_type.__name__, **self.attrs)
+        return False
 
 
 def wtime_per_it(wtime: float, nt: int, warmup: int = 10) -> float:
@@ -91,15 +145,20 @@ def gpts_per_s(shape, wtime_it: float) -> float:
 
 
 # ---------------------------------------------------------------------------
-# Structured run events (resilience layer, docs/RESILIENCE.md §2).
+# Structured run events — a compatibility shim over telemetry.events.
 #
-# The supervisor's retry/backoff decisions must leave a machine-readable
-# trail — "the run recovered twice" is an operational fact the same way
-# T_eff is a performance fact. Events accumulate in-process (the tests'
-# and supervisor-caller's view) and, when RMT_EVENT_LOG names a path,
-# append as JSON lines (the post-mortem view: the file survives the
-# process the way the chip watcher's log survived the outage rounds).
+# The PR-1 resilience layer introduced this API; the telemetry subsystem
+# now owns the storage (versioned records, per-rank JSONL writers,
+# RMT_EVENT_LOG legacy tee — rocm_mpi_tpu/telemetry/events.py). The
+# RunEvent view below preserves every pre-telemetry caller (tests,
+# supervisor post-mortems) while new fields — the satellite fixes —
+# ride along: `t_mono` (monotonic, orders events within a rank; the old
+# wall-only stamp couldn't) and `v` (the event-schema version the old
+# lines lacked).
 # ---------------------------------------------------------------------------
+
+import dataclasses  # noqa: E402  (grouped with the shim it serves)
+import json  # noqa: E402
 
 
 @dataclasses.dataclass(frozen=True)
@@ -107,11 +166,13 @@ class RunEvent:
     """One structured resilience event (retry, restore, give-up...)."""
 
     kind: str            # e.g. "attempt-failed", "backoff", "restored"
-    t: float             # time.time() at emission
+    t: float             # wall time at emission (comparable across ranks)
     attempt: int | None = None
     step: int | None = None
     wait_s: float | None = None
     error: str | None = None
+    t_mono: float | None = None  # monotonic stamp (ordering within a rank)
+    v: int = _tel.SCHEMA_VERSION
 
     def to_json(self) -> str:
         return json.dumps(
@@ -120,33 +181,32 @@ class RunEvent:
         )
 
 
-_EVENTS: list[RunEvent] = []
+def _as_run_event(rec: dict) -> RunEvent:
+    return RunEvent(
+        kind=rec["name"], t=rec["t"], attempt=rec.get("attempt"),
+        step=rec.get("step"), wait_s=rec.get("wait_s"),
+        error=rec.get("error"), t_mono=rec.get("t_mono"),
+        v=rec.get("v", _tel.SCHEMA_VERSION),
+    )
 
 
 def record_event(kind: str, *, attempt=None, step=None, wait_s=None,
                  error=None) -> RunEvent:
-    """Append a structured event; best-effort tee to RMT_EVENT_LOG."""
-    ev = RunEvent(
-        kind=kind, t=time.time(), attempt=attempt, step=step,
-        wait_s=wait_s, error=error,
-    )
-    _EVENTS.append(ev)
-    path = os.environ.get("RMT_EVENT_LOG")
-    if path:
-        try:
-            with open(path, "a") as fh:
-                fh.write(ev.to_json() + "\n")
-        except OSError:
-            pass  # the event log must never be what kills a run
-    return ev
+    """Append a structured event (telemetry stream + RMT_EVENT_LOG tee)."""
+    rec = _tel.record_event(kind, attempt=attempt, step=step,
+                            wait_s=wait_s, error=error)
+    return _as_run_event(rec)
 
 
 def events(kind: str | None = None) -> list[RunEvent]:
     """The in-process event trail (optionally filtered by kind)."""
-    if kind is None:
-        return list(_EVENTS)
-    return [e for e in _EVENTS if e.kind == kind]
+    return [
+        _as_run_event(r)
+        for r in _tel.records(kind="event", name=kind)
+    ]
 
 
 def clear_events() -> None:
-    _EVENTS.clear()
+    """Drop the event trail only — buffered spans/gauges and the
+    trace-annotation dedup state belong to telemetry and survive."""
+    _tel.clear(kind="event")

@@ -95,6 +95,25 @@ run_headline() {
   fi
 }
 
+archive_telemetry() {
+  # Any measurement child that ran with telemetry (--telemetry DIR or
+  # RMT_TELEMETRY_DIR, docs/TELEMETRY.md) left per-rank JSONL streams;
+  # bank them next to the watcher's other logs so a mid-watch flap can't
+  # lose the only per-phase attribution of a healthy window. cp -p keeps
+  # re-archiving idempotent (append-only files, newest copy wins).
+  local tdir="${RMT_TELEMETRY_DIR:-$PWD/output/telemetry}"
+  [ -d "$tdir" ] || return 0
+  local found=0 f
+  for f in "$tdir"/telemetry-rank*.jsonl "$tdir"/telemetry-summary.json \
+           "$tdir"/telemetry-trace.json; do
+    [ -s "$f" ] || continue
+    mkdir -p docs/telemetry_r5
+    cp -p "$f" docs/telemetry_r5/ && found=$((found + 1))
+  done
+  [ "$found" -gt 0 ] && echo "[watcher] archived $found telemetry file(s) into docs/telemetry_r5/"
+  return 0
+}
+
 group_log() { echo "docs/tpu_tier_${1}_r5.txt"; }
 
 group_done() {
@@ -182,6 +201,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     bash scripts/run_chip_queue.sh
     queue_rc=$?
     run_tier_groups
+    archive_telemetry
     if headline_done && [ "$queue_rc" -eq 0 ] && tier_done; then
       # Don't stop at the first healthy window otherwise: a mid-queue flap
       # leaves INCOMPLETE artifacts, and the skip-complete logic makes

@@ -3,13 +3,26 @@
 # (rule catalog: docs/ANALYSIS.md; engine: rocm_mpi_tpu/analysis/).
 #
 # Fast (<5 s, stdlib-only AST walk) — run it BEFORE the test suite: it
-# catches the donation-race / trace-purity / compat-drift bug classes that
-# unit tests only see under the exact interleaving that bites.
+# catches the donation-race / trace-purity / compat-drift / raw-timing
+# bug classes that unit tests only see under the exact interleaving that
+# bites.
 #
-# Exit codes: 0 clean, 1 non-suppressed findings, 2 usage/internal error.
-# Extra args pass through (e.g. scripts/lint.sh --json, --select GL03).
+# Also validates the committed measurement baselines still parse as known
+# formats (telemetry regress --check-schema, docs/TELEMETRY.md): a
+# hand-edited BASELINE/MULTICHIP file must fail here, not silently brick
+# the perf-regression gate that reads it.
+#
+# Exit codes: 0 clean, 1 non-suppressed findings or schema problems,
+# 2 usage/internal error. Extra args pass through to the analyzer
+# (e.g. scripts/lint.sh --json, --select GL03).
 set -u
 cd "$(dirname "$0")/.."
 # The gate never needs a device and must not hang on a flaky chip tunnel.
-exec env JAX_PLATFORMS=cpu python -m rocm_mpi_tpu.analysis \
-  rocm_mpi_tpu apps bench.py "$@"
+env JAX_PLATFORMS=cpu python -m rocm_mpi_tpu.analysis \
+  rocm_mpi_tpu apps bench.py "$@" || exit $?
+# Schema stage's ok-line goes to stderr so `scripts/lint.sh --json | jq`
+# (the documented analyzer usage) still receives pure JSON on stdout;
+# problems already print to stderr.
+exec env JAX_PLATFORMS=cpu python -m rocm_mpi_tpu.telemetry regress \
+  --check-schema BASELINE.json MULTICHIP_r0*.json \
+  docs/weak_scaling_*mechanics*.jsonl 1>&2

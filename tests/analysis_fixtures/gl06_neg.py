@@ -1,0 +1,39 @@
+"""GL06 true negative: the sanctioned idioms — telemetry spans, a
+labeled Timer, monotonic deadlines, and sleeps — plus non-time lookalikes."""
+
+import time
+
+from rocm_mpi_tpu import telemetry
+from rocm_mpi_tpu.utils import metrics
+
+
+def timed_run(advance, state, n):
+    with telemetry.span("step_window", phase="step", steps=n) as sp:
+        state = advance(state, n)
+        sp.sync(state)
+    return state
+
+
+def timed_run_timer(advance, state, n):
+    timer = metrics.Timer(label="step_window", steps=n)
+    timer.tic(state)
+    state = advance(state, n)
+    timer.toc(state)
+    return state, timer.elapsed
+
+
+def budget_loop(work, budget_s):
+    # Deadline control flow, not measurement: monotonic is the right tool.
+    deadline = time.monotonic() + budget_s
+    while time.monotonic() < deadline:
+        work()
+        time.sleep(0.1)
+
+
+class Clock:
+    def time(self):
+        return 0.0
+
+
+def not_the_time_module(clock: Clock):
+    return clock.time()  # attribute named `time` on a non-module object

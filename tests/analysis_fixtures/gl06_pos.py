@@ -1,0 +1,19 @@
+"""GL06 true positive: raw timing in non-owner code, all four spellings."""
+
+import time
+from time import perf_counter
+from time import time as walltime
+
+
+def timed_run(advance, state, n):
+    t0 = time.perf_counter()        # GL06: module-attribute spelling
+    state = advance(state, n)
+    wtime = time.perf_counter() - t0
+    stamp = time.time()             # GL06: wall-clock spelling
+    return state, wtime, stamp
+
+
+def timed_run_from_imports(advance, state, n):
+    t0 = perf_counter()             # GL06: from-import alias
+    state = advance(state, n)
+    return state, perf_counter() - t0, walltime()  # GL06 ×2

@@ -1,0 +1,439 @@
+"""Telemetry subsystem tests (docs/TELEMETRY.md): span semantics and
+disabled-mode cost, the versioned JSONL schema round-trip, multi-rank
+aggregation with a straggler, Chrome-trace validity, the regression CLI's
+exit-code contract, and the end-to-end 2-rank weak-scaling acceptance run
+(per-rank streams -> merged summary with halo/interior/checkpoint
+attribution -> openable trace)."""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import sys
+import time
+
+import pytest
+
+from rocm_mpi_tpu.telemetry import aggregate, events, regress, trace
+from rocm_mpi_tpu.telemetry.__main__ import main as cli_main
+from rocm_mpi_tpu.utils import metrics
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _isolated_telemetry(monkeypatch):
+    """Every test starts disabled, sink-less, buffer-empty; monkeypatch
+    restores whatever the ambient process config was."""
+    monkeypatch.setattr(events, "_ENABLED", False)
+    monkeypatch.setattr(events, "_DIR", None)
+    monkeypatch.setattr(events, "_RANK", None)
+    events.clear()
+    yield
+    events.clear()
+
+
+# ---------------------------------------------------------------------------
+# Spans: nesting, sync, disabled-mode overhead
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_and_jsonl_roundtrip(tmp_path):
+    from rocm_mpi_tpu.telemetry import span
+
+    events.configure(directory=tmp_path, rank=3)
+    with span("outer", phase="step", steps=4) as outer:
+        with span("inner.detail") as inner:
+            inner.set(bytes=128)
+        outer.set(note="done")
+    events.counter("halo.bytes", 4096)
+    events.gauge("run.gpts", 1.25)
+
+    path = tmp_path / "telemetry-rank3.jsonl"
+    assert path.is_file(), "one writer per rank, named by rank"
+    recs = [json.loads(line) for line in path.read_text().splitlines()]
+    assert all(r["v"] == events.SCHEMA_VERSION for r in recs)
+    assert all(r["rank"] == 3 for r in recs)
+    by_name = {r["name"]: r for r in recs}
+    assert by_name["inner.detail"]["depth"] == 1
+    assert by_name["outer"]["depth"] == 0
+    assert by_name["inner.detail"]["attrs"]["bytes"] == 128
+    assert by_name["outer"]["attrs"]["note"] == "done"
+    # inner closed first and fits inside outer
+    assert by_name["inner.detail"]["dur_s"] <= by_name["outer"]["dur_s"]
+    assert by_name["halo.bytes"]["kind"] == "counter"
+    assert by_name["run.gpts"]["value"] == 1.25
+    # the buffer view matches the file view
+    assert len(events.records()) == len(recs)
+
+
+def test_disabled_spans_are_noop_and_cheap(tmp_path):
+    from rocm_mpi_tpu.telemetry import span
+
+    assert not events.enabled()
+    t0 = time.monotonic()
+    for _ in range(20_000):
+        with span("hot.loop", steps=1) as sp:
+            sp.sync(object())  # must NOT force/fetch when disabled
+    elapsed = time.monotonic() - t0
+    assert events.records() == [], "disabled spans must record nothing"
+    assert not list(tmp_path.iterdir())
+    # 20k disabled spans in well under a second — the near-zero-overhead
+    # contract (generous cap for slow CI boxes).
+    assert elapsed < 2.0, f"20k disabled spans took {elapsed:.2f}s"
+
+
+def test_span_records_error_flag(tmp_path):
+    from rocm_mpi_tpu.telemetry import span
+
+    events.configure(directory=tmp_path, rank=0)
+    with pytest.raises(ValueError):
+        with span("failing"):
+            raise ValueError("boom")
+    (rec,) = events.records(kind="span")
+    assert rec["error"] == "ValueError"
+
+
+# ---------------------------------------------------------------------------
+# Satellite: versioned + monotonic record_event, Timer context manager
+# ---------------------------------------------------------------------------
+
+
+def test_record_event_is_versioned_and_monotonic():
+    a = metrics.record_event("attempt-failed", attempt=0, error="x")
+    b = metrics.record_event("backoff", attempt=0, wait_s=0.5)
+    assert a.v == events.SCHEMA_VERSION == 2
+    assert isinstance(a.t_mono, float)
+    assert b.t_mono > a.t_mono, "monotonic stamps order events in-rank"
+    assert [e.kind for e in metrics.events()] == ["attempt-failed",
+                                                 "backoff"]
+    assert metrics.events("backoff")[0].wait_s == 0.5
+    doc = json.loads(b.to_json())
+    assert doc["v"] == 2 and "t_mono" in doc
+    metrics.clear_events()
+    assert metrics.events() == []
+
+
+def test_events_flow_into_rank_stream_when_enabled(tmp_path):
+    events.configure(directory=tmp_path, rank=1)
+    metrics.record_event("restored", step=16)
+    line = json.loads(
+        (tmp_path / "telemetry-rank1.jsonl").read_text().splitlines()[0]
+    )
+    assert line["kind"] == "event" and line["name"] == "restored"
+    assert line["step"] == 16 and line["v"] == 2
+
+
+def test_timer_context_manager_and_label(tmp_path):
+    with metrics.Timer() as t:
+        time.sleep(0.01)
+    assert t.elapsed and t.elapsed >= 0.008
+    # explicit toc inside the block wins over the exit stamp
+    with metrics.Timer() as t2:
+        time.sleep(0.01)
+        t2.toc()
+        marked = t2.elapsed
+        time.sleep(0.01)
+    assert t2.elapsed == marked
+    with pytest.raises(RuntimeError):
+        metrics.Timer().toc()
+    # a labeled timer feeds the telemetry stream
+    events.configure(directory=tmp_path, rank=0)
+    with metrics.Timer(label="step_window", phase="step", steps=5):
+        time.sleep(0.005)
+    (rec,) = events.records(kind="span")
+    assert rec["name"] == "step_window"
+    assert rec["attrs"]["steps"] == 5
+    assert rec["dur_s"] >= 0.004
+
+
+# ---------------------------------------------------------------------------
+# Aggregation: merge, phases, percentiles, stragglers
+# ---------------------------------------------------------------------------
+
+
+def _span_rec(name, dur_s, rank, t=1000.0, **attrs):
+    rec = {"v": 2, "kind": "span", "name": name, "t": t,
+           "t_mono": t, "rank": rank, "dur_s": dur_s, "depth": 0, "tid": 1}
+    if attrs:
+        rec["attrs"] = attrs
+    return rec
+
+
+def _two_rank_streams():
+    fast = [
+        _span_rec("step_window", 0.010, 0, t=1000.0 + i, steps=10,
+                  phase="step")
+        for i in range(4)
+    ]
+    slow = [
+        _span_rec("step_window", 0.040, 1, t=1000.0 + i, steps=10,
+                  phase="step")
+        for i in range(4)
+    ]
+    halo = [
+        _span_rec("halo.probe", 0.002, r, t=1010.0, phase="halo",
+                  bytes=1 << 20)
+        for r in (0, 1)
+    ]
+    interior = [
+        _span_rec("interior.probe", 0.004, r, t=1011.0, phase="interior")
+        for r in (0, 1)
+    ]
+    ckpt = [_span_rec("checkpoint.save", 0.05, 0, t=1012.0, step=40)]
+    ev = [{"v": 2, "kind": "event", "name": "backoff", "t": 1001.0,
+           "t_mono": 1.0, "rank": 1, "attempt": 0, "wait_s": 0.5}]
+    gauge = [{"v": 2, "kind": "gauge", "name": "run.gpts", "t": 1013.0,
+              "t_mono": 2.0, "rank": 0, "value": 2.5}]
+    return {0: fast + [halo[0], interior[0]] + ckpt + gauge,
+            1: slow + [halo[1], interior[1]] + ev}
+
+
+def test_multi_rank_aggregation_detects_straggler():
+    streams = _two_rank_streams()
+    s = aggregate.summarize(streams)
+    assert s["ranks"] == [0, 1]
+    for phase in aggregate.CANONICAL_PHASES:
+        assert phase in s["phases"], "canonical phases always present"
+    assert s["phases"]["halo"]["bytes"] == 2 << 20
+    assert s["phases"]["halo"]["bytes_per_s"] > 0
+    assert s["phases"]["checkpoint"]["wall_s"] == pytest.approx(0.05)
+    assert s["steps"]["count"] == 80 and s["steps"]["windows"] == 8
+    # rank 1's windows are 4x slower: p90 reflects the slow rank and the
+    # straggler detector names it in the step phase
+    assert s["steps"]["per_step_us"]["p90"] >= 4000 * 0.9
+    assert any(
+        st["rank"] == 1 and st["phase"] == "step" and st["ratio"] > 1.5
+        for st in s["stragglers"]
+    ), s["stragglers"]
+    assert s["events"] == {"backoff": 1}
+    assert s["gauges"]["run.gpts"] == 2.5
+
+
+def test_gauges_reduce_to_cross_rank_median():
+    """Per-rank copies of a rung's gauge must merge to the median, not
+    whichever rank sorts last — one straggler must not become the gate's
+    whole view of the rung."""
+    def g(rank, value):
+        return {"v": 2, "kind": "gauge", "name": "run.gpts", "t": 1.0,
+                "t_mono": 1.0, "rank": rank, "value": value,
+                "attrs": {"devices": 4}}
+
+    s = aggregate.summarize({0: [g(0, 1.0)], 1: [g(1, 1.1)],
+                             2: [g(2, 1.2)], 3: [g(3, 9.9)]})
+    assert s["gauges"]["run.gpts@4dev"] == pytest.approx(1.15)
+    assert len(s["gauge_series"]) == 4
+
+
+def test_timer_cm_records_failed_interval(tmp_path):
+    events.configure(directory=tmp_path, rank=0)
+    with pytest.raises(RuntimeError):
+        with metrics.Timer(label="run.checkpointed", steps=100) as t:
+            time.sleep(0.005)
+            raise RuntimeError("backend gone")
+    assert t.elapsed and t.elapsed >= 0.004
+    (rec,) = events.records(kind="span")
+    assert rec["name"] == "run.checkpointed"
+    assert rec["error"] == "RuntimeError"
+    assert rec["dur_s"] >= 0.004
+
+
+def test_windowed_run_rejects_degenerate_windows(tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "weak_scaling_for_test", REPO / "apps" / "weak_scaling.py"
+    )
+    ws = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ws)
+
+    class _FakeModel:
+        def advance_fn(self, variant):  # pragma: no cover - never reached
+            raise AssertionError("validation must fire first")
+
+    events.configure(directory=tmp_path, rank=0)
+    with pytest.raises(ValueError, match="warmup"):
+        ws.telemetry_windowed_run(_FakeModel(), "hide", nt=200,
+                                  warmup=200, windows=4)
+
+
+def test_load_rank_streams_skips_torn_lines(tmp_path):
+    good = json.dumps(_span_rec("step_window", 0.01, 0, steps=5))
+    (tmp_path / "telemetry-rank0.jsonl").write_text(
+        good + "\n" + '{"kind": "span", "name": "torn'  # killed mid-write
+    )
+    streams, skipped = aggregate.load_rank_streams(tmp_path)
+    assert len(streams[0]) == 1 and skipped == 1
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace
+# ---------------------------------------------------------------------------
+
+
+def test_chrome_trace_is_valid_and_complete(tmp_path):
+    streams = _two_rank_streams()
+    doc = trace.write_chrome_trace(streams, tmp_path / "trace.json")
+    parsed = json.loads((tmp_path / "trace.json").read_text())
+    assert parsed == doc
+    assert isinstance(parsed["traceEvents"], list) and parsed["traceEvents"]
+    for ev in parsed["traceEvents"]:
+        for key in trace.TRACE_REQUIRED_KEYS:
+            assert key in ev, (key, ev)
+    pids = {ev["pid"] for ev in parsed["traceEvents"]}
+    assert pids == {0, 1}, "one track per rank"
+    xs = [e for e in parsed["traceEvents"] if e["ph"] == "X"]
+    assert xs and all(e["dur"] >= 0 and e["ts"] >= 0 for e in xs)
+    assert any(e["ph"] == "i" for e in parsed["traceEvents"]), \
+        "resilience events appear as instants"
+
+
+# ---------------------------------------------------------------------------
+# Regression CLI: exit codes on pass / fail / missing baseline
+# ---------------------------------------------------------------------------
+
+
+def _write_summary(path, scale=1.0):
+    streams = {
+        0: [_span_rec("step_window", 0.010 * scale, 0, t=1000.0 + i,
+                      steps=10, phase="step") for i in range(4)]
+        + [{"v": 2, "kind": "gauge", "name": "run.gpts", "t": 1013.0,
+            "t_mono": 2.0, "rank": 0, "value": 2.5 / scale}],
+    }
+    path.write_text(json.dumps(aggregate.summarize(streams)))
+    return path
+
+
+def test_regress_cli_exit_codes(tmp_path, capsys):
+    base = _write_summary(tmp_path / "base.json")
+    same = _write_summary(tmp_path / "same.json")
+    slow = _write_summary(tmp_path / "slow.json", scale=2.0)
+
+    assert cli_main(["regress", str(base), "--baseline", str(base)]) == 0
+    assert cli_main(["regress", str(same), "--baseline", str(base)]) == 0
+    assert cli_main(["regress", str(slow), "--baseline", str(base)]) == 1
+    out = capsys.readouterr()
+    assert "REGRESSED" in out.out and "REGRESSION" in out.err
+    # a 2x slowdown passes a sufficiently lax gate
+    assert cli_main(["regress", str(slow), "--baseline", str(base),
+                     "--tolerance", "1.5"]) == 0
+    # missing baseline: exit 2, never a silent pass
+    assert cli_main(["regress", str(same), "--baseline",
+                     str(tmp_path / "nope.json")]) == 2
+    assert cli_main(["regress", str(tmp_path / "nope.json"),
+                     "--baseline", str(base)]) == 2
+    assert cli_main(["regress"]) == 2
+
+
+def test_regress_direction_higher_is_better(tmp_path):
+    """A gpts gauge going UP must not read as a regression, and going
+    down must."""
+    base = json.loads(_write_summary(tmp_path / "b.json").read_text())
+    better = json.loads(json.dumps(base))
+    better["gauges"]["run.gpts"] = base["gauges"]["run.gpts"] * 3
+    better["steps"] = {"count": 0, "windows": 0, "wall_s": 0,
+                       "per_step_us": {}}
+    better["phases"] = {}
+    deltas = regress.compare(better, base)
+    assert not regress.regressions(deltas)
+    worse = json.loads(json.dumps(better))
+    worse["gauges"]["run.gpts"] = base["gauges"]["run.gpts"] / 3
+    assert regress.regressions(regress.compare(worse, base))
+
+
+def test_check_schema_on_committed_baselines(tmp_path, capsys):
+    committed = [str(REPO / "BASELINE.json"),
+                 str(REPO / "MULTICHIP_r01.json")]
+    jsonl = sorted(
+        str(p) for p in (REPO / "docs").glob("weak_scaling_*_r3.jsonl")
+    )[:1]
+    assert cli_main(["regress", "--check-schema", *committed, *jsonl]) == 0
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert cli_main(["regress", "--check-schema", str(bad)]) == 1
+    assert cli_main(["regress", "--check-schema",
+                     str(tmp_path / "missing.json")]) == 1
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# summarize CLI
+# ---------------------------------------------------------------------------
+
+
+def test_summarize_cli_writes_summary_and_trace(tmp_path, capsys):
+    from rocm_mpi_tpu.telemetry import span
+
+    events.configure(directory=tmp_path, rank=0)
+    with span("step_window", phase="step", steps=10):
+        time.sleep(0.002)
+    with span("halo.probe", phase="halo", bytes=2048):
+        time.sleep(0.001)
+    assert cli_main(["summarize", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "phase" in out and "halo" in out
+    summary = json.loads((tmp_path / "telemetry-summary.json").read_text())
+    assert summary["schema"] == aggregate.SUMMARY_SCHEMA
+    assert summary["phases"]["halo"]["bytes"] == 2048
+    parsed = json.loads((tmp_path / "telemetry-trace.json").read_text())
+    assert parsed["traceEvents"]
+    # an empty dir is exit 2 (nothing to summarize is not success)
+    assert cli_main(["summarize", str(tmp_path / "empty")]) == 2
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: 2-rank weak_scaling run -> merged summary + trace
+# ---------------------------------------------------------------------------
+
+
+def test_two_rank_weak_scaling_telemetry_end_to_end(tmp_path, capsys):
+    """The ISSUE-3 acceptance drill: a real 2-process gloo weak-scaling
+    run with --telemetry via the launcher; the merged summary must
+    attribute halo / interior / checkpoint wall time and export a valid
+    Chrome trace."""
+    from rocm_mpi_tpu.parallel.launcher import spawn_ranks
+
+    tel_dir = tmp_path / "telemetry"
+    results = spawn_ranks(
+        [
+            REPO / "apps" / "weak_scaling.py",
+            "--cpu-devices", "1", "--local", "16", "--nt", "24",
+            "--warmup", "4", "--counts", "2", "--dtype", "f32",
+            "--telemetry-windows", "4",
+        ],
+        nprocs=2,
+        timeout=300,
+        telemetry_dir=tel_dir,
+    )
+    for i, (proc, (out, err)) in enumerate(results):
+        assert proc.returncode == 0, f"rank {i} rc={proc.returncode}:" \
+                                     f"\n{out}\n{err}"
+    assert (tel_dir / "telemetry-rank0.jsonl").is_file()
+    assert (tel_dir / "telemetry-rank1.jsonl").is_file()
+    # the launcher merged at exit...
+    merged = json.loads((tel_dir / "telemetry-summary.json").read_text())
+    assert merged["ranks"] == [0, 1]
+    assert any("telemetry: merged" in n for n in results.report.events)
+    # ...and the CLI reproduces it with per-phase attribution
+    assert cli_main(["summarize", str(tel_dir)]) == 0
+    capsys.readouterr()
+    summary = json.loads((tel_dir / "telemetry-summary.json").read_text())
+    phases = summary["phases"]
+    for phase in ("halo", "interior", "checkpoint"):
+        assert phases[phase]["wall_s"] > 0, (phase, phases)
+    assert phases["halo"]["bytes"] > 0
+    assert summary["steps"]["windows"] >= 4
+    assert summary["steps"]["per_step_us"]["p50"] > 0
+    assert summary["traced"].get("halo.exchange", {}).get("bytes", 0) > 0
+    trace_doc = json.loads((tel_dir / "telemetry-trace.json").read_text())
+    pids = {e["pid"] for e in trace_doc["traceEvents"]}
+    assert pids == {0, 1}
+    # the banked summary gates cleanly against itself — the regress
+    # half of the acceptance criterion
+    assert cli_main([
+        "regress", str(tel_dir / "telemetry-summary.json"),
+        "--baseline", str(tel_dir / "telemetry-summary.json"),
+    ]) == 0
+    capsys.readouterr()
