@@ -93,6 +93,7 @@ def make_parser(
         help="use deep-halo sweeps: exchange width-K ghosts every K steps "
         "instead of width-1 every step (parallel.deep_halo; f32/bf16)",
     )
+    add_driver_flag(p)
     p.add_argument(
         "--save-field", default=None, metavar="PATH.npy",
         help="dump the final gathered field as .npy (process 0)",
@@ -100,6 +101,21 @@ def make_parser(
     add_telemetry_flag(p)
     add_checkpoint_flags(p)
     return p
+
+
+def add_driver_flag(p) -> None:
+    """The shared --driver knob: which multi-step loop form runs the
+    per-step variants. "scan" (default) is the donation-aware lax.scan
+    driver (models.*.scan_advance_fn — allocation-free steady state);
+    "step" the classic per-step fori_loop. Results are bitwise identical;
+    telemetry stamps the form so summaries from different drivers can't
+    be compared silently."""
+    p.add_argument(
+        "--driver", default="scan", choices=["step", "scan"],
+        help="multi-step loop form for per-step variants (default: scan, "
+        "the donation-aware lax.scan driver); --deep and --checkpoint "
+        "schedules have their own loop forms and ignore this",
+    )
 
 
 def add_telemetry_flag(p) -> None:
@@ -373,16 +389,21 @@ def build_config(args):
     return cfg
 
 
-def emit_run_gauges(result, variant: str) -> None:
+def emit_run_gauges(result, variant: str, driver: str | None = None) -> None:
     """Bank the run's headline rates into the telemetry stream (no-op
     when collection is off; rate properties divide by the timed window,
-    so a fully-resumed nt=0 run emits nothing)."""
+    so a fully-resumed nt=0 run emits nothing). `driver` stamps the loop
+    form (step/scan) on the gauges so summaries from different drivers
+    can't be compared silently."""
     from rocm_mpi_tpu import telemetry
 
     if not telemetry.enabled() or not result.nt or not result.wtime:
         return
-    telemetry.gauge("run.gpts", result.gpts, variant=variant)
-    telemetry.gauge("run.t_eff_gbs", result.t_eff, variant=variant)
+    attrs = {"variant": variant}
+    if driver is not None:
+        attrs["driver"] = driver
+    telemetry.gauge("run.gpts", result.gpts, **attrs)
+    telemetry.gauge("run.t_eff_gbs", result.t_eff, **attrs)
 
 
 def run_app(variant: str, args) -> int:
@@ -450,11 +471,17 @@ def run_app(variant: str, args) -> int:
         emit_run_gauges(result, variant)
     else:
         log0("Starting the time loop 🚀...", end="")
+        driver = getattr(args, "driver", "step")
         with profile_ctx:
             if getattr(args, "deep", 0):
+                # The deep schedule is its own loop form (k-step sweeps);
+                # --driver selects among the per-step loop forms only.
+                # Stamp "deep" — the same spelling weak_scaling uses — so
+                # the two harnesses' gauges land under one key.
                 result = model.run_deep(block_steps=args.deep)
+                driver = "deep"
             else:
-                result = model.run(variant=variant)
+                result = model.run(variant=variant, driver=driver)
         log0("done")
 
         per_chip = result.t_eff / grid.nprocs
@@ -463,7 +490,7 @@ def run_app(variant: str, args) -> int:
             f"(@ T_eff = {result.t_eff:.2f} GB/s aggregate, "
             f"{per_chip:.2f} GB/s/chip, {result.gpts:.4f} Gpts/s)"
         )
-        emit_run_gauges(result, variant)
+        emit_run_gauges(result, variant, driver=driver)
 
     T_v = (
         gather_to_host0(result.T)

@@ -65,8 +65,9 @@ def make_parser():
         help="dump the final gathered surface height as .npy on process 0 "
         "(the machine-readable artifact, SURVEY.md §5.4)",
     )
-    from _common import add_checkpoint_flags, add_telemetry_flag
+    from _common import add_checkpoint_flags, add_driver_flag, add_telemetry_flag
 
+    add_driver_flag(p)
     add_telemetry_flag(p)
     add_checkpoint_flags(p)
     return p
@@ -150,7 +151,7 @@ def main(argv=None) -> int:
         runner = model.run_vmem_resident
     else:
         label = args.variant
-        runner = lambda: model.run(variant=args.variant)
+        runner = lambda: model.run(variant=args.variant, driver=args.driver)
     from _common import profile_context
 
     profile_ctx = profile_context(jax, args)

@@ -28,7 +28,7 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 
 def telemetry_windowed_run(model, variant: str, nt: int, warmup: int,
-                           windows: int):
+                           windows: int, driver: str = "step"):
     """The --telemetry run path (diffusion): the same warmup/timed
     protocol as model.run, but the timed loop split into `windows`
     spanned windows — per-step PERCENTILES need more than the single
@@ -36,7 +36,13 @@ def telemetry_windowed_run(model, variant: str, nt: int, warmup: int,
     over windows is what catches a straggling stretch the mean hides).
     Each window boundary costs one device-fetch sync (the span's
     correctness requirement); windows of many steps amortize it, exactly
-    as tic/toc always did."""
+    as tic/toc always did.
+
+    `driver` picks the loop form (step/scan — models run the same step
+    program either way); the scan driver's static chunk q quantizes the
+    windows (every window a multiple of q, guaranteed non-degenerate by
+    q | gcd(warmup, timed)), and every span carries the driver stamp so
+    summaries from different drivers can't be compared silently."""
     from rocm_mpi_tpu.models.diffusion import RunResult
     from rocm_mpi_tpu.utils import metrics
 
@@ -44,24 +50,28 @@ def telemetry_windowed_run(model, variant: str, nt: int, warmup: int,
         # Same contract as model.run: a degenerate window must fail
         # loudly here, not as a later divide-by-zero or a negative rate.
         raise ValueError(f"need 0 <= warmup < nt, got {warmup}, {nt}")
-    advance = model.advance_fn(variant)
+    if driver == "scan":
+        advance, unit = model.scan_advance_fn(variant, nt=nt, warmup=warmup)
+    else:
+        advance, unit = model.advance_fn(variant), 1
     T, Cp = model.init_state()
     from rocm_mpi_tpu import telemetry
 
-    with telemetry.span("warmup", steps=warmup, variant=variant) as sp:
+    with telemetry.span("warmup", steps=warmup, variant=variant,
+                        driver=driver) as sp:
         if warmup:
             T = advance(T, Cp, warmup)
         sp.sync(T)
     timed = nt - warmup
-    n_windows = max(1, min(windows, timed))
-    base, extra = divmod(timed, n_windows)
+    n_windows = max(1, min(windows, timed // unit))
+    base, extra = divmod(timed // unit, n_windows)
     wtime = 0.0
     for i in range(n_windows):
-        w = base + (1 if i < extra else 0)
+        w = (base + (1 if i < extra else 0)) * unit
         if w == 0:
             continue
         timer = metrics.Timer(label="step_window", phase="step", steps=w,
-                              variant=variant, window=i,
+                              variant=variant, window=i, driver=driver,
                               workload="diffusion")
         timer.tic(T)
         T = advance(T, Cp, w)
@@ -98,8 +108,9 @@ def main(argv=None) -> int:
                    "up to all available)")
     p.add_argument("--json", action="store_true",
                    help="emit one JSON line per count as well")
-    from _common import add_telemetry_flag, setup_jax
+    from _common import add_driver_flag, add_telemetry_flag, setup_jax
 
+    add_driver_flag(p)
     add_telemetry_flag(p)
     p.add_argument("--telemetry-windows", type=int, default=8, metavar="W",
                    help="with --telemetry: split the timed loop into W "
@@ -145,6 +156,9 @@ def main(argv=None) -> int:
             c *= 2
     base_per_dev = base_n = None
     probe_model = None
+    # The loop-form stamp every gauge/probe carries (the deep schedule is
+    # its own form; --driver only selects among the per-step loop forms).
+    run_driver = "deep" if args.variant == "deep" else args.driver
     # Process-0-gated output: on a multi-host slice every process runs this
     # script, but only one may report (rank-0 printing, SURVEY.md §5.5).
     log0(
@@ -188,16 +202,16 @@ def main(argv=None) -> int:
             r = model.run_deep(block_steps=args.deep_k)
         elif (telemetry.enabled() and args.workload == "diffusion"
               and model.config.halo_transport != "host"):
-            # The windowed path drives advance_fn directly; under
+            # The windowed path drives the advance directly; under
             # halo_transport='host' that would silently measure the
             # device-collective path while labeling it a host run —
             # model.run owns the host-staged dispatch and its warning.
             r = telemetry_windowed_run(
                 model, args.variant, args.nt, args.warmup,
-                args.telemetry_windows,
+                args.telemetry_windows, driver=args.driver,
             )
         else:
-            r = model.run(variant=args.variant)
+            r = model.run(variant=args.variant, driver=args.driver)
         probe_model = model  # the last rung this process participated in
         per_dev = r.gpts / n
         if base_per_dev is None:
@@ -206,12 +220,17 @@ def main(argv=None) -> int:
             # list, so label the baseline explicitly.
             base_per_dev, base_n = per_dev, n
         eff = per_dev / base_per_dev
+        # The driver stamp rides every gauge: a "scan"-driver summary and
+        # a "step"-driver summary are different measurements and must not
+        # regress-gate against each other silently.
         if telemetry.enabled():
             telemetry.gauge("run.gpts", round(r.gpts, 6), devices=n,
-                            variant=args.variant, workload=args.workload)
+                            variant=args.variant, workload=args.workload,
+                            driver=run_driver)
             telemetry.gauge("run.gpts_per_device", round(per_dev, 6),
-                            devices=n)
-            telemetry.gauge("run.efficiency", round(eff, 6), devices=n)
+                            devices=n, driver=run_driver)
+            telemetry.gauge("run.efficiency", round(eff, 6), devices=n,
+                            driver=run_driver)
         log0(
             f"n={n:4d} mesh={dims} global={shape}: "
             f"{r.wtime_it * 1e6:9.3f} us/step  {r.gpts:9.4f} Gpts/s "
@@ -267,7 +286,7 @@ def main(argv=None) -> int:
              + ("/checkpoint" if ckpt_dir else "")
              + " phase probes")
         probes.run_diffusion_phase_probes(
-            probe_model, checkpoint_dir=ckpt_dir
+            probe_model, checkpoint_dir=ckpt_dir, driver=run_driver,
         )
     return 0
 

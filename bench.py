@@ -363,6 +363,42 @@ def prime_cache() -> int:
 # --------------------------------------------------------------------------
 
 
+def _next_bench_record_path() -> str:
+    """BENCH_r{n}.json at the repo root — the bench trajectory: one
+    schema-valid flat-metrics file (telemetry regress format,
+    docs/TELEMETRY.md) per completed suite run, numbered consecutively so
+    `python -m rocm_mpi_tpu.telemetry regress BENCH_r02.json --baseline
+    BENCH_r01.json` gates run N against run N-1."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    n = 1
+    while os.path.exists(os.path.join(root, f"BENCH_r{n:02d}.json")):
+        n += 1
+    return os.path.join(root, f"BENCH_r{n:02d}.json")
+
+
+def _write_bench_record(rows: dict) -> None:
+    """Bank the suite's rates as a flat metrics baseline (all rates:
+    higher is better). Atomic tmp+rename so a mid-write kill cannot leave
+    a torn record that bricks the schema gate."""
+    if not rows:
+        return
+    path = _next_bench_record_path()
+    doc = {
+        "metrics": {
+            f"suite.{label}.gpts": {"value": round(v, 4),
+                                    "direction": "higher"}
+            for label, v in rows.items()
+        },
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+    print(f"bench.py --suite: banked {len(rows)} rows into {path}",
+          file=sys.stderr)
+
+
 def run_suite() -> None:
     if not _accelerated():
         print(
@@ -375,12 +411,15 @@ def run_suite() -> None:
     from rocm_mpi_tpu.config import DiffusionConfig
     from rocm_mpi_tpu.models import HeatDiffusion
 
+    suite_rows: dict = {}
+
     def report(label, r):
         print(
             f"{label:34s} {r.wtime_it * 1e6:12.3f} us/step  "
             f"T_eff={r.t_eff:8.1f} GB/s  {r.gpts:8.3f} Gpts/s",
             file=sys.stderr,
         )
+        suite_rows[label] = r.gpts
 
     def row(label, shape, runner, nt, warmup, dtype="f32", **kw):
         cfg = DiffusionConfig(
@@ -456,6 +495,11 @@ def run_suite() -> None:
             f"252² {name} VMEM-resident loop",
             model_cls(mcfg_v).run_vmem_resident(),
         )
+
+    # The trajectory record is written only when the whole ladder ran —
+    # a partial (killed) suite prints its rows to stderr but does not
+    # bank a record that under-represents the machine.
+    _write_bench_record(suite_rows)
 
 
 # --------------------------------------------------------------------------

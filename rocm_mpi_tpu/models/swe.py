@@ -283,6 +283,44 @@ class ShallowWater:
 
         return advance
 
+    def scan_advance_fn(
+        self,
+        variant: str = "perf",
+        nt: int | None = None,
+        warmup: int | None = None,
+        chunk: int | None = None,
+    ):
+        """(jitted (h, us, Mus, n) -> (h, us), chunk q) — the
+        donation-aware scan driver, SWE edition (see
+        HeatDiffusion.scan_advance_fn): the whole coupled state pytree is
+        the scan carry and every state leaf is donated; the masks ride
+        along undonated (they are read-only data). `n` must be a multiple
+        of q."""
+        from rocm_mpi_tpu.models.diffusion import effective_block_steps
+
+        cfg = self.config
+        nt_v = cfg.nt if nt is None else nt
+        wu_v = cfg.warmup if warmup is None else warmup
+        q = effective_block_steps(
+            nt_v, wu_v, (nt_v - wu_v) if chunk is None else chunk,
+            label="SWE scan driver chunk", warn=chunk is not None,
+        )
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def advance(h, us, Mus, n):
+            step = self._step(variant, Mus)
+
+            def q_steps(carry, _):
+                return step(carry[0], carry[1]), None
+
+            def body(_, carry):
+                carry, _ = lax.scan(q_steps, carry, xs=None, length=q)
+                return carry
+
+            return lax.fori_loop(0, n // q, body, (h, us))
+
+        return advance, q
+
     def _run_timed(self, advance, nt, warmup) -> SWERunResult:
         """Shared scaffold: warmup-advance / tic / advance / toc (the
         framework's timing protocol; `advance(h, us, Mus, n)` must serve
@@ -307,8 +345,18 @@ class ShallowWater:
     def run(
         self, variant: str = "perf",
         nt: int | None = None, warmup: int | None = None,
+        driver: str = "step",
     ) -> SWERunResult:
-        return self._run_timed(self.advance_fn(variant), nt, warmup)
+        """`driver="scan"` routes to the donation-aware scan driver
+        (scan_advance_fn); "step" keeps the per-step fori_loop. Same step
+        program either way — results are bitwise identical."""
+        if driver not in ("step", "scan"):
+            raise ValueError(f"driver must be 'step' or 'scan', got {driver!r}")
+        if driver == "scan":
+            advance, _ = self.scan_advance_fn(variant, nt=nt, warmup=warmup)
+        else:
+            advance = self.advance_fn(variant)
+        return self._run_timed(advance, nt, warmup)
 
     def run_vmem_resident(
         self, nt: int | None = None, warmup: int | None = None,
@@ -396,15 +444,19 @@ class ShallowWater:
 
         cfg = self.config
         k = self.effective_deep_depth(nt, warmup, block_steps)
-        sweep = make_swe_deep_sweep(
+        sched = make_swe_deep_sweep(
             self.grid, k, cfg.dt, cfg.spacing, cfg.H0, cfg.g
         )
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def advance(h, us, Mus, n):
             del Mus
+            # The padded face masks are geometry-only: built ONCE per
+            # compiled advance (DeepSchedule.prepare), not inside every
+            # sweep — the loop carries only the coupled state.
+            Mp = sched.prepare(h)
             return lax.fori_loop(
-                0, n // k, lambda _, s: sweep(s[0], s[1]), (h, us)
+                0, n // k, lambda _, s: sched.sweep(s[0], s[1], Mp), (h, us)
             )
 
         return advance, k

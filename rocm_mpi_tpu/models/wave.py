@@ -23,11 +23,15 @@ Variants mirror the flagship's ladder where it transfers:
   "ap"   — global-array jnp ops; GSPMD partitions and inserts comms.
   "perf" — shard_map + exchange_halo + whole-block Pallas kernel
            (ops.wave_kernels), explicit Dirichlet mask.
-  "hide" — perf's kernel on the boundary-slab/interior overlap
-           decomposition (parallel.overlap): the U exchange is dataflow-
-           independent of the interior update, so XLA may hide it — the
-           second workload on the reference's intended variant (3)
-           schedule (hide.jl:94-101).
+  "hide" — the masked-contract kernel (ops.wave_kernels
+           .wave_step_padded_masked_pallas) on the boundary-slab/interior
+           overlap decomposition (parallel.overlap): the U exchange is
+           dataflow-independent of the interior update, so XLA may hide
+           it — the second workload on the reference's intended variant
+           (3) schedule (hide.jl:94-101). The Dirichlet hold rides the
+           prepared (M, Cw) data operands (a branch-free select,
+           fp-identical to perf on updating cells), so no trailing
+           whole-shard `where` and no per-step mask rebuild.
 """
 
 from __future__ import annotations
@@ -165,21 +169,46 @@ class AcousticWave:
         U = make_U()
         return U, jnp.copy(U), make_C2()
 
+    def _mask_prepare(self):
+        """prepare(C2) -> (M, Cw): the interior mask (1.0 on updating
+        cells, exactly 0.0 on the global Dirichlet edge) and the masked
+        coefficient Cw = dt²·c²·M — the wave edition of the diffusion Cm
+        contract, computed ONCE per jitted program from global-array ops
+        (GSPMD shards them like the state). The leapfrog needs M itself
+        because a zeroed coefficient alone gives 2U − U⁻ ≠ U
+        (ops/wave_kernels.py module docstring)."""
+        cfg, grid = self.config, self.grid
+        dt = cfg.jax_dtype(cfg.dt)
+        dt2 = dt * dt
+
+        def prepare(C2):
+            from rocm_mpi_tpu.ops.wave_kernels import interior_mask
+
+            M = interior_mask(grid.global_shape, C2.dtype)
+            return M, dt2 * C2 * M
+
+        return prepare
+
     def _step(self, variant: str):
-        """(U, Uprev, C2) -> (U⁺, U)."""
+        """(step, prepare): `step(U, Uprev, C2, P) -> (U⁺, U)` with `P`
+        the loop-invariant operands `prepare(C2)` builds once per jitted
+        program (None for variants that need none)."""
         cfg, grid = self.config, self.grid
         dt = cfg.jax_dtype(cfg.dt)
 
         if variant == "ap":
 
-            def step(U, Uprev, C2):
+            def step(U, Uprev, C2, P):
+                del P
                 return wave_step_fused(U, Uprev, C2, dt, cfg.spacing), U
 
-            return step
+            return step, None
         if variant == "perf":
             from rocm_mpi_tpu.ops.wave_kernels import wave_step_padded_pallas
 
-            def step(U, Uprev, C2):
+            def step(U, Uprev, C2, P):
+                del P
+
                 def local(Ul, Upl, C2l):
                     pad = exchange_halo(Ul, grid)
                     new = wave_step_padded_pallas(
@@ -196,14 +225,20 @@ class AcousticWave:
                 )(U, Uprev, C2)
                 return new, U
 
-            return step
+            return step, None
         if variant == "hide":
             # Comm/compute overlap for the leapfrog (VERDICT r3 #5): the
             # same boundary-slab/interior decomposition as the diffusion
             # flagship's hide rung (parallel.overlap, the reference's
             # intended variant (3) semantics, hide.jl:94-101) — only U is
-            # exchanged; (U_prev, C2) ride along as core-only aux operands.
-            from rocm_mpi_tpu.ops.wave_kernels import wave_step_padded_pallas
+            # exchanged; (U_prev, M, Cw) ride along as core-only aux
+            # operands. Mask-as-data contract: the Dirichlet hold is a
+            # branch-free select inside the region kernel (bitwise-
+            # identical to perf's expression on updating cells), so no
+            # trailing whole-shard `where` and no per-step mask rebuild.
+            from rocm_mpi_tpu.ops.wave_kernels import (
+                wave_step_padded_masked_pallas,
+            )
             from rocm_mpi_tpu.parallel.overlap import make_overlap_step
 
             if grid.nprocs == 1:
@@ -213,40 +248,83 @@ class AcousticWave:
                 return self._step("perf")
 
             def pu(tp, aux, lam, dt_, spacing):
-                del lam
-                return wave_step_padded_pallas(tp, aux[0], aux[1], dt_,
-                                               spacing)
+                del lam, dt_
+                return wave_step_padded_masked_pallas(
+                    tp, aux[0], aux[1], aux[2], spacing
+                )
 
-            local = make_overlap_step(grid, pu, cfg.b_width)
+            local = make_overlap_step(
+                grid, pu, cfg.b_width, mask_boundary=False
+            )
 
-            def step(U, Uprev, C2):
+            def step(U, Uprev, C2, P):
+                M, Cw = P
                 new = shard_map(
-                    lambda Ul, Upl, C2l: local(
-                        Ul, (Upl, C2l), None, dt, cfg.spacing
+                    lambda Ul, Upl, Ml, Cwl: local(
+                        Ul, (Upl, Ml, Cwl), None, dt, cfg.spacing
                     ),
                     mesh=grid.mesh,
-                    in_specs=(grid.spec,) * 3,
+                    in_specs=(grid.spec,) * 4,
                     out_specs=grid.spec,
                     check_vma=False,
-                )(U, Uprev, C2)
+                )(U, Uprev, M, Cw)
                 return new, U
 
-            return step
+            return step, self._mask_prepare()
         raise ValueError(
             f"unknown wave variant {variant!r} (ap, perf, hide)"
         )
 
     def advance_fn(self, variant: str = "perf"):
         """jitted (U, Uprev, C2, n) -> (U after n steps, U after n-1)."""
-        step = self._step(variant)
+        step, prep = self._step(variant)
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def advance(U, Uprev, C2, n):
+            P = None if prep is None else prep(C2)
             return lax.fori_loop(
-                0, n, lambda _, s: step(s[0], s[1], C2), (U, Uprev)
+                0, n, lambda _, s: step(s[0], s[1], C2, P), (U, Uprev)
             )
 
         return advance
+
+    def scan_advance_fn(
+        self,
+        variant: str = "perf",
+        nt: int | None = None,
+        warmup: int | None = None,
+        chunk: int | None = None,
+    ):
+        """(jitted (U, Uprev, C2, n) -> (U, Uprev), chunk q) — the
+        donation-aware scan driver, wave edition (see
+        HeatDiffusion.scan_advance_fn): the state pair is the scan carry
+        (XLA's double buffer — the leapfrog's natural `U, U⁻ = U⁺, U`
+        swap) and both leaves are donated. `n` must be a multiple of q."""
+        from rocm_mpi_tpu.models.diffusion import effective_block_steps
+
+        cfg = self.config
+        step, prep = self._step(variant)
+        nt_v = cfg.nt if nt is None else nt
+        wu_v = cfg.warmup if warmup is None else warmup
+        q = effective_block_steps(
+            nt_v, wu_v, (nt_v - wu_v) if chunk is None else chunk,
+            label="wave scan driver chunk", warn=chunk is not None,
+        )
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def advance(U, Uprev, C2, n):
+            P = None if prep is None else prep(C2)
+
+            def q_steps(carry, _):
+                return step(carry[0], carry[1], C2, P), None
+
+            def body(_, carry):
+                carry, _ = lax.scan(q_steps, carry, xs=None, length=q)
+                return carry
+
+            return lax.fori_loop(0, n // q, body, (U, Uprev))
+
+        return advance, q
 
     def _run_timed(self, advance, nt, warmup) -> WaveRunResult:
         """Shared run scaffold: validate the windows, init, then
@@ -272,8 +350,18 @@ class AcousticWave:
     def run(
         self, variant: str = "perf",
         nt: int | None = None, warmup: int | None = None,
+        driver: str = "step",
     ) -> WaveRunResult:
-        return self._run_timed(self.advance_fn(variant), nt, warmup)
+        """`driver="scan"` routes to the donation-aware scan driver
+        (scan_advance_fn); "step" keeps the per-step fori_loop. Same step
+        program either way — results are bitwise identical."""
+        if driver not in ("step", "scan"):
+            raise ValueError(f"driver must be 'step' or 'scan', got {driver!r}")
+        if driver == "scan":
+            advance, _ = self.scan_advance_fn(variant, nt=nt, warmup=warmup)
+        else:
+            advance = self.advance_fn(variant)
+        return self._run_timed(advance, nt, warmup)
 
     def run_vmem_resident(
         self, nt: int | None = None, warmup: int | None = None
@@ -367,12 +455,17 @@ class AcousticWave:
         cfg = self.config
         k = self.effective_deep_depth(nt, warmup, block_steps)
         dt = cfg.jax_dtype(cfg.dt)
-        sweep = make_wave_deep_sweep(self.grid, k, dt, cfg.spacing)
+        sched = make_wave_deep_sweep(self.grid, k, dt, cfg.spacing)
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def advance(U, Uprev, C2, n):
+            # The time-invariant c² is exchanged + masked ONCE per
+            # compiled advance (DeepSchedule.prepare), not inside every
+            # sweep — the loop carries only the leapfrog state pair.
+            P = sched.prepare(C2)
             return lax.fori_loop(
-                0, n // k, lambda _, s: sweep(s[0], s[1], C2), (U, Uprev)
+                0, n // k, lambda _, s: sched.sweep(s[0], s[1], P),
+                (U, Uprev),
             )
 
         return advance, k

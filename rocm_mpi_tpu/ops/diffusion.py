@@ -84,6 +84,28 @@ def step_fused_padded(Tp, Cp, lam, dt, spacing):
     return Tp[core] + dt * lam / Cp * lap
 
 
+def step_cm_padded(Tp, Cm, spacing):
+    """Candidate fused update under the Cm contract (pure jnp): `Tp` is
+    the width-1-padded block, `Cm` the PREPARED masked coefficient —
+    (dt·λ)/Cp on updating cells, exactly 0.0 on held (global Dirichlet)
+    cells (models.diffusion `_cm_prepare`). Held cells therefore come back
+    bit-unchanged (Tp[core] + 0·lap), so callers need no trailing
+    whole-shard `where` — the jnp twin of ops.pallas_kernels.fused_step_cm,
+    and bitwise-identical to `step_fused_padded` on updating cells (the
+    same left-associated (dt·λ)/Cp·lap product, just computed once per
+    program instead of once per step).
+    """
+    ndim = Cm.ndim
+    core = tuple(slice(1, -1) for _ in range(ndim))
+    lap = jnp.zeros_like(Cm)
+    for ax in range(ndim):
+        d2 = spacing[ax] * spacing[ax]
+        hi = tuple(slice(2, None) if a == ax else slice(1, -1) for a in range(ndim))
+        lo = tuple(slice(None, -2) if a == ax else slice(1, -1) for a in range(ndim))
+        lap = lap + (Tp[hi] - 2.0 * Tp[core] + Tp[lo]) / d2
+    return Tp[core] + Cm * lap
+
+
 def gaussian_ic(coords, lengths, dtype=None):
     """Initial condition: unit Gaussian at the domain center.
 

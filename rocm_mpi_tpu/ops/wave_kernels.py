@@ -89,6 +89,65 @@ def wave_step_padded_pallas(Up, Uprev, C2, dt, spacing, interpret=None):
     )(Up, Uprev, C2)
 
 
+def wave_step_padded_masked(Up, Uprev, M, Cw, spacing):
+    """Masked-contract candidate leapfrog update (pure jnp): `Up` is the
+    width-1-padded displacement; `Uprev`, the interior mask `M` (1.0 on
+    updating cells, exactly 0.0 on global Dirichlet cells) and the masked
+    coefficient `Cw = dt²·c²·M` are core-shaped data operands prepared
+    once per program (models.wave `_mask_prepare`).
+
+    The hold is a branch-free select, M·cand + (1−M)·U: on updating cells
+    (M==1) `cand = 2U − U⁻ + Cw·∇²U` is the SAME left-associated fp
+    expression as `wave_step_padded`, so results are bitwise identical
+    there; on held cells the result is U bitwise. No caller-side
+    whole-shard `where` — the wave edition of the diffusion Cm contract
+    (the leapfrog needs M itself because c²==0 alone gives 2U − U⁻ ≠ U,
+    see the module docstring).
+    """
+    inv_d2 = tuple(1.0 / (d * d) for d in spacing)
+    core = tuple(slice(1, -1) for _ in range(M.ndim))
+    Uc = Up[core]
+    cand = 2.0 * Uc - Uprev + Cw * _lap_from_padded(Up, inv_d2)
+    return M * cand + (1.0 - M) * Uc
+
+
+def _wave_kernel_whole_masked(Up_ref, Uprev_ref, M_ref, Cw_ref, out_ref, *,
+                              inv_d2):
+    Up, Uprev, M, Cw = _upcast_for_compute(
+        Up_ref[:], Uprev_ref[:], M_ref[:], Cw_ref[:]
+    )
+    core = tuple(slice(1, -1) for _ in range(M.ndim))
+    Uc = Up[core]
+    cand = 2.0 * Uc - Uprev + Cw * _lap_from_padded(Up, inv_d2)
+    out_ref[:] = (M * cand + (1.0 - M) * Uc).astype(out_ref.dtype)
+
+
+def wave_step_padded_masked_pallas(Up, Uprev, M, Cw, spacing,
+                                   interpret=None):
+    """Pallas whole-block form of the masked-contract leapfrog update
+    (the hide rung's region kernel). Falls back to the identical-semantics
+    jnp form for blocks beyond the VMEM budget and for dtypes Mosaic
+    cannot compile (f64 on a real chip) — the same policy as
+    wave_step_padded_pallas."""
+    if interpret is None:
+        interpret = _interpret_default()
+    nbytes = _compute_nbytes(M)
+    if (not _supports_compiled(Up.dtype) and not interpret) or (
+        nbytes > _VMEM_BLOCK_BUDGET_BYTES
+    ):
+        return wave_step_padded_masked(Up, Uprev, M, Cw, spacing)
+    inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
+    kernel = functools.partial(_wave_kernel_whole_masked, inv_d2=inv_d2)
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        kernel,
+        out_shape=_out_struct(M.shape, M),
+        in_specs=[vmem, vmem, vmem, vmem],
+        out_specs=vmem,
+        interpret=interpret,
+    )(Up, Uprev, M, Cw)
+
+
 # ---------------------------------------------------------------------------
 # Whole-loop-in-VMEM leapfrog: the wave edition of the diffusion flagship's
 # fused_multi_step schedule (one HBM round-trip per `chunk` steps).

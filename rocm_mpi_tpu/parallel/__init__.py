@@ -9,7 +9,9 @@ from rocm_mpi_tpu.parallel.gather import gather_to_host0  # noqa: F401
 from rocm_mpi_tpu.parallel.halo import (  # noqa: F401
     HostStagedStepper,
     exchange_halo,
+    exchange_into,
     global_boundary_mask,
     neighbor_shift,
+    place_core,
 )
 from rocm_mpi_tpu.parallel.ring import ring_exchange, ring_exchange_demo  # noqa: F401

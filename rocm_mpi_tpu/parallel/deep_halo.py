@@ -15,20 +15,27 @@ k× less exposed latency. Same total exchanged volume, identical math
 
 Correctness argument (the same light-cone bound as the HBM temporal
 blocking in ops.pallas_kernels._tb_kernel): after s local steps, values at
-ghost depth ≥ s+1 are stale and roll-wraparound garbage has penetrated
-s-1 cells into the k-wide ghost ring; for s ≤ k neither reaches the core.
-Dirichlet global-boundary cells are held by a zero update coefficient, and
-off-domain ghost cells (domain edge) hold zeros with a zero coefficient —
-the zero-ghost convention used framework-wide.
+ghost depth ≥ s+1 from the core are stale (the outermost ghost layer is
+either roll-wraparound garbage or held, depending on the local kernel —
+both contaminate inward one cell per step); for s ≤ k neither reaches the
+core. Dirichlet global-boundary cells are held by a zero update
+coefficient, and off-domain ghost cells (domain edge) hold zeros with a
+zero coefficient — the zero-ghost convention used framework-wide.
 
-Cp handling: the update coefficient needs neighbor Cp values in the ghost
-ring, so each sweep also exchanges Cp's halo. Cp is time-invariant, so this
-is redundant work — but it is two small ppermutes per axis amortized over
-k steps, and keeping it inside the sweep keeps the carried loop state to
-the bare field.
+Time-invariant operands are exchanged ONCE per compiled advance, not once
+per sweep: every builder returns a `DeepSchedule(prepare, sweep, k)`
+where `prepare` runs the ghost exchange + masking of the loop-invariant
+operands (diffusion's Cp→Cm, the wave's C2→(M, Cw), the SWE face masks)
+as its own shard_map program whose *block-padded* output the caller
+hoists outside the `fori_loop` — the carried loop state stays the bare
+field(s), and the per-sweep program exchanges exactly the state. (The old
+form re-exchanged the coefficient inside every sweep; the perf gate's
+traffic audit, docs/PERF.md, is what made that cost visible.)
 """
 
 from __future__ import annotations
+
+from typing import Callable, NamedTuple
 
 import jax.numpy as jnp
 from jax import lax
@@ -38,6 +45,28 @@ from rocm_mpi_tpu.utils.compat import shard_map
 from rocm_mpi_tpu import telemetry
 from rocm_mpi_tpu.parallel.halo import exchange_halo
 from rocm_mpi_tpu.parallel.mesh import GlobalGrid
+
+
+class DeepSchedule(NamedTuple):
+    """A deep-halo schedule: `prepare(*aux)` exchanges/masks the
+    loop-invariant operands once (returning block-padded global arrays —
+    each shard's slice is its k-padded block), `sweep(state…, prepared)`
+    advances the state k steps with one state exchange. Callers jit
+    `prepare` outside their step loop and carry only the state."""
+
+    prepare: Callable
+    sweep: Callable
+    k: int
+
+
+def _validate_depth(grid: GlobalGrid, k: int, label: str = "sweep depth"):
+    if k < 1:
+        raise ValueError(f"{label} k must be >= 1, got {k}")
+    if any(k > ln for ln in grid.local_shape):
+        raise ValueError(
+            f"{label} {k} exceeds a local shard extent "
+            f"{grid.local_shape}; ghost slices need width <= shard"
+        )
 
 
 def padded_hold_mask(shape, grid: GlobalGrid, width: int):
@@ -71,8 +100,11 @@ def padded_update_coefficient(Cp_padded, grid: GlobalGrid, width: int,
     return jnp.where(mask, jnp.zeros_like(Cp_padded), (dt * lam) / safe)
 
 
-def make_deep_sweep(grid: GlobalGrid, k: int, lam, dt, spacing):
-    """Build sweep(T, Cp) -> T advanced k steps, one halo exchange total.
+def make_deep_sweep(grid: GlobalGrid, k: int, lam, dt, spacing,
+                    local_form: str = "auto") -> DeepSchedule:
+    """Build the diffusion DeepSchedule: `prepare(Cp)` -> block-padded Cm
+    (ONE width-k Cp exchange per compiled advance), `sweep(T, Cm)` -> T
+    advanced k steps with one width-k T exchange.
 
     The local k-step kernel is the same unrolled roll-based Pallas program
     as the single-chip VMEM-resident path (ops.pallas_kernels.multi_step_cm)
@@ -82,15 +114,13 @@ def make_deep_sweep(grid: GlobalGrid, k: int, lam, dt, spacing):
     (multi_step_cm_hbm; k ≤ 16 with a depth-dependent stripe geometry,
     gated on the Mosaic compile envelope — tb_slab_fits): the same
     schedule at every scale — exchange once, advance k steps locally,
-    crop.
+    crop. `local_form="jnp"` forces the any-shape XLA fallback — the form
+    whose compiled byte counts the perf traffic gate audits on CPU
+    (rocm_mpi_tpu/perf/traffic.py); "auto" is the production routing.
     """
-    if k < 1:
-        raise ValueError(f"sweep depth k must be >= 1, got {k}")
-    if any(k > ln for ln in grid.local_shape):
-        raise ValueError(
-            f"sweep depth {k} exceeds a local shard extent "
-            f"{grid.local_shape}; ghost slices need width <= shard"
-        )
+    _validate_depth(grid, k, "sweep depth")
+    if local_form not in ("auto", "jnp"):
+        raise ValueError(f"local_form must be 'auto' or 'jnp', got {local_form!r}")
     from rocm_mpi_tpu.ops.pallas_kernels import (
         _TB_MAX_STEPS,
         _VMEM_BLOCK_BUDGET_BYTES,
@@ -102,42 +132,61 @@ def make_deep_sweep(grid: GlobalGrid, k: int, lam, dt, spacing):
     )
 
     core = tuple(slice(k, -k) for _ in range(grid.ndim))
-
+    inner = tuple(slice(1, -1) for _ in range(grid.ndim))
     inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
 
     def jnp_k_steps(Tp, Cm):
-        # Any-shape/any-k fallback: the same roll+Cm semantics as the
-        # Pallas kernels, XLA-fused. Slower (no temporal blocking) but
-        # never shape-constrained — the HBM kernel's stripe divisibility,
-        # k <= 16 bound, and compile-envelope gate do not always survive
-        # run_deep's depth degradation (effective_block_steps), and a
-        # crashed sweep is strictly worse than a slower one.
+        # Any-shape/any-k fallback: the padded-slice stencil + an in-place
+        # `dynamic_update_slice` of the advanced inner box (the outermost
+        # ghost layer is held — same light-cone contamination geometry as
+        # the Pallas kernels' roll wraparound, and no whole-block roll
+        # staging copies). Slower than temporal blocking but never
+        # shape-constrained — the HBM kernel's stripe divisibility, k <= 16
+        # bound, and compile-envelope gate do not always survive run_deep's
+        # depth degradation (effective_block_steps), and a crashed sweep is
+        # strictly worse than a slower one.
+        ndim = Tp.ndim
         for _ in range(k):
             lap = None
-            for ax in range(Tp.ndim):
-                term = (
-                    jnp.roll(Tp, -1, ax) + jnp.roll(Tp, 1, ax) - 2.0 * Tp
-                ) * inv_d2[ax]
+            for ax in range(ndim):
+                hi = tuple(
+                    slice(2, None) if a == ax else slice(1, -1)
+                    for a in range(ndim)
+                )
+                lo = tuple(
+                    slice(None, -2) if a == ax else slice(1, -1)
+                    for a in range(ndim)
+                )
+                term = (Tp[hi] - 2.0 * Tp[inner] + Tp[lo]) * inv_d2[ax]
                 lap = term if lap is None else lap + term
-            Tp = Tp + Cm * lap
+            Tp = lax.dynamic_update_slice(
+                Tp, Tp[inner] + Cm[inner] * lap, (1,) * ndim
+            )
         return Tp
 
-    def local_sweep(Tl, Cpl):
-        Tp = exchange_halo(Tl, grid, width=k)
+    def local_prepare(Cpl):
         Cpp = exchange_halo(Cpl, grid, width=k)
-        Cm = padded_update_coefficient(Cpp, grid, k, lam, dt)
+        return padded_update_coefficient(Cpp, grid, k, lam, dt)
+
+    def tb_ok(Tp):
         n0p = Tp.shape[0]
-        tb_ok = (
+        return (
             k <= _TB_MAX_STEPS
             and Tp.ndim in (2, 3)
             and tb_slab_fits(k, Tp.shape, Tp.dtype)
             and n0p % tb_geometry(k)[1] == 0
             and (n0p // tb_geometry(k)[1]) >= 2
         )
-        if _compute_nbytes(Tp) <= _VMEM_BLOCK_BUDGET_BYTES:
+
+    def local_sweep(Tl, Cm):
+        Tp = exchange_halo(Tl, grid, width=k)
+        if local_form == "jnp":
+            route = "jnp"
+            Tp = jnp_k_steps(Tp, Cm)
+        elif _compute_nbytes(Tp) <= _VMEM_BLOCK_BUDGET_BYTES:
             route = "vmem"
             Tp = multi_step_cm(Tp, Cm, spacing, k)
-        elif tb_ok:
+        elif tb_ok(Tp):
             route = "hbm-tb"
             Tp = multi_step_cm_hbm(Tp, Cm, spacing, k)
         else:
@@ -150,16 +199,25 @@ def make_deep_sweep(grid: GlobalGrid, k: int, lam, dt, spacing):
                                steps_per_exchange=k)
         return Tp[core]
 
-    def sweep(T, Cp):
+    def prepare(Cp):
+        return shard_map(
+            local_prepare,
+            mesh=grid.mesh,
+            in_specs=(grid.spec,),
+            out_specs=grid.spec,
+            check_vma=False,
+        )(Cp)
+
+    def sweep(T, Cm):
         return shard_map(
             local_sweep,
             mesh=grid.mesh,
             in_specs=(grid.spec, grid.spec),
             out_specs=grid.spec,
             check_vma=False,
-        )(T, Cp)
+        )(T, Cm)
 
-    return sweep
+    return DeepSchedule(prepare, sweep, k)
 
 
 def padded_face_mask(shape, grid: GlobalGrid, axis: int, width: int, dtype):
@@ -186,25 +244,22 @@ def padded_face_mask(shape, grid: GlobalGrid, axis: int, width: int, dtype):
     )
 
 
-def make_swe_deep_sweep(grid: GlobalGrid, k: int, dt, spacing, H, g):
-    """Deep-halo sweeps for the shallow-water workload: build
-    sweep(h, us) -> (h, us) advanced k steps with ONE width-k ghost
-    exchange of the whole ndim+1-field coupled state (same light-cone
-    argument as make_deep_sweep: the forward-backward update moves
-    information one cell per step in each direction, so width-k ghosts
-    keep the core exact for k steps).
+def make_swe_deep_sweep(grid: GlobalGrid, k: int, dt, spacing, H,
+                        g) -> DeepSchedule:
+    """Deep-halo DeepSchedule for the shallow-water workload:
+    `prepare(h)` -> the block-padded face masks (geometry-only; `h` just
+    donates dtype and sharding — computed ONCE per compiled advance),
+    `sweep(h, us, Mus_padded)` -> (h, us) advanced k steps with ONE
+    width-k ghost exchange of the whole ndim+1-field coupled state (same
+    light-cone argument as make_deep_sweep: the forward-backward update
+    moves information one cell per step in each direction, so width-k
+    ghosts keep the core exact for k steps).
 
     Local compute: the VMEM-resident masked multi-step kernel
     (ops.swe_kernels.swe_multi_step_masked) when the padded state fits,
     else the identical-semantics jnp roll fallback (masked_swe_step — the
     one definition of the update)."""
-    if k < 1:
-        raise ValueError(f"sweep depth k must be >= 1, got {k}")
-    if any(k > ln for ln in grid.local_shape):
-        raise ValueError(
-            f"sweep depth {k} exceeds a local shard extent "
-            f"{grid.local_shape}; ghost slices need width <= shard"
-        )
+    _validate_depth(grid, k, "sweep depth")
     from rocm_mpi_tpu.ops.pallas_kernels import (
         _VMEM_BLOCK_BUDGET_BYTES,
         _compute_nbytes,
@@ -218,57 +273,68 @@ def make_swe_deep_sweep(grid: GlobalGrid, k: int, dt, spacing, H, g):
     ndim = grid.ndim
     core = tuple(slice(k, -k) for _ in range(ndim))
     cH, cg = swe_coeffs(dt, spacing, H, g)
+    padded_local = tuple(ln + 2 * k for ln in grid.local_shape)
 
     def jnp_k_steps(h, us, Mus):
         for _ in range(k):
             h, us = masked_swe_step(h, us, Mus, cH, cg)
         return h, us
 
-    def local_sweep(hl, *uls):
-        hp = exchange_halo(hl, grid, width=k)
-        ups = tuple(exchange_halo(u, grid, width=k) for u in uls)
-        Mus = tuple(
-            padded_face_mask(hp.shape, grid, a, k, hp.dtype)
+    def local_prepare(hl):
+        return tuple(
+            padded_face_mask(padded_local, grid, a, k, hl.dtype)
             for a in range(ndim)
         )
+
+    def local_sweep(hl, *rest):
+        uls, Mus = rest[:ndim], rest[ndim:]
+        hp = exchange_halo(hl, grid, width=k)
+        ups = tuple(exchange_halo(u, grid, width=k) for u in uls)
         if (3 * ndim + 2) * _compute_nbytes(hp) <= _VMEM_BLOCK_BUDGET_BYTES:
             h2, us2 = swe_multi_step_masked(hp, ups, Mus, cH, cg, k)
         else:
             h2, us2 = jnp_k_steps(hp, ups, Mus)
         return (h2[core],) + tuple(u[core] for u in us2)
 
-    def sweep(h, us):
+    def prepare(h):
+        return shard_map(
+            local_prepare,
+            mesh=grid.mesh,
+            in_specs=(grid.spec,),
+            out_specs=(grid.spec,) * ndim,
+            check_vma=False,
+        )(h)
+
+    def sweep(h, us, Mus_padded):
         outs = shard_map(
             local_sweep,
             mesh=grid.mesh,
-            in_specs=(grid.spec,) * (ndim + 1),
+            in_specs=(grid.spec,) * (2 * ndim + 1),
             out_specs=(grid.spec,) * (ndim + 1),
             check_vma=False,
-        )(h, *us)
+        )(h, *us, *Mus_padded)
         return outs[0], tuple(outs[1:])
 
-    return sweep
+    return DeepSchedule(prepare, sweep, k)
 
 
-def make_wave_deep_sweep(grid: GlobalGrid, k: int, dt, spacing):
-    """Deep-halo sweeps for the acoustic-wave workload: build
-    sweep(U, Uprev, C2) -> (U, Uprev) advanced k steps with ONE width-k
-    ghost exchange — the second workload on the flagship multi-chip
-    schedule (same light-cone argument as make_deep_sweep; the leapfrog
-    state pair is exchanged together and both outputs cropped).
+def make_wave_deep_sweep(grid: GlobalGrid, k: int, dt,
+                         spacing) -> DeepSchedule:
+    """Deep-halo DeepSchedule for the acoustic-wave workload:
+    `prepare(C2)` -> block-padded (M, Cw) — ONE width-k exchange of the
+    time-invariant squared wave speed per compiled advance, with the hold
+    mask M and the masked coefficient Cw = dt²·c²·M derived in the same
+    program — and `sweep(U, Uprev, (M, Cw))` -> (U, Uprev) advanced k
+    steps with ONE width-k ghost exchange of the leapfrog state pair (the
+    second workload on the flagship multi-chip schedule; same light-cone
+    argument as make_deep_sweep, both outputs cropped).
 
     Local compute: the VMEM-resident masked leapfrog kernel
     (ops.wave_kernels.wave_multi_step_masked) when the padded block fits,
     else an XLA-fused jnp fallback with identical semantics (the wave
     workload is the layering demo — it has no HBM temporal-blocked rung).
     """
-    if k < 1:
-        raise ValueError(f"sweep depth k must be >= 1, got {k}")
-    if any(k > ln for ln in grid.local_shape):
-        raise ValueError(
-            f"sweep depth {k} exceeds a local shard extent "
-            f"{grid.local_shape}; ghost slices need width <= shard"
-        )
+    _validate_depth(grid, k, "sweep depth")
     from rocm_mpi_tpu.ops.pallas_kernels import (
         _VMEM_BLOCK_BUDGET_BYTES,
         _compute_nbytes,
@@ -287,28 +353,38 @@ def make_wave_deep_sweep(grid: GlobalGrid, k: int, dt, spacing):
             U, Uprev = masked_leapfrog_step(U, Uprev, M, Cw, inv_d2)
         return U, Uprev
 
-    def local_sweep(Ul, Upl, C2l):
+    def local_prepare(C2l):
+        C2p = exchange_halo(C2l, grid, width=k)
+        hold = padded_hold_mask(C2p.shape, grid, k)
+        M = jnp.where(hold, jnp.zeros_like(C2p), jnp.ones_like(C2p))
+        return M, dt2 * C2p * M
+
+    def local_sweep(Ul, Upl, M, Cw):
         Up_ = exchange_halo(Ul, grid, width=k)
         Upp = exchange_halo(Upl, grid, width=k)
-        C2p = exchange_halo(C2l, grid, width=k)
-        hold = padded_hold_mask(Up_.shape, grid, k)
-        M = jnp.where(
-            hold, jnp.zeros_like(Up_), jnp.ones_like(Up_)
-        )
-        Cw = dt2 * C2p * M
         if 2 * _compute_nbytes(Up_) <= _VMEM_BLOCK_BUDGET_BYTES:
             U2, Up2 = wave_multi_step_masked(Up_, Upp, M, Cw, spacing, k)
         else:
             U2, Up2 = jnp_k_steps(Up_, Upp, M, Cw)
         return U2[core], Up2[core]
 
-    def sweep(U, Uprev, C2):
+    def prepare(C2):
+        return shard_map(
+            local_prepare,
+            mesh=grid.mesh,
+            in_specs=(grid.spec,),
+            out_specs=(grid.spec, grid.spec),
+            check_vma=False,
+        )(C2)
+
+    def sweep(U, Uprev, prepared):
+        M, Cw = prepared
         return shard_map(
             local_sweep,
             mesh=grid.mesh,
-            in_specs=(grid.spec,) * 3,
+            in_specs=(grid.spec,) * 4,
             out_specs=(grid.spec, grid.spec),
             check_vma=False,
-        )(U, Uprev, C2)
+        )(U, Uprev, M, Cw)
 
-    return sweep
+    return DeepSchedule(prepare, sweep, k)

@@ -10,9 +10,20 @@ Inside `shard_map`, `exchange_halo(u, grid)` pads every sharded axis of the
 local block with `width` cells fetched from the cartesian neighbors via
 `lax.ppermute` — which XLA lowers to collective-permute riding the ICI, the
 interconnect analog of GPU-direct MPI (no host staging, SURVEY.md §2.4).
-Axes are exchanged sequentially, so the second axis sends slices of the
-already-padded first axis and corner ghosts arrive from diagonal neighbors
-for free (the standard two-stage corner trick).
+Axes are exchanged sequentially, so the second axis sends slices that
+include the first axis's already-received ghosts and corner ghosts arrive
+from diagonal neighbors for free (the standard two-stage corner trick).
+
+Traffic discipline (the A_eff accounting the perf gate audits,
+docs/PERF.md): padding is ONE preallocated buffer — `place_core` writes
+the block into the ghost-ringed buffer once, `exchange_into` then writes
+each received ghost slice in place with `lax.dynamic_update_slice`. The
+old form rebuilt the whole padded array with a fresh `jnp.concatenate`
+copy per exchanged axis (ndim whole-shard staging copies per exchange);
+the in-place form stages exactly one, which XLA's buffer assignment can
+further alias away. `exchange_into` is exposed separately so callers that
+already hold a padded buffer (the overlap and deep-halo schedules) reuse
+it without re-staging the core.
 
 Non-periodic boundaries: ppermute entries are omitted at the domain edge, so
 edge ghosts arrive as zeros. Their values are never *used*: the global
@@ -58,12 +69,6 @@ def exchange_nbytes(local_shape, itemsize: int, width: int = 1,
     return total
 
 
-def _edge(u, axis: int, side: str, width: int):
-    idx = [slice(None)] * u.ndim
-    idx[axis] = slice(0, width) if side == "lo" else slice(-width, None)
-    return u[tuple(idx)]
-
-
 def neighbor_shift(x, axis_name: str, direction: int):
     """Send `x` to the neighbor `direction` steps up the mesh axis
     (non-periodic: edge devices receive zeros)."""
@@ -79,12 +84,108 @@ def neighbor_shift(x, axis_name: str, direction: int):
     return lax.ppermute(x, axis_name, perm)
 
 
+def place_core(u, width: int = 1, axes=None):
+    """Preallocate the ghost-ringed buffer and write `u` into its core.
+
+    Returns a zero buffer grown by 2*width along each `axes` entry with
+    `u` placed at offset `width` there — ONE staging write, the only
+    whole-block copy the in-place exchange pays. Edge-of-domain ghost
+    slices that no neighbor overwrites stay zero, which IS the framework's
+    zero-ghost boundary convention.
+    """
+    axes = set(range(u.ndim) if axes is None else axes)
+    shape = tuple(
+        n + 2 * width if a in axes else n for a, n in enumerate(u.shape)
+    )
+    start = tuple(width if a in axes else 0 for a in range(u.ndim))
+    return lax.dynamic_update_slice(jnp.zeros(shape, u.dtype), u, start)
+
+
+def exchange_into(buf, grid: GlobalGrid, width: int = 1, axes=None):
+    """Fill the ghost ring of a padded buffer with neighbor slices
+    (inside shard_map). `buf` is a `place_core`-shaped buffer: core at
+    offset `width` along every exchanged axis.
+
+    Axis k's sends span the ghosts of axes < k (the two-stage corner
+    trick) and only the core of axes > k, so the wire bytes match
+    `exchange_nbytes` exactly. The corner extensions are assembled from
+    the RECEIVED slabs of earlier axes — tiny width×width concatenates —
+    never by re-reading the updated buffer: every received slab then
+    lands via one `lax.dynamic_update_slice` in a single-consumer chain,
+    which XLA's buffer assignment executes fully in place (re-slicing the
+    updated buffer for later sends would force it to materialize a
+    defensive whole-buffer copy — the staging cost this module exists to
+    remove). Non-periodic boundaries: ppermute entries are omitted at the
+    domain edge, so edge devices receive zeros — harmless writes into the
+    zero ring.
+    """
+    axes = tuple(range(grid.ndim) if axes is None else axes)
+    exchanged = set(axes)
+    ndim = buf.ndim
+    width = int(width)
+
+    def core_extent(a):
+        return buf.shape[a] - (2 * width if a in exchanged else 0)
+
+    recv: dict = {}  # (axis, side) -> received slab
+    done: list = []
+    for ax in axes:
+        name = grid.axis_names[ax]
+        n = core_extent(ax)
+
+        def core_edge(off):
+            # The buffer's own edge hyperslab (pre-update reads only):
+            # core extent on every other exchanged axis.
+            idx = tuple(
+                slice(off, off + width) if a == ax
+                else slice(width, width + core_extent(a))
+                if a in exchanged else slice(None)
+                for a in range(ndim)
+            )
+            return buf[idx]
+
+        def send_slab(lo_side):
+            # Core edge, extended along each already-exchanged axis with
+            # the matching edge pieces of ITS received slabs — at each
+            # step the extents line up because recv[(a, ·)] spans full
+            # padded extent on axes exchanged before `a` and core extent
+            # after (the same invariant this concat establishes).
+            piece = core_edge(width if lo_side else n)
+            edge = slice(0, width) if lo_side else slice(n - width, n)
+            sel = tuple(
+                edge if a == ax else slice(None) for a in range(ndim)
+            )
+            for a in done:
+                piece = jnp.concatenate(
+                    [recv[(a, "lo")][sel], piece, recv[(a, "hi")][sel]],
+                    axis=a,
+                )
+            return piece
+
+        recv[(ax, "lo")] = neighbor_shift(send_slab(False), name, +1)
+        recv[(ax, "hi")] = neighbor_shift(send_slab(True), name, -1)
+        done.append(ax)
+
+    for i, ax in enumerate(done):
+        n = core_extent(ax)
+        for side, off in (("lo", 0), ("hi", n + width)):
+            starts = tuple(
+                off if a == ax
+                else 0 if a in done[:i] or a not in exchanged
+                else width
+                for a in range(ndim)
+            )
+            buf = lax.dynamic_update_slice(buf, recv[(ax, side)], starts)
+    return buf
+
+
 def exchange_halo(u, grid: GlobalGrid, width: int = 1, axes=None):
     """Pad the local block `u` with neighbor ghost cells (inside shard_map).
 
     Returns an array grown by 2*width along each exchanged axis. This is the
     `update_halo!(T)` analog: one call per step, all axes
-    (diffusion_2D_ap.jl:42).
+    (diffusion_2D_ap.jl:42). Composition of `place_core` + `exchange_into`
+    — one staged copy, ghost slices written in place.
     """
     axes = tuple(range(grid.ndim) if axes is None else axes)
     if telemetry.enabled():
@@ -97,12 +198,7 @@ def exchange_halo(u, grid: GlobalGrid, width: int = 1, axes=None):
             width=width,
             block=tuple(int(n) for n in u.shape),
         )
-    for ax in axes:
-        name = grid.axis_names[ax]
-        ghost_lo = neighbor_shift(_edge(u, ax, "hi", width), name, +1)
-        ghost_hi = neighbor_shift(_edge(u, ax, "lo", width), name, -1)
-        u = jnp.concatenate([ghost_lo, u, ghost_hi], axis=ax)
-    return u
+    return exchange_into(place_core(u, width, axes), grid, width, axes)
 
 
 def global_boundary_mask(grid: GlobalGrid, dtype=bool):

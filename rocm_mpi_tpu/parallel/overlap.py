@@ -14,12 +14,25 @@ latency-hiding scheduler do the queue juggling:
 Per step, inside one shard_map program:
   1. `ppermute` the current field's edge slices to the cartesian neighbors
      (the halo exchange) — depends only on the field's edges;
-  2. update the interior region — depends on NO ghost value, so XLA is free
-     to run the collective and the interior compute concurrently (this
-     dataflow independence is the whole trick: no user-visible queues,
-     priorities, or signals — SURVEY.md §2.2 D8);
+  2. update the interior region — it reads the UNPADDED local block
+     directly (its width-1 stencil window never leaves the shard), so it
+     depends on NO ghost value and XLA is free to run the collective and
+     the interior compute concurrently (this dataflow independence is the
+     whole trick: no user-visible queues, priorities, or signals —
+     SURVEY.md §2.2 D8, made explicit rather than left to XLA's
+     slice-of-concatenate simplifier);
   3. update the boundary slabs once their ghosts arrive;
-  4. splice slabs + interior, Dirichlet-mask the global edge.
+  4. write every region's result into one output buffer with
+     `lax.dynamic_update_slice` — no per-axis concatenate tree, no
+     staging copies; with the masked-coefficient contract (below) held
+     cells come back unchanged from the region update itself, so there is
+     no trailing whole-shard Dirichlet `jnp.where` either.
+
+Traffic (the A_eff accounting docs/PERF.md formalizes): the old splice
+rebuilt the shard through a tree of `jnp.concatenate`s (one staging copy
+per axis level) and then paid a whole-shard select; the in-place splice
+writes each region exactly once into a buffer XLA can alias with the
+input block.
 
 Unlike the reference's two-queue scheme, correctness never rests on manual
 signal ordering (hide.jl:69,86-90): the schedule is derived from dataflow,
@@ -32,9 +45,11 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+from jax import lax
 
 from rocm_mpi_tpu import telemetry
-from rocm_mpi_tpu.parallel.halo import exchange_halo, global_boundary_mask
+from rocm_mpi_tpu.parallel.halo import exchange_halo
 from rocm_mpi_tpu.parallel.mesh import GlobalGrid
 
 
@@ -84,21 +99,66 @@ def make_overlap_step(
     and the whole tree is handed to `padded_update` as its second
     argument. Aux operands are read core-only, never exchanged.
 
-    `mask_boundary=False` drops the final Dirichlet `where`: for the Cm
-    contract (C = the boundary-masked coefficient, models.diffusion
-    `_make_masked_step`), held cells already come back unchanged from the
-    region update, so the extra whole-shard select would be dead work.
+    `mask_boundary=False` drops the Dirichlet hold entirely: for the
+    masked contracts (Cm — the boundary-masked coefficient of
+    models.diffusion `_make_masked_step`; the mask-as-data operands of the
+    wave and SWE models), held cells already come back unchanged from the
+    region update, so any select would be dead work. This is the contract
+    every in-repo caller uses. `mask_boundary=True` keeps a hold for
+    external padded_updates without a masked form; its edge-cell
+    indicators are precomputed at build time (numpy constants closed over
+    here — only the ndim scalar `axis_index` compares remain in the traced
+    step, not a per-step iota/compare chain).
 
     The shard is decomposed axis-by-axis into boundary slabs and one
     interior box: axis 0 contributes the first/last `b` rows (full extent
     elsewhere), axis 1 the first/last `b` columns of the remaining middle,
     and so on; the innermost box is the ghost-free interior. Only the
-    axis-0/…​ slabs read exchanged ghosts — the interior reads purely local
-    data, which is what makes the exchange hideable.
+    axis-0/…​ slabs read exchanged ghosts — the interior reads the unpadded
+    local block, which is what makes the exchange hideable.
     """
     local = grid.local_shape
     ndim = grid.ndim
     bw = effective_b_width(local, b_width)
+
+    def boxes(axis, prefix):
+        """Enumerate the region boxes (per-axis (lo, hi) core ranges) —
+        the same decomposition the concatenate tree used to assemble,
+        computed once at build time."""
+        if axis == ndim:
+            return [tuple(prefix)]  # the interior box
+        n, b = local[axis], bw[axis]
+        rest = [(0, local[a]) for a in range(axis + 1, ndim)]
+        out = [
+            tuple(prefix + [(0, b)] + rest),  # lo slab: reads ghosts
+            tuple(prefix + [(n - b, n)] + rest),  # hi slab: reads ghosts
+        ]
+        if n - 2 * b > 0:
+            out[1:1] = boxes(axis + 1, prefix + [(b, n - b)])
+        return out
+
+    all_boxes = boxes(0, [])
+
+    def ghost_free(bounds):
+        """True when the box's width-1 stencil window never leaves the
+        unpadded shard — it can (and must, for overlap) read `Tl`."""
+        return all(
+            lo >= 1 and hi <= local[a] - 1
+            for a, (lo, hi) in enumerate(bounds)
+        )
+
+    if mask_boundary:
+        # Build-time edge indicators (numpy): cell lies on the shard face
+        # that COULD be a global-domain face. The traced step only adds
+        # the per-axis scalar axis_index compares.
+        edge_lo, edge_hi = [], []
+        for ax in range(ndim):
+            lo = np.zeros(local, bool)
+            hi = np.zeros(local, bool)
+            lo[tuple(0 if a == ax else slice(None) for a in range(ndim))] = True
+            hi[tuple(-1 if a == ax else slice(None) for a in range(ndim))] = True
+            edge_lo.append(lo)
+            edge_hi.append(hi)
 
     def local_step(Tl, Cpl, lam, dt, spacing):
         if telemetry.enabled():
@@ -116,37 +176,45 @@ def make_overlap_step(
         )  # core + 2 per axis
 
         def region(bounds):
-            """Candidate update of the core box given by `bounds`
-            (per-axis (lo, hi) core ranges), read from the padded state."""
-            pad_idx = tuple(slice(lo, hi + 2) for lo, hi in bounds)
-            tp = jax.tree_util.tree_map(lambda a: a[pad_idx], Tp)
+            """Candidate update of the core box given by `bounds`. Slab
+            boxes read the padded state; ghost-free boxes (the interior)
+            read the raw block — no dataflow edge to the collective."""
             core_idx = tuple(slice(lo, hi) for lo, hi in bounds)
             cp = jax.tree_util.tree_map(lambda a: a[core_idx], Cpl)
+            if ghost_free(bounds):
+                raw_idx = tuple(slice(lo - 1, hi + 1) for lo, hi in bounds)
+                tp = jax.tree_util.tree_map(lambda a: a[raw_idx], Tl)
+            else:
+                pad_idx = tuple(slice(lo, hi + 2) for lo, hi in bounds)
+                tp = jax.tree_util.tree_map(lambda a: a[pad_idx], Tp)
             return padded_update(tp, cp, lam, dt, spacing)
 
-        def build(axis, prefix):
-            """Assemble the box whose axes < `axis` are already restricted
-            to their middles (`prefix` bounds) and axes ≥ `axis` are full."""
-            if axis == ndim:
-                # (2) the interior: no ghost dependence → overlappable.
-                return region(prefix)
-            n, b = local[axis], bw[axis]
-            rest = [(0, local[a]) for a in range(axis + 1, ndim)]
-            lo_slab = region(prefix + [(0, b)] + rest)  # (3) reads ghosts
-            hi_slab = region(prefix + [(n - b, n)] + rest)
-            parts = [lo_slab]
-            if n - 2 * b > 0:
-                parts.append(build(axis + 1, prefix + [(b, n - b)]))
-            parts.append(hi_slab)
-            return jax.tree_util.tree_map(
-                lambda *xs: jnp.concatenate(xs, axis=axis), *parts
+        # (2)+(3) region updates, (4) spliced in place: every box is
+        # written exactly once, so the seed buffer's values never survive
+        # — XLA may alias it with Tl's storage (dead after the exchange),
+        # and each region+DUS link lowers to an in-place update-slice
+        # fusion (observed on the CPU backend) instead of the old concat
+        # tree's whole-shard staging copies.
+        new = Tl
+        for bounds in all_boxes:
+            res = region(bounds)
+            origin = tuple(lo for lo, _ in bounds)
+            new = jax.tree_util.tree_map(
+                lambda o, r: lax.dynamic_update_slice(o, r, origin),
+                new, res,
             )
-
-        new = build(0, [])
         if not mask_boundary:
             return new
-        # (4) Dirichlet: global-domain edge cells never change.
-        mask = global_boundary_mask(grid)
+        # Dirichlet hold for unmasked padded_updates: global-domain edge
+        # cells keep their old values (edge indicators are build-time
+        # constants; only the axis_index compares are traced per step).
+        mask = None
+        for ax, name in enumerate(grid.axis_names):
+            idx = lax.axis_index(name)
+            m = ((idx == 0) & edge_lo[ax]) | (
+                (idx == grid.dims[ax] - 1) & edge_hi[ax]
+            )
+            mask = m if mask is None else mask | m
         return jax.tree_util.tree_map(
             lambda old, nw: jnp.where(mask, old, nw), Tl, new
         )

@@ -1,0 +1,13 @@
+"""Static performance accounting: the compiled HBM-traffic gate.
+
+`traffic.py` audits the distributed step drivers' compiled programs
+against their analytic A_eff ideals; `python -m rocm_mpi_tpu.perf` is the
+CPU-only CI gate (docs/PERF.md)."""
+
+from rocm_mpi_tpu.perf.traffic import (  # noqa: F401
+    TrafficRow,
+    audit_variants,
+    hlo_bytes_accessed,
+    load_budgets,
+    render_table,
+)

@@ -1,0 +1,106 @@
+"""The compiled HBM-traffic gate CLI (docs/PERF.md).
+
+    python -m rocm_mpi_tpu.perf [--local N] [--devices N] [--deep-k K]
+                                [--budgets PATH] [--json]
+                                [--include-waste-fixture]
+
+CPU-only by construction: it pins the CPU backend, builds a small
+virtual-device mesh, lowers + compiles each distributed step driver, and
+gates the modeled bytes-per-invocation (and exact collective wire bytes)
+against the committed budgets in rocm_mpi_tpu/perf/budgets.json.
+
+Exit codes: 0 every audited variant within budget; 1 any variant over
+budget (or over the wire ideal); 2 usage/internal error. Runs in tier-1
+and scripts/lint.sh — no accelerator, no timing, no flakiness.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m rocm_mpi_tpu.perf",
+        description=__doc__.splitlines()[0],
+    )
+    p.add_argument("--local", type=int, default=None,
+                   help="per-device shard edge (default: budgets geometry)")
+    p.add_argument("--devices", type=int, default=None,
+                   help="virtual CPU devices (default: budgets geometry)")
+    p.add_argument("--deep-k", type=int, default=None,
+                   help="deep sweep depth (default: budgets geometry)")
+    p.add_argument("--budgets", default=None, metavar="PATH",
+                   help="budgets file (default: rocm_mpi_tpu/perf/budgets.json)")
+    p.add_argument("--json", action="store_true",
+                   help="emit one JSON line per row on stdout (table goes "
+                   "to stderr)")
+    p.add_argument("--include-waste-fixture", action="store_true",
+                   help="also audit the known-waste concatenate-splice "
+                   "fixture (regression-tests the gate itself; EXPECTED "
+                   "to fail, so the exit code goes 1)")
+    args = p.parse_args(argv)
+
+    # CPU pinning BEFORE any backend use: the gate must neither need nor
+    # touch an accelerator (a flaky chip tunnel cannot hang it).
+    import jax
+
+    from rocm_mpi_tpu.utils.backend import set_cpu_device_count
+
+    from rocm_mpi_tpu.perf import traffic
+
+    try:
+        budgets = traffic.load_budgets(args.budgets)
+    except (OSError, ValueError) as e:
+        print(f"perf: cannot load budgets: {e}", file=sys.stderr)
+        return 2
+    geo = budgets.get("geometry", {})
+    local = args.local or int(geo.get("local", traffic.DEFAULT_LOCAL))
+    deep_k = args.deep_k or int(geo.get("deep_k", traffic.DEFAULT_DEEP_K))
+    dims = tuple(int(d) for d in geo.get("dims", (2, 1)))
+    if args.devices:
+        from rocm_mpi_tpu.parallel.mesh import suggest_dims
+
+        dims = suggest_dims(args.devices, 2)
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    import math
+
+    set_cpu_device_count(max(2, math.prod(dims)))
+
+    rows = traffic.audit_variants(
+        local=local, dims=dims, deep_k=deep_k, budgets=budgets,
+        include_waste_fixture=args.include_waste_fixture,
+    )
+    table = traffic.render_table(rows)
+    if args.json:
+        print(table, file=sys.stderr)
+        for r in rows:
+            print(json.dumps({
+                "metric": f"traffic {r.variant}", "steps": r.steps,
+                "bytes": r.measured_bytes, "ideal": r.ideal_bytes,
+                "ratio": round(r.ratio, 4), "wire": r.wire_bytes,
+                "wire_ideal": r.wire_ideal, "budget": r.budget,
+                "ok": r.ok,
+            }))
+    else:
+        print(table)
+    bad = [r for r in rows if not r.ok]
+    if bad:
+        print(
+            "perf: TRAFFIC GATE FAILED — "
+            + ", ".join(f"{r.variant} ({r.ratio:.2f}x vs "
+                        f"{r.budget if r.budget is not None else '—'}"
+                        f"{'' if r.wire_ok else ', wire over ideal'})"
+                        for r in bad),
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -104,6 +104,23 @@ def _cmd_regress(args) -> int:
             file=sys.stderr,
         )
         return 2
+    # Key drift must be VISIBLE: a baseline metric with no counterpart in
+    # the summary simply drops out of the comparison (e.g. gauge keys
+    # grew a ':driver' suffix, or a phase stopped being observed) — that
+    # family is then ungated, which the operator must be told about even
+    # while the remaining metrics still gate.
+    dropped = sorted(
+        set(regress.extract_metrics(baseline))
+        - set(regress.extract_metrics(summary))
+    )
+    if dropped:
+        shown = ", ".join(dropped[:5]) + ("…" if len(dropped) > 5 else "")
+        print(
+            f"warning: {len(dropped)} baseline metric(s) have no "
+            f"counterpart in the summary and are NOT gated: {shown} "
+            "(renamed keys? re-bank the baseline)",
+            file=sys.stderr,
+        )
     for d in deltas:
         print(d.describe())
     bad = regress.regressions(deltas)

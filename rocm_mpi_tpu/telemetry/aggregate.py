@@ -170,6 +170,12 @@ def summarize(streams: dict[int, list[dict]], skipped_lines: int = 0,
                 key = rec["name"]
                 if "devices" in attrs:
                     key = f"{key}@{attrs['devices']}dev"
+                if "driver" in attrs:
+                    # The loop form is part of the measurement's identity:
+                    # a scan-driver rate and a step-driver rate must land
+                    # under distinct keys so the regress gate can never
+                    # compare them silently (apps/_common.py --driver).
+                    key = f"{key}:{attrs['driver']}"
                 gauge_samples.setdefault(key, []).append(rec.get("value"))
                 gauge_series.append({
                     "name": rec["name"], "value": rec.get("value"),

@@ -30,7 +30,8 @@ from rocm_mpi_tpu.telemetry.spans import span
 
 
 def run_diffusion_phase_probes(model, iters: int = 10,
-                               checkpoint_dir=None) -> None:
+                               checkpoint_dir=None,
+                               driver: str | None = None) -> None:
     """Measure halo / interior (and optionally checkpoint) phases for a
     HeatDiffusion model, emitting one span per phase.
 
@@ -39,7 +40,10 @@ def run_diffusion_phase_probes(model, iters: int = 10,
     that eats the compile. With `checkpoint_dir`, one save/restore cycle
     runs through utils.checkpoint — whose own spans provide the
     checkpoint attribution (every process must call this on multi-host
-    runs: orbax saves are collective).
+    runs: orbax saves are collective). `driver` stamps the loop form the
+    probed run used (apps --driver) on every probe span: phase
+    attributions banked from a scan-driver run and a step-driver run are
+    different measurements and must say so.
     """
     if not events.enabled():
         return
@@ -101,16 +105,18 @@ def run_diffusion_phase_probes(model, iters: int = 10,
     # `n` is a static argument, so a warmup at a different n compiles a
     # different program and the span would time the compile, not the
     # kernels — poisoning every baseline banked from the run.
+    stamp = {} if driver is None else {"driver": driver}
     force(halo_probe(T, iters))
     with span(
         "halo.probe", phase="halo", probe=True, iters=iters,
-        bytes=per_exchange * n_local_devices * iters,
+        bytes=per_exchange * n_local_devices * iters, **stamp,
     ) as sp:
         sp.sync(halo_probe(T, iters))
 
     force(interior_probe(T, Cp, iters))
     with span(
         "interior.probe", phase="interior", probe=True, iters=iters,
+        **stamp,
     ) as sp:
         sp.sync(interior_probe(T, Cp, iters))
 

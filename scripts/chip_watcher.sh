@@ -102,15 +102,24 @@ archive_telemetry() {
   # lose the only per-phase attribution of a healthy window. cp -p keeps
   # re-archiving idempotent (append-only files, newest copy wins).
   local tdir="${RMT_TELEMETRY_DIR:-$PWD/output/telemetry}"
-  [ -d "$tdir" ] || return 0
   local found=0 f
-  for f in "$tdir"/telemetry-rank*.jsonl "$tdir"/telemetry-summary.json \
-           "$tdir"/telemetry-trace.json; do
+  if [ -d "$tdir" ]; then
+    for f in "$tdir"/telemetry-rank*.jsonl "$tdir"/telemetry-summary.json \
+             "$tdir"/telemetry-trace.json; do
+      [ -s "$f" ] || continue
+      mkdir -p docs/telemetry_r5
+      cp -p "$f" docs/telemetry_r5/ && found=$((found + 1))
+    done
+  fi
+  # The bench trajectory (BENCH_r{n}.json, written by bench.py --suite in
+  # the telemetry regress flat-metrics format) is banked alongside: a
+  # mid-watch flap must not lose the only completed-suite record either.
+  for f in BENCH_r*.json; do
     [ -s "$f" ] || continue
     mkdir -p docs/telemetry_r5
     cp -p "$f" docs/telemetry_r5/ && found=$((found + 1))
   done
-  [ "$found" -gt 0 ] && echo "[watcher] archived $found telemetry file(s) into docs/telemetry_r5/"
+  [ "$found" -gt 0 ] && echo "[watcher] archived $found telemetry/bench file(s) into docs/telemetry_r5/"
   return 0
 }
 

@@ -23,6 +23,18 @@ env JAX_PLATFORMS=cpu python -m rocm_mpi_tpu.analysis \
 # Schema stage's ok-line goes to stderr so `scripts/lint.sh --json | jq`
 # (the documented analyzer usage) still receives pure JSON on stdout;
 # problems already print to stderr.
-exec env JAX_PLATFORMS=cpu python -m rocm_mpi_tpu.telemetry regress \
+# BENCH_r*.json only exists once bench.py --suite has banked a suite on a
+# chip — an empty trajectory must not read as a missing file. nullglob is
+# scoped to THIS expansion only: the other baseline families must keep
+# failing loudly (exit 2 "missing") if their files disappear.
+shopt -s nullglob
+bench_records=(BENCH_r*.json)
+shopt -u nullglob
+env JAX_PLATFORMS=cpu python -m rocm_mpi_tpu.telemetry regress \
   --check-schema BASELINE.json MULTICHIP_r0*.json \
-  docs/weak_scaling_*mechanics*.jsonl 1>&2
+  ${bench_records[@]+"${bench_records[@]}"} \
+  docs/weak_scaling_*mechanics*.jsonl 1>&2 || exit $?
+# Compiled HBM-traffic gate (docs/PERF.md): lowers + audits every
+# distributed step driver against perf/budgets.json on virtual CPU
+# devices — the static roofline check; no accelerator, no timing.
+exec env JAX_PLATFORMS=cpu python -m rocm_mpi_tpu.perf 1>&2

@@ -77,12 +77,12 @@ def main() -> int:
     # also crosses the process boundary.
     from rocm_mpi_tpu.parallel.deep_halo import make_deep_sweep
 
-    sweep = jax.jit(
-        make_deep_sweep(
-            model.grid, n_steps, cfg.lam, cfg.jax_dtype(cfg.dt), cfg.spacing
-        )
+    sched = make_deep_sweep(
+        model.grid, n_steps, cfg.lam, cfg.jax_dtype(cfg.dt), cfg.spacing
     )
-    T_deep = sweep(T0_dev, Cp)
+    # DeepSchedule contract: the time-invariant coefficient's width-k
+    # exchange runs once (prepare); the sweep carries only the field.
+    T_deep = jax.jit(sched.sweep)(T0_dev, jax.jit(sched.prepare)(Cp))
     metrics.force(T_deep)
     full_deep = gather_to_host0(T_deep)
 
@@ -107,12 +107,12 @@ def main() -> int:
     Uh, _ = wave.advance_fn("hide")(jnp.copy(U), jnp.copy(Uprev), C2, n_steps)
     metrics.force(Uh)
     full_wave_hide = gather_to_host0(Uh)
-    wsweep = jax.jit(
-        make_wave_deep_sweep(
-            wave.grid, n_steps, wcfg.jax_dtype(wcfg.dt), wcfg.spacing
-        )
+    wsched = make_wave_deep_sweep(
+        wave.grid, n_steps, wcfg.jax_dtype(wcfg.dt), wcfg.spacing
     )
-    Uw_deep, _ = wsweep(U, Uprev, C2)
+    Uw_deep, _ = jax.jit(wsched.sweep)(
+        U, Uprev, jax.jit(wsched.prepare)(C2)
+    )
     metrics.force(Uw_deep)
     full_wave = gather_to_host0(Uw)
     full_wave_deep = gather_to_host0(Uw_deep)
@@ -140,12 +140,12 @@ def main() -> int:
         jnp.copy(sh0), tuple(map(jnp.copy, sus0)), sMus, n_steps
     )
     metrics.force(sh_h)
-    ssweep = jax.jit(
-        make_swe_deep_sweep(
-            swe.grid, n_steps, scfg.dt, scfg.spacing, scfg.H0, scfg.g
-        )
+    ssched = make_swe_deep_sweep(
+        swe.grid, n_steps, scfg.dt, scfg.spacing, scfg.H0, scfg.g
     )
-    sh_d, _ = ssweep(sh0, sus0)
+    sh_d, _ = jax.jit(ssched.sweep)(
+        sh0, sus0, jax.jit(ssched.prepare)(sh0)
+    )
     metrics.force(sh_d)
     full_swe = gather_to_host0(sh_p)
     full_swe_hide = gather_to_host0(sh_h)

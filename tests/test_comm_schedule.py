@@ -10,10 +10,14 @@ itself, the strongest proof available in a 1-chip bench environment:
      the count IS the per-step (or per-sweep) message count.
        - per-step perf/hide: one exchange_halo per step = 2 ppermutes per
          sharded axis = 2·ndim ops per step;
-       - deep-k sweeps: T and Cp exchanged once per k steps = 2·2·ndim ops
-         per k steps — the k× message-reduction claim of
-         parallel/deep_halo.py as a regression guard;
-       - wave deep-k: the leapfrog state pair + C2 = 3·2·ndim per k steps.
+       - deep-k sweeps: ONLY the state is exchanged per sweep (2·ndim ops
+         per k steps for T; the time-invariant Cp is exchanged once per
+         compiled advance by DeepSchedule.prepare, outside the loop) —
+         the k× message-reduction claim of parallel/deep_halo.py plus its
+         hoisted-coefficient refinement, as a regression guard;
+       - wave deep-k: the leapfrog state pair = 2·2·ndim per k steps, C2
+         once per advance; SWE deep-k: prepare is exchange-free (the face
+         masks are geometry).
   2. Dataflow: hide's interior region must not consume collective results
      (the reference's intended variant (3) semantics,
      /root/reference/scripts/diffusion_2D_perf_hide.jl:94-101 — interior
@@ -69,23 +73,31 @@ def test_deep_sweep_messages_per_k_steps():
     m = _diffusion()
     T, Cp = m.init_state()
     k = 4
-    sweep = make_deep_sweep(
+    sched = make_deep_sweep(
         m.grid, k, m.config.lam, m.config.jax_dtype(m.config.dt),
         m.config.spacing,
     )
+    Cm = jax.jit(sched.prepare)(Cp)
+
+    # ONLY the carried field is exchanged per k-step sweep: 2·ndim ops —
+    # the k× message-reduction claim, mechanically, plus the hoisted-
+    # coefficient refinement (the old schedule re-exchanged Cp inside
+    # every sweep, doubling the per-sweep message count).
+    per_sweep = _cp_count(jax.jit(sched.sweep).lower(T, Cm))
+    assert per_sweep == 2 * len(DIMS)
+    # The time-invariant Cp costs one exchange per compiled advance…
+    assert _cp_count(jax.jit(sched.prepare).lower(Cp)) == 2 * len(DIMS)
 
     @jax.jit
     def advance(T, Cp, n_sweeps):
+        Cm = sched.prepare(Cp)
         return jax.lax.fori_loop(
-            0, n_sweeps, lambda _, x: sweep(x, Cp), T
+            0, n_sweeps, lambda _, x: sched.sweep(x, Cm), T
         )
 
-    # T + Cp exchanged once per k-step sweep: 2 fields x 2·ndim ops per k
-    # steps, vs the per-step schedule's 2·ndim per step — the k× (here
-    # k/2 = 2× at k=4, k growing with depth) message-reduction claim,
-    # mechanically.
-    per_sweep = _cp_count(advance.lower(T, Cp, 2))
-    assert per_sweep == 2 * 2 * len(DIMS)
+    # …so the whole advance lowers to prepare + loop body: 2·2·ndim ops
+    # regardless of the sweep count.
+    assert _cp_count(advance.lower(T, Cp, 2)) == 2 * 2 * len(DIMS)
     per_step_equiv = _cp_count(m.advance_fn("perf").lower(T, Cp, 8))
     assert per_sweep < k * per_step_equiv  # fewer messages for k steps
 
@@ -98,17 +110,27 @@ def test_wave_deep_sweep_messages_three_fields():
     wave = AcousticWave(wcfg)
     U, Uprev, C2 = wave.init_state()
     k = 4
-    sweep = make_wave_deep_sweep(
+    sched = make_wave_deep_sweep(
         wave.grid, k, wcfg.jax_dtype(wcfg.dt), wcfg.spacing
     )
+    P = jax.jit(sched.prepare)(C2)
+
+    # Per sweep: ONLY the leapfrog state pair (2 fields) is exchanged;
+    # the time-invariant C2 costs one exchange per compiled advance.
+    assert _cp_count(jax.jit(sched.sweep).lower(U, Uprev, P)) \
+        == 2 * 2 * len(DIMS)
+    assert _cp_count(jax.jit(sched.prepare).lower(C2)) == 2 * len(DIMS)
 
     @jax.jit
     def advance(U, Uprev, C2, n_sweeps):
+        P = sched.prepare(C2)
         return jax.lax.fori_loop(
-            0, n_sweeps, lambda _, s: sweep(s[0], s[1], C2), (U, Uprev)
+            0, n_sweeps, lambda _, s: sched.sweep(s[0], s[1], P),
+            (U, Uprev),
         )
 
-    # The leapfrog state pair + C2: 3 fields exchanged per k-step sweep.
+    # Whole advance: state pair per sweep + C2 once = 3·2·ndim in the
+    # lowered text (the loop body appears once).
     assert _cp_count(advance.lower(U, Uprev, C2, 2)) == 3 * 2 * len(DIMS)
 
 
@@ -231,15 +253,22 @@ def test_swe_deep_sweep_messages_per_k_steps():
     swe = ShallowWater(scfg)
     h, us = swe.init_state()
     k = 4
-    sweep = make_swe_deep_sweep(
+    sched = make_swe_deep_sweep(
         swe.grid, k, scfg.dt, scfg.spacing, scfg.H0, scfg.g
     )
+    ndim = len(DIMS)
+    Mp = jax.jit(sched.prepare)(h)
+
+    # The face masks are geometry: prepare needs NO exchange at all.
+    assert _cp_count(jax.jit(sched.prepare).lower(h)) == 0
+    assert _cp_count(jax.jit(sched.sweep).lower(h, us, Mp)) \
+        == (ndim + 1) * 2 * ndim
 
     @jax.jit
     def advance(h, us, n_sweeps):
+        Mp = sched.prepare(h)
         return jax.lax.fori_loop(
-            0, n_sweeps, lambda _, s: sweep(s[0], s[1]), (h, us)
+            0, n_sweeps, lambda _, s: sched.sweep(s[0], s[1], Mp), (h, us)
         )
 
-    ndim = len(DIMS)
     assert _cp_count(advance.lower(h, us, 2)) == (ndim + 1) * 2 * ndim

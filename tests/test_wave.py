@@ -235,10 +235,10 @@ def test_wave_deep_sweep_matches_ap_sharded():
     ref, ref_prev = model.advance_fn("ap")(
         jnp.copy(U), jnp.copy(Uprev), C2, 8
     )
-    sweep = jax.jit(
-        make_wave_deep_sweep(model.grid, 4, cfg.dt, cfg.spacing)
-    )
-    got, got_prev = sweep(*sweep(U, Uprev, C2), C2)
+    sched = make_wave_deep_sweep(model.grid, 4, cfg.dt, cfg.spacing)
+    P = jax.jit(sched.prepare)(C2)  # the ONE C2 exchange of the schedule
+    sweep = jax.jit(sched.sweep)
+    got, got_prev = sweep(*sweep(U, Uprev, P), P)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-12)
     np.testing.assert_allclose(
         np.asarray(got_prev), np.asarray(ref_prev), rtol=1e-12

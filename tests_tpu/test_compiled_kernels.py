@@ -281,13 +281,14 @@ def test_deep_halo_sweep_compiled():
 
     grid = init_global_grid(64, 64, dims=(1, 1), devices=jax.devices()[:1])
     lam, dt = 1.0, jnp.float32(1e-4)
-    sweep = jax.jit(make_deep_sweep(grid, 4, lam, dt, grid.spacing))
+    sched = make_deep_sweep(grid, 4, lam, dt, grid.spacing)
     T = _rand((64, 64))
     Cp = 1.0 + _rand((64, 64), seed=1)
+    Cm = jax.jit(sched.prepare)(Cp)  # the once-per-advance Cp exchange
     ref = T
     for _ in range(4):
         ref = step_fused(ref, Cp, lam, dt, grid.spacing)
-    _close(sweep(T, Cp), ref)
+    _close(jax.jit(sched.sweep)(T, Cm), ref)
 
 
 def test_deep_halo_hbm_shard_compiled():
@@ -299,13 +300,14 @@ def test_deep_halo_hbm_shard_compiled():
 
     grid = init_global_grid(736, 736, dims=(1, 1), devices=jax.devices()[:1])
     lam, dt = 1.0, jnp.float32(1e-5)
-    sweep = jax.jit(make_deep_sweep(grid, 8, lam, dt, grid.spacing))
+    sched = make_deep_sweep(grid, 8, lam, dt, grid.spacing)
     T = _rand((736, 736))
     Cp = 1.0 + _rand((736, 736), seed=1)
+    Cm = jax.jit(sched.prepare)(Cp)
     ref = T
     for _ in range(8):
         ref = step_fused(ref, Cp, lam, dt, grid.spacing)
-    _close(sweep(T, Cp), ref)
+    _close(jax.jit(sched.sweep)(T, Cm), ref)
 
 
 def test_wave_kernel_compiled():
@@ -355,12 +357,12 @@ def test_wave_deep_sweep_compiled():
     model = AcousticWave(cfg, devices=jax.devices()[:1])
     U, Uprev, C2 = model.init_state()
     ref, _ = model.advance_fn("ap")(jnp.copy(U), jnp.copy(Uprev), C2, 8)
-    sweep = jax.jit(
-        make_wave_deep_sweep(
-            model.grid, 4, cfg.jax_dtype(cfg.dt), cfg.spacing
-        )
+    sched = make_wave_deep_sweep(
+        model.grid, 4, cfg.jax_dtype(cfg.dt), cfg.spacing
     )
-    got, _ = sweep(*sweep(U, Uprev, C2), C2)
+    P = jax.jit(sched.prepare)(C2)
+    sweep = jax.jit(sched.sweep)
+    got, _ = sweep(*sweep(U, Uprev, P), P)
     _close(got, ref)
 
 
@@ -540,11 +542,11 @@ def test_swe_deep_sweep_compiled():
     ref_h, ref_us = model.advance_fn("ap")(
         jnp.copy(h), tuple(map(jnp.copy, us)), Mus, 8
     )
-    sweep = jax.jit(
-        make_swe_deep_sweep(model.grid, 4, cfg.dt, cfg.spacing, cfg.H0,
-                            cfg.g)
-    )
-    got_h, got_us = sweep(*sweep(h, us))
+    sched = make_swe_deep_sweep(model.grid, 4, cfg.dt, cfg.spacing,
+                                cfg.H0, cfg.g)
+    P = jax.jit(sched.prepare)(h)
+    sweep = jax.jit(sched.sweep)
+    got_h, got_us = sweep(*sweep(h, us, P), P)
     _close(got_h, ref_h)
     for gu, ru in zip(got_us, ref_us):
         _close(gu, ru)
