@@ -99,6 +99,7 @@ def make_parser(
         help="dump the final gathered field as .npy (process 0)",
     )
     add_telemetry_flag(p)
+    add_health_flag(p)
     add_checkpoint_flags(p)
     return p
 
@@ -134,14 +135,64 @@ def setup_telemetry(args, jax) -> None:
     """Enable telemetry when --telemetry DIR was given (env-configured
     collection — the launcher's RMT_TELEMETRY_DIR — needs no call here;
     events reads the env at import). Called after distributed init so
-    the rank stamp is the real process index."""
-    if getattr(args, "telemetry", None):
-        from rocm_mpi_tpu import telemetry
+    the rank stamp is the real process index. A telemetry-enabled run
+    also installs the compile tracker (telemetry/compiles.py): compile
+    spans and the recompile accounting ride the same stream."""
+    from rocm_mpi_tpu import telemetry
 
+    if getattr(args, "telemetry", None):
         telemetry.configure(
             directory=args.telemetry, enabled=True,
             rank=jax.process_index(),
         )
+    if telemetry.enabled():
+        from rocm_mpi_tpu.telemetry import compiles
+
+        compiles.install()
+
+
+def add_health_flag(p) -> None:
+    """The shared --health knob (docs/TELEMETRY.md "Health plane")."""
+    p.add_argument(
+        "--health", action="store_true",
+        help="run the per-rank flight recorder: progress counters + a "
+        "heartbeat-rank{k}.json sidecar (atomic, watchdog/monitor-"
+        "readable even while this rank is blocked in a collective) and "
+        "a SIGUSR2 faulthandler post-mortem hook; needs a telemetry "
+        "directory (--telemetry DIR or the launcher env) for the "
+        "sidecars (RMT_HEALTH=1 is the env spelling spawn_ranks "
+        "forwards)",
+    )
+
+
+def setup_health(args, jax) -> None:
+    """Arm the flight recorder when --health was given or the launcher
+    contract says so (RMT_HEALTH, forwarded by spawn_ranks health_dir).
+    Called after distributed init + setup_telemetry: the sidecar rank
+    stamp must be the real process index, and the default sidecar home
+    is the telemetry sink."""
+    from rocm_mpi_tpu.telemetry import flight
+
+    try:
+        if getattr(args, "health", False):
+            flight.enable(rank=jax.process_index())
+        elif not flight.enable_from_env():
+            return
+    except ValueError as e:
+        # Both spellings (--health flag, RMT_HEALTH env) fail the same
+        # clean way when no sidecar directory is configured.
+        raise SystemExit(f"--health / RMT_HEALTH: {e}") from None
+    flight.install_postmortem_handler()
+    # flight.enable may have just armed telemetry collection (health
+    # implies it) AFTER setup_telemetry's install gate ran — re-check,
+    # or a health-only run would mark/emit compile gauges with no
+    # tracker listening and bank fabricated zeros.
+    from rocm_mpi_tpu import telemetry
+
+    if telemetry.enabled():
+        from rocm_mpi_tpu.telemetry import compiles
+
+        compiles.install()
 
 
 def add_checkpoint_flags(p) -> None:
@@ -345,6 +396,7 @@ def setup_jax(args):
 
     enable_persistent_cache()
     setup_telemetry(args, jax)
+    setup_health(args, jax)
     return jax
 
 

@@ -28,7 +28,8 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 
 def telemetry_windowed_run(model, variant: str, nt: int, warmup: int,
-                           windows: int, driver: str = "step"):
+                           windows: int, driver: str = "step",
+                           step_base: int = 0):
     """The --telemetry run path (diffusion): the same warmup/timed
     protocol as model.run, but the timed loop split into `windows`
     spanned windows — per-step PERCENTILES need more than the single
@@ -42,8 +43,33 @@ def telemetry_windowed_run(model, variant: str, nt: int, warmup: int,
     program either way); the scan driver's static chunk q quantizes the
     windows (every window a multiple of q, guaranteed non-degenerate by
     q | gcd(warmup, timed)), and every span carries the driver stamp so
-    summaries from different drivers can't be compared silently."""
+    summaries from different drivers can't be compared silently.
+
+    Under the health plane (--health / RMT_HEALTH, flight recorder on)
+    each window boundary additionally runs, in this order: (1) the halo
+    heartbeat probe — one REAL cross-rank exchange under a
+    `halo.heartbeat` span, a live probe of the collective fabric whose
+    entry is the last thing a rank wedging at the boundary records;
+    (2) the "window" fault point (deterministic drills: the `stall`
+    kind wedges a rank right here); (3) the flight-recorder step bump,
+    flushed to the sidecar BEFORE this rank enters the window's
+    compiled collectives. The bump-after-fault-point order is what
+    makes the watchdog's stalled-collective signature deterministic: a
+    rank stalled at boundary K never publishes step K, while its peer
+    publishes K and then blocks inside window K — the cross-rank median
+    moves past the victim and names it (telemetry.health). `step_base`
+    offsets the published steps by this process's earlier ladder rungs
+    (run or sat out), keeping flight step counters COMPARABLE across
+    ranks — the watchdog's contract; a rung-local restart would be
+    masked by the recorder's monotonic guard and skew every later
+    comparison.
+    After warmup (and the heartbeat's own compile) the run calls
+    `compiles.mark_steady()`: every later XLA compile counts as a
+    steady-state recompile, banked as the `compiles.steady_state` gauge
+    the regress gate pins at 0."""
     from rocm_mpi_tpu.models.diffusion import RunResult
+    from rocm_mpi_tpu.resilience import faults
+    from rocm_mpi_tpu.telemetry import compiles, flight
     from rocm_mpi_tpu.utils import metrics
 
     if not 0 <= warmup < nt:
@@ -62,14 +88,34 @@ def telemetry_windowed_run(model, variant: str, nt: int, warmup: int,
         if warmup:
             T = advance(T, Cp, warmup)
         sp.sync(T)
+    heartbeat = None
+    if flight.enabled():
+        from rocm_mpi_tpu.telemetry import probes
+
+        heartbeat = probes.make_halo_heartbeat(model)
+        T = heartbeat(T)  # eat the heartbeat's compile inside warmup
+    if warmup:
+        # The warmup line: the window program and the heartbeat are
+        # compiled; anything XLA compiles after this is a recompile.
+        # A --warmup 0 run has no warmup line to draw — the window
+        # program's FIRST compile would land inside the steady window
+        # and fail the zero-pin gate with no actual recompile storm —
+        # so such runs simply don't pin steady state (the gauge is
+        # only emitted once a window was ever opened).
+        compiles.mark_steady()
     timed = nt - warmup
     n_windows = max(1, min(windows, timed // unit))
     base, extra = divmod(timed // unit, n_windows)
     wtime = 0.0
+    done = warmup
     for i in range(n_windows):
         w = (base + (1 if i < extra else 0)) * unit
         if w == 0:
             continue
+        if heartbeat is not None:
+            T = heartbeat(T)
+        faults.fault_point("window", step=done)
+        flight.progress(step=step_base + done, windows=1)
         timer = metrics.Timer(label="step_window", phase="step", steps=w,
                               variant=variant, window=i, driver=driver,
                               workload="diffusion")
@@ -77,6 +123,11 @@ def telemetry_windowed_run(model, variant: str, nt: int, warmup: int,
         T = advance(T, Cp, w)
         timer.toc(T)
         wtime += timer.elapsed
+        done += w
+    flight.progress(step=step_base + done)
+    # Close this rung's steady window: the NEXT rung's mesh/shape
+    # compiles are legitimate warmup, not steady-state recompiles.
+    compiles.unmark_steady()
     return RunResult(T=T, wtime=wtime, nt=nt, warmup=warmup,
                      config=model.config)
 
@@ -108,10 +159,16 @@ def main(argv=None) -> int:
                    "up to all available)")
     p.add_argument("--json", action="store_true",
                    help="emit one JSON line per count as well")
-    from _common import add_driver_flag, add_telemetry_flag, setup_jax
+    from _common import (
+        add_driver_flag,
+        add_health_flag,
+        add_telemetry_flag,
+        setup_jax,
+    )
 
     add_driver_flag(p)
     add_telemetry_flag(p)
+    add_health_flag(p)
     p.add_argument("--telemetry-windows", type=int, default=8, metavar="W",
                    help="with --telemetry: split the timed loop into W "
                    "spanned windows (per-step percentiles need more than "
@@ -156,6 +213,13 @@ def main(argv=None) -> int:
             c *= 2
     base_per_dev = base_n = None
     probe_model = None
+    # Global flight-step offset across the ladder (health plane): every
+    # rung this process ran — or sat out before its first participation
+    # — banks its nt, so participating ranks' step counters stay
+    # comparable rung after rung (the watchdog's contract; a sat-out
+    # rung never publishes, which the read side treats as
+    # "not participating", never as "stalled").
+    steps_banked = 0
     # The loop-form stamp every gauge/probe carries (the deep schedule is
     # its own form; --driver only selects among the per-step loop forms).
     run_driver = "deep" if args.variant == "deep" else args.driver
@@ -176,7 +240,9 @@ def main(argv=None) -> int:
             # A rung whose submesh holds none of this process's devices:
             # this process cannot allocate on it (jax 0.4.x refuses a
             # device assignment with no local devices) and the compute is
-            # entirely local to the owning process(es) — sit the rung out.
+            # entirely local to the owning process(es) — sit the rung out
+            # (banking its steps for the health plane's global counter).
+            steps_banked += args.nt
             continue
         dims = suggest_dims(n, 2)
         shape = (args.local * dims[0], args.local * dims[1])
@@ -209,9 +275,11 @@ def main(argv=None) -> int:
             r = telemetry_windowed_run(
                 model, args.variant, args.nt, args.warmup,
                 args.telemetry_windows, driver=args.driver,
+                step_base=steps_banked,
             )
         else:
             r = model.run(variant=args.variant, driver=args.driver)
+        steps_banked += args.nt
         probe_model = model  # the last rung this process participated in
         per_dev = r.gpts / n
         if base_per_dev is None:
@@ -254,6 +322,15 @@ def main(argv=None) -> int:
             print(json.dumps(row))
 
     from rocm_mpi_tpu import telemetry
+
+    if telemetry.enabled():
+        # Compile accounting, banked BEFORE the phase probes below: the
+        # probes compile their own halo/interior programs on purpose,
+        # and those deliberate epilogue compiles must not show up as
+        # steady-state recompiles in the gauge the regress gate pins.
+        from rocm_mpi_tpu.telemetry import compiles
+
+        compiles.emit_gauges()
 
     if (telemetry.enabled() and args.probes and probe_model is not None
             and args.workload == "diffusion"):

@@ -88,6 +88,7 @@ def all_rules() -> list[Rule]:
     from rocm_mpi_tpu.analysis.rules_donation import DonationSafetyRule
     from rocm_mpi_tpu.analysis.rules_pallas import PallasHygieneRule
     from rocm_mpi_tpu.analysis.rules_purity import TraceTimePurityRule
+    from rocm_mpi_tpu.analysis.rules_signals import SignalHygieneRule
     from rocm_mpi_tpu.analysis.rules_timing import RawTimingRule
 
     return [
@@ -97,6 +98,7 @@ def all_rules() -> list[Rule]:
         PallasHygieneRule(),
         AxisConsistencyRule(),
         RawTimingRule(),
+        SignalHygieneRule(),
     ]
 
 

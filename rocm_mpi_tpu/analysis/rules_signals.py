@@ -1,0 +1,121 @@
+"""GL07 — signal/faulthandler hygiene outside the health-plane owners.
+
+The health plane's post-mortem hook (telemetry/flight.py) owns SIGUSR2
+via `faulthandler.register`, and the resilience tier owns deliberate
+process-fate decisions. A second `signal.signal`/`faulthandler.register`
+anywhere else silently STEALS that disposition — Python keeps exactly
+one handler per signal per process, last install wins — so the
+watchdog's SIGUSR2 would dump nothing and the post-mortem bundle would
+ship empty, with no error anywhere. Handler installs also don't compose
+across libraries (orbax, jax's own faulthandler use at init), which is
+why the framework routes every one of them through two audited homes:
+
+* `rocm_mpi_tpu/telemetry/flight.py` — the SIGUSR2 post-mortem hook
+* `rocm_mpi_tpu/resilience/`          — fault injection / supervision
+
+Flagged everywhere else:
+
+* calls to `signal.signal(...)` / `signal.sigaction` / `signal.setitimer`
+  (module-attribute or from-import alias spellings)
+* any import of `faulthandler` (importing it is the capability; every
+  use of it manipulates process-wide dump state)
+
+NOT flagged: reading signal CONSTANTS (`signal.SIGUSR2`) and sending
+signals (`proc.send_signal`, `os.kill`) — observing or delivering a
+signal is fine anywhere; only *handler installation* is owned.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from rocm_mpi_tpu.analysis import astutil
+from rocm_mpi_tpu.analysis.core import ModuleContext, Rule
+
+_OWNER_FILES = (
+    "rocm_mpi_tpu/telemetry/flight.py",
+)
+_OWNER_DIR_MARK = "/rocm_mpi_tpu/resilience/"
+
+_INSTALLERS = frozenset({"signal", "sigaction", "setitimer"})
+
+
+def _is_owner(ctx: ModuleContext) -> bool:
+    return (
+        ctx.posix_path.endswith(_OWNER_FILES)
+        or _OWNER_DIR_MARK in ctx.posix_path
+    )
+
+
+class SignalHygieneRule(Rule):
+    id = "GL07"
+    name = "signal-hygiene"
+    severity = "error"
+    rationale = (
+        "signal handlers don't compose: a stray signal.signal/"
+        "faulthandler install silently steals the health plane's "
+        "SIGUSR2 post-mortem hook (owners: telemetry/flight.py, "
+        "resilience/)"
+    )
+    hint = "see docs/ANALYSIS.md#gl07"
+
+    def check(self, ctx: ModuleContext):
+        if _is_owner(ctx):
+            return []
+        imports = astutil.collect_imports(ctx.tree)
+        signal_modules = {
+            local for local, mod in imports.module_aliases.items()
+            if mod == "signal"
+        }
+        installer_aliases = {
+            local: origin.rpartition(".")[2]
+            for local, origin in imports.from_imports.items()
+            if origin in {f"signal.{fn}" for fn in _INSTALLERS}
+        }
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name.split(".")[0] == "faulthandler":
+                        findings.append(ctx.finding(
+                            node, self,
+                            "faulthandler import outside the health-"
+                            "plane owners — its dump targets are "
+                            "process-wide state the SIGUSR2 post-mortem "
+                            "hook depends on",
+                            "route post-mortem dumps through "
+                            "telemetry.flight.install_postmortem_handler",
+                        ))
+            elif isinstance(node, ast.ImportFrom):
+                if (node.module or "").split(".")[0] == "faulthandler":
+                    findings.append(ctx.finding(
+                        node, self,
+                        "faulthandler import outside the health-plane "
+                        "owners",
+                        "route post-mortem dumps through "
+                        "telemetry.flight.install_postmortem_handler",
+                    ))
+            elif isinstance(node, ast.Call):
+                fn = node.func
+                spelled = None
+                if (
+                    isinstance(fn, ast.Attribute)
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id in signal_modules
+                    and fn.attr in _INSTALLERS
+                ):
+                    spelled = f"{fn.value.id}.{fn.attr}"
+                elif isinstance(fn, ast.Name) and fn.id in installer_aliases:
+                    spelled = f"{fn.id} (= signal.{installer_aliases[fn.id]})"
+                if spelled is not None:
+                    findings.append(ctx.finding(
+                        node, self,
+                        f"{spelled}() installs a process-wide signal "
+                        "handler outside the owners — last install wins, "
+                        "so this silently disarms the health plane's "
+                        "SIGUSR2 hook",
+                        "move the handler into telemetry/flight.py or "
+                        "resilience/ (or deliver signals instead of "
+                        "handling them)",
+                    ))
+        return findings

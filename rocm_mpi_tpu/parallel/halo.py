@@ -347,6 +347,20 @@ class HostStagedStepper:
         return out
 
     def run(self, T: np.ndarray, Cp: np.ndarray, nt: int) -> np.ndarray:
-        for _ in range(nt):
+        # The one per-step HOST loop in the framework, so it feeds the
+        # health plane directly: a "step" fault point (deterministic
+        # drills) and a flight-recorder step bump per step — the halo /
+        # interior spans in step_python already land in the flight ring
+        # via the events tap. Both are one-global-read no-ops when the
+        # recorder / fault plan are off.
+        from rocm_mpi_tpu.resilience import faults
+        from rocm_mpi_tpu.telemetry import flight
+
+        for i in range(nt):
+            faults.fault_point("step", step=i + 1)
+            # Additive: the recorder's step counter is process-global,
+            # and a second .run() restarting at 1 would be masked by
+            # its monotonic guard.
+            flight.progress(step_inc=1)
             T = self.step(T, Cp)
         return T

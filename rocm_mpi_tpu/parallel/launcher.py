@@ -29,7 +29,22 @@ Robustness contract:
     (RMT_TELEMETRY_DIR — each rank appends telemetry-rank{k}.jsonl,
     docs/TELEMETRY.md) and, after all ranks exit, merges the streams
     into <dir>/telemetry-summary.json — the launcher is the one place
-    that outlives every rank, so it owns the merge.
+    that outlives every rank, so it owns the merge;
+  * `health_dir` arms the runtime health plane (docs/TELEMETRY.md
+    "Health plane"): ranks run the flight recorder (RMT_HEALTH /
+    RMT_HEALTH_DIR → heartbeat-rank{k}.json sidecars + an in-process
+    SIGUSR2 faulthandler), and the supervision thread becomes a
+    PROGRESS-AWARE watchdog — it tails the sidecars and flags a rank
+    whose step counter stalls while the cross-rank median advances (the
+    stalled-collective signature, telemetry.health.ProgressWatch; wall
+    clock alone cannot tell the victim from the peers it wedged). A
+    flagged rank gets SIGUSR2 (all-thread traceback into its
+    post-mortem sidecar), `postmortem-rank{k}.json` is composed out of
+    process, the rank is killed, the existing peer-grace kill reaps the
+    survivors, and everything is bundled into <health_dir>/postmortem/
+    with a merged timeline trace. The wall-clock heartbeat log line
+    gains per-rank progress ages; with the health plane OFF it stays
+    byte-for-byte the legacy line.
 """
 
 from __future__ import annotations
@@ -37,6 +52,8 @@ from __future__ import annotations
 import dataclasses
 import os
 import pathlib
+import shutil
+import signal
 import socket
 import subprocess
 import sys
@@ -60,6 +77,9 @@ class LaunchReport:
     first_failure: tuple[int, int, float] | None = None  # (rank, rc, t_s)
     killed_after_failure: list[int] = dataclasses.field(default_factory=list)
     events: list[str] = dataclasses.field(default_factory=list)
+    # Progress-watchdog verdicts (health_dir runs): one dict per flagged
+    # rank — rank, step, median_step, stalled_for_s, last phase, t.
+    watchdog_verdicts: list[dict] = dataclasses.field(default_factory=list)
 
     def note(self, msg: str) -> None:
         self.events.append(msg)
@@ -84,6 +104,9 @@ def spawn_ranks(
     heartbeat_s: float = 10.0,
     peer_grace_s: float = 20.0,
     telemetry_dir=None,
+    health_dir=None,
+    stall_grace_s: float = 6.0,
+    postmortem_grace_s: float = 1.5,
 ):
     """Spawn `nprocs` ranks of `[sys.executable] + argv` under the RMT_*
     launcher contract; return RankResults of (proc, (stdout, stderr)) in
@@ -91,7 +114,12 @@ def spawn_ranks(
     Callers judge returncodes (a killed-at-timeout or killed-after-peer-
     failure rank reports its signal code with whatever it flushed).
     With `telemetry_dir` every rank collects telemetry into it and the
-    merged summary is written at exit (see module docstring)."""
+    merged summary is written at exit; with `health_dir` the supervision
+    thread runs the progress-aware watchdog over the ranks' heartbeat
+    sidecars (`stall_grace_s` of no progress while the cross-rank median
+    is ahead; `postmortem_grace_s` between SIGUSR2 and the kill, so the
+    in-process faulthandler gets to write its dump) — module docstring
+    has the full story."""
     port = _free_port()
     base = os.environ.copy()
     # Ranks size their own device count (--cpu-devices); an inherited
@@ -121,6 +149,35 @@ def spawn_ranks(
             os.makedirs(telemetry_dir, exist_ok=True)
             env["RMT_TELEMETRY"] = "1"
             env["RMT_TELEMETRY_DIR"] = str(telemetry_dir)
+        if health_dir:
+            # The flight-recorder contract (telemetry/flight.py): ranks
+            # write heartbeat sidecars here and register the SIGUSR2
+            # faulthandler (apps/_common.setup_health reads these).
+            os.makedirs(health_dir, exist_ok=True)
+            if pid == 0:
+                # Sidecars are THIS launch's state: stale heartbeat /
+                # post-mortem files from a previous run in a reused dir
+                # would feed the watchdog old counters during the new
+                # ranks' slow startup (python + distributed init takes
+                # longer than the stall grace) and get a healthy rank
+                # flagged and killed for last run's incident.
+                for stale in pathlib.Path(health_dir).glob(
+                    "heartbeat-rank*.json"
+                ):
+                    stale.unlink(missing_ok=True)
+                for pattern in ("postmortem-rank*.json",
+                                "postmortem-rank*.traceback"):
+                    for stale in pathlib.Path(health_dir).glob(pattern):
+                        stale.unlink(missing_ok=True)
+                # Including last run's bundle: "clean runs leave no
+                # bundle" must hold for a clean RERUN of a dir that saw
+                # an incident — else the watcher archives the previous
+                # incident as if it belonged to this burst.
+                stale_bundle = pathlib.Path(health_dir) / "postmortem"
+                if stale_bundle.is_dir():
+                    shutil.rmtree(stale_bundle, ignore_errors=True)
+            env["RMT_HEALTH"] = "1"
+            env["RMT_HEALTH_DIR"] = str(health_dir)
         procs.append(
             subprocess.Popen(
                 [sys.executable] + [str(a) for a in argv],
@@ -153,10 +210,56 @@ def spawn_ranks(
             p.kill()
             outs[i] = ("", f"rank {i} drain failed: {exc!r}")
 
+    watch = None
+    if health_dir:
+        from rocm_mpi_tpu.telemetry import health as _health
+
+        watch = _health.ProgressWatch(stall_grace_s=stall_grace_s)
+
+    def watchdog_tick(now: float) -> None:
+        """One progress-watchdog poll (health_dir runs only): tail the
+        sidecars, and on the first stalled-collective verdict dump +
+        post-mortem + kill the flagged rank — the kill turns into a
+        nonzero exit the first-failure path below already knows how to
+        handle (peer-grace kill of the wedged survivors)."""
+        from rocm_mpi_tpu.telemetry import health as _health
+
+        beats, _ = _health.load_heartbeats(health_dir)
+        watch.observe(beats, now)
+        if report.watchdog_verdicts:
+            return  # one verdict round per launch: the rest is cleanup
+        for verdict in watch.verdicts(now):
+            rank = verdict["rank"]
+            if procs[rank].poll() is not None:
+                continue  # already dead: the exit path will report it
+            report.note(
+                f"watchdog: rank {rank} stalled at step {verdict['step']} "
+                f"(cross-rank median {verdict['median_step']}, no progress "
+                f"for {verdict['stalled_for_s']}s, last phase "
+                f"{verdict['last_phase']!r}) — SIGUSR2 then kill"
+            )
+            try:
+                if hasattr(signal, "SIGUSR2"):
+                    procs[rank].send_signal(signal.SIGUSR2)
+                    # Give the in-process faulthandler time to append its
+                    # all-thread dump (cancellable wait, not sleep).
+                    done.wait(postmortem_grace_s)
+            except (OSError, ValueError):
+                pass
+            try:
+                path = _health.write_postmortem(health_dir, rank, verdict)
+                report.note(f"watchdog: wrote {path}")
+            except Exception as exc:  # noqa: BLE001 — never wedge the kill
+                report.note(f"watchdog: post-mortem failed: {exc!r}")
+            report.watchdog_verdicts.append(verdict)
+            if procs[rank].poll() is None:
+                procs[rank].kill()
+
     def supervise() -> None:
         """Heartbeat rank liveness; on the first nonzero exit, give hung
         peers `peer_grace_s` to finish on their own, then kill them —
-        a gloo collective never completes once a participant is dead."""
+        a gloo collective never completes once a participant is dead.
+        With `health_dir`, each pass also runs the progress watchdog."""
         t0 = time.monotonic()
         next_beat = t0 + heartbeat_s
         failure_t = None
@@ -165,6 +268,11 @@ def spawn_ranks(
             alive = [i for i, p in enumerate(procs) if p.poll() is None]
             if not alive:
                 return
+            if watch is not None:
+                try:
+                    watchdog_tick(now)
+                except Exception as exc:  # noqa: BLE001
+                    report.note(f"watchdog: tick failed: {exc!r}")
             if report.first_failure is None:
                 for i, p in enumerate(procs):
                     rc = p.poll()
@@ -189,9 +297,21 @@ def spawn_ranks(
                 )
                 return
             if heartbeat_s and now >= next_beat:
-                report.note(
-                    f"heartbeat at {now - t0:.1f}s: ranks {alive} alive"
-                )
+                if watch is None:
+                    # The legacy line, byte for byte: the resilience
+                    # drills (and whoever greps their logs) pin it.
+                    report.note(
+                        f"heartbeat at {now - t0:.1f}s: ranks {alive} alive"
+                    )
+                else:
+                    ages = watch.ages(now)
+                    detail = ", ".join(
+                        f"rank{rk} {ages[rk]:.1f}s" for rk in sorted(ages)
+                    ) or "no sidecars yet"
+                    report.note(
+                        f"heartbeat at {now - t0:.1f}s: ranks {alive} "
+                        f"alive; last progress age: {detail}"
+                    )
                 next_beat = now + heartbeat_s
             done.wait(0.25)
 
@@ -227,6 +347,24 @@ def spawn_ranks(
             )
         except Exception as exc:  # noqa: BLE001
             report.note(f"telemetry merge failed: {exc!r}")
+    if health_dir and report.watchdog_verdicts:
+        # The post-mortem bundle: per-rank post-mortems + heartbeats +
+        # bundle.json naming the verdicts + the merged timeline trace.
+        # Clean runs (zero verdicts) deliberately leave no postmortem/
+        # directory — an empty bundle would read as a silent incident.
+        try:
+            from rocm_mpi_tpu.telemetry import health as _health
+
+            bundle = _health.bundle_postmortem(
+                health_dir, report.watchdog_verdicts
+            )
+            report.note(
+                f"watchdog: bundled post-mortem for rank(s) "
+                f"{[v['rank'] for v in report.watchdog_verdicts]} "
+                f"into {bundle}"
+            )
+        except Exception as exc:  # noqa: BLE001
+            report.note(f"watchdog: bundling failed: {exc!r}")
     results = RankResults(zip(procs, outs))
     results.report = report
     return results

@@ -20,6 +20,15 @@ rank):
                             largest file of the newest checkpoint step
     delay=S@step=K          sleep S seconds at step K (flapping-tunnel
                             stall analog; exercises heartbeat reporting)
+    stall@step=K            block FOREVER in a time.monotonic busy-wait
+                            at step K — the wedged-in-a-collective
+                            analog. Unlike `delay` it never resumes, so
+                            it is the only kind that exercises the
+                            health-plane watchdog's full detect → dump →
+                            kill path (parallel/launcher.py): the
+                            stalled rank stops bumping its flight
+                            recorder while its peers advance and then
+                            wedge behind it
 
 Any clause may be rank-scoped with `rank=R`:
 
@@ -36,6 +45,13 @@ Instrumented fault points:
                  save (step = absolute step count, directory = ckpt dir)
     "init"     — parallel/distributed.maybe_initialize_distributed,
                  before jax.distributed.initialize (step = None)
+    "window"   — apps/weak_scaling.telemetry_windowed_run, at each
+                 window boundary AFTER the halo heartbeat probe and
+                 BEFORE the flight-recorder step bump (step = steps
+                 completed so far) — the ordering the health-plane
+                 watchdog drill relies on (docs/TELEMETRY.md)
+    "step"     — parallel/halo.HostStagedStepper.run, before each
+                 host-staged step (step = 1-based step index)
 """
 
 from __future__ import annotations
@@ -84,7 +100,7 @@ def _parse_clause(raw: str) -> FaultClause:
     if kind.startswith("delay="):
         delay_s = float(kind[len("delay="):])
         kind = "delay"
-    if kind not in ("crash", "kill", "truncate-latest", "delay"):
+    if kind not in ("crash", "kill", "truncate-latest", "delay", "stall"):
         raise ValueError(f"unknown fault kind {kind!r} in {raw!r}")
     clause = FaultClause(kind, delay_s=delay_s)
     triggers = [t for t in [trigger.strip()] + mods if t]
@@ -99,7 +115,7 @@ def _parse_clause(raw: str) -> FaultClause:
             clause.rank = int(val)
         else:
             raise ValueError(f"unknown fault trigger {t!r} in {raw!r}")
-    if kind in ("crash", "kill", "delay") and clause.step is None \
+    if kind in ("crash", "kill", "delay", "stall") and clause.step is None \
             and clause.segment is None:
         raise ValueError(
             f"{kind} fault needs a step=K or segment=N trigger: {raw!r}"
@@ -228,6 +244,16 @@ def fault_point(name: str, step=None, directory=None) -> None:
         clause.fires += 1
         if clause.kind == "delay":
             time.sleep(clause.delay_s)
+        elif clause.kind == "stall":
+            # The wedged rank: a pure-Python monotonic busy-wait that
+            # never exits. Deliberately NOT a sleep — the interpreter
+            # keeps executing bytecode, so daemon threads (telemetry
+            # drains) stay live and the process looks exactly like a
+            # rank spinning inside a stuck collective: alive by wall
+            # clock, dead by progress. Only the watchdog's kill (or the
+            # launcher timeout) ends it.
+            while True:  # pragma: no branch — exit is the kill signal
+                time.monotonic()
         elif clause.kind == "truncate-latest":
             if directory is not None:
                 _truncate_latest(directory)

@@ -23,12 +23,22 @@ attributes (halo / interior / checkpoint / step, stragglers); trace
 exports to Perfetto; regress gates PRs on committed baselines; probes
 (jax-needing, imported lazily) measure phase attribution for fused step
 programs that expose no seams at runtime.
+
+The runtime health plane rides on top (docs/TELEMETRY.md "Health
+plane"): flight (write side — per-rank flight recorder, heartbeat
+sidecars, SIGUSR2 post-mortems), health (read side — sidecar tailing,
+the progress-aware stall verdict, monitor/OpenMetrics), compiles
+(per-program compile + recompile accounting through utils/compat):
+
+    python -m rocm_mpi_tpu.telemetry monitor DIR
+    python -m rocm_mpi_tpu.telemetry export-openmetrics DIR
 """
 
 from rocm_mpi_tpu.telemetry.events import (
     SCHEMA_VERSION,
     annotate,
     clear,
+    clear_events,
     configure,
     counter,
     enabled,
@@ -44,6 +54,7 @@ __all__ = [
     "SCHEMA_VERSION",
     "annotate",
     "clear",
+    "clear_events",
     "configure",
     "counter",
     "enabled",

@@ -1,12 +1,14 @@
-"""CLI: python -m rocm_mpi_tpu.telemetry {summarize,regress} …
+"""CLI: python -m rocm_mpi_tpu.telemetry
+           {summarize,regress,monitor,export-openmetrics} …
 
     summarize DIR [--json] [--out FILE] [--trace FILE]
                   [--straggler-factor F]
         Merge DIR's telemetry-rank*.jsonl streams; write the summary
         (default DIR/telemetry-summary.json) and a Chrome trace (default
-        DIR/telemetry-trace.json, openable at ui.perfetto.dev); print a
-        human report (--json prints the summary document instead).
-        Exit 0 on success, 2 when DIR has no rank streams.
+        DIR/telemetry-trace.json, openable at ui.perfetto.dev — health
+        heartbeat sidecars in DIR merge in as progress counter tracks);
+        print a human report (--json prints the summary document
+        instead). Exit 0 on success, 2 when DIR has no rank streams.
 
     regress SUMMARY --baseline FILE [--tolerance F]
         Gate SUMMARY (a summary file, or a run directory to summarize on
@@ -15,8 +17,24 @@
 
     regress --check-schema FILE [FILE…]
         Validate committed measurement artifacts (BASELINE.json,
-        MULTICHIP_r0*.json, mechanics/telemetry JSONLs, summaries) still
-        parse as a known format. Exit 0 ok, 1 problems.
+        MULTICHIP_r0*.json, mechanics/telemetry JSONLs, summaries,
+        heartbeat/post-mortem sidecars) still parse as a known format.
+        Exit 0 ok, 1 problems.
+
+    monitor DIR [--interval S] [--iterations N]
+        Live per-rank view from the health-plane heartbeat sidecars
+        (docs/TELEMETRY.md "Health plane"): step counter, step rate,
+        current phase, phase age, delta vs the cross-rank median.
+        Curses-free — redraws in place on a TTY, appends snapshots
+        otherwise. Exit 0 after N iterations (default: run until ^C),
+        2 when DIR has no heartbeat sidecars to watch.
+
+    export-openmetrics DIR [--out FILE]
+        One Prometheus/OpenMetrics text snapshot of the run's gauges,
+        counters, and per-rank progress, metric keys verbatim in a
+        `key` label (scrape-ready; round-trips `run.gpts@4dev:scan`
+        keys exactly). Exit 0, 2 when DIR has neither rank streams nor
+        heartbeat sidecars.
 
 stdlib-only end to end: the read side of telemetry must run on machines
 that will never import jax (CI, a laptop holding a pod's stream).
@@ -29,7 +47,7 @@ import json
 import pathlib
 import sys
 
-from rocm_mpi_tpu.telemetry import aggregate, regress, trace
+from rocm_mpi_tpu.telemetry import aggregate, health, regress, trace
 
 
 def _cmd_summarize(args) -> int:
@@ -49,7 +67,10 @@ def _cmd_summarize(args) -> int:
     trace_path = pathlib.Path(
         args.trace or pathlib.Path(args.dir) / "telemetry-trace.json"
     )
-    trace.write_chrome_trace(streams, trace_path)
+    # Health sidecars, when the run left any, ride into the trace as
+    # progress counter tracks — same merge the post-mortem bundle gets.
+    beats, _ = health.load_heartbeats(args.dir)
+    trace.write_chrome_trace(streams, trace_path, heartbeats=beats or None)
     if args.json:
         print(json.dumps(summary, indent=1))
     else:
@@ -133,6 +154,64 @@ def _cmd_regress(args) -> int:
     return 0
 
 
+def _cmd_monitor(args) -> int:
+    import time
+
+    beats, skipped = health.load_heartbeats(args.dir)
+    if not beats:
+        print(
+            f"error: no heartbeat-rank*.json under {args.dir} — is a "
+            "--health run writing sidecars there? (docs/TELEMETRY.md)",
+            file=sys.stderr,
+        )
+        return 2
+    prev: dict[int, dict] | None = None
+    i = 0
+    clear_screen = sys.stdout.isatty()
+    try:
+        while True:
+            rows = health.monitor_rows(beats, prev)
+            if clear_screen:
+                print("\x1b[H\x1b[2J", end="")
+            print(f"health monitor: {args.dir}  "
+                  f"({len(beats)} rank(s), poll {args.interval:g}s)")
+            print(health.format_monitor(rows, skipped))
+            sys.stdout.flush()
+            i += 1
+            if args.iterations is not None and i >= args.iterations:
+                return 0
+            time.sleep(args.interval)
+            prev = beats
+            beats, skipped = health.load_heartbeats(args.dir)
+            if not beats:
+                print(f"error: heartbeat sidecars vanished from {args.dir}",
+                      file=sys.stderr)
+                return 2
+    except KeyboardInterrupt:
+        return 0
+
+
+def _cmd_export_openmetrics(args) -> int:
+    text = health.export_openmetrics(args.dir)
+    if text is None:
+        print(
+            f"error: nothing to export under {args.dir} (neither "
+            "telemetry-rank*.jsonl nor heartbeat-rank*.json)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.out:
+        out = pathlib.Path(args.out)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        tmp = out.with_suffix(out.suffix + ".tmp")
+        tmp.write_text(text)
+        tmp.replace(out)
+        print(f"wrote {out}", file=sys.stderr)
+    else:
+        print(text, end="")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m rocm_mpi_tpu.telemetry",
@@ -170,11 +249,32 @@ def main(argv=None) -> int:
                        help="only validate the files parse as known "
                             "measurement formats")
 
+    p_mon = sub.add_parser(
+        "monitor", help="live per-rank progress from heartbeat sidecars"
+    )
+    p_mon.add_argument("dir", help="directory of heartbeat-rank*.json")
+    p_mon.add_argument("--interval", type=float, default=1.0, metavar="S",
+                       help="poll interval in seconds (default %(default)s)")
+    p_mon.add_argument("--iterations", type=int, default=None, metavar="N",
+                       help="exit 0 after N redraws (default: run until ^C)")
+
+    p_om = sub.add_parser(
+        "export-openmetrics",
+        help="Prometheus text snapshot of gauges/counters/progress",
+    )
+    p_om.add_argument("dir", help="telemetry/health run directory")
+    p_om.add_argument("--out", default=None, metavar="FILE",
+                      help="write the snapshot here instead of stdout")
+
     args = parser.parse_args(argv)
     if args.command == "summarize":
         return _cmd_summarize(args)
     if args.command == "regress":
         return _cmd_regress(args)
+    if args.command == "monitor":
+        return _cmd_monitor(args)
+    if args.command == "export-openmetrics":
+        return _cmd_export_openmetrics(args)
     parser.print_usage(sys.stderr)
     return 2
 

@@ -82,6 +82,18 @@ _ANNOTATED: set = set()  # (name, sorted attrs) — trace-annotation dedup
 _MAX_HOT_RECORDS = 100_000
 _DROPPED = 0
 
+# Optional observer of every emitted record (the flight recorder's ring,
+# telemetry/flight.py). One slot, set/cleared whole — not a listener
+# list: the hot path pays one global read when no tap is installed.
+_TAP = None
+
+
+def set_tap(fn) -> None:
+    """Install (or with None clear) the single record tap. The tap runs
+    outside the emit lock and must never raise into the caller."""
+    global _TAP
+    _TAP = fn
+
 
 def enabled() -> bool:
     """The one hot-path guard: a plain module-global read."""
@@ -175,6 +187,12 @@ def emit(kind: str, name: str, *, buffer_always: bool = False,
         # (launcher drains, supervisor events) behind each append and
         # skew the very intervals being recorded on a slow sink.
         _write_line(json.dumps(rec))
+    tap = _TAP
+    if tap is not None:
+        try:
+            tap(rec)
+        except Exception:  # noqa: BLE001 — observability never kills a run
+            pass
     return rec
 
 
@@ -274,3 +292,13 @@ def clear(kind: str | None = None) -> None:
             _DROPPED = 0
         else:
             _RECORDS[:] = [r for r in _RECORDS if r["kind"] != kind]
+
+
+def clear_events() -> None:
+    """THE public reset for the structured event trail: drops buffered
+    "event"-kind records only — buffered spans/gauges and the
+    trace-annotation dedup set survive (a cleared dedup set would
+    re-emit once-per-program annotations on the next retrace). This is
+    the one behavior behind `metrics.clear_events()` (a deprecated
+    alias) and the flight recorder's reset path (flight.reset)."""
+    clear(kind="event")

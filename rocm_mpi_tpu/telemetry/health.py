@@ -1,0 +1,417 @@
+"""Runtime health plane, read side: sidecar tailing, the progress-aware
+stall verdict, post-mortem composition, the live monitor, and the
+OpenMetrics export.
+
+The write side (telemetry/flight.py) publishes one `heartbeat-rank{k}.json`
+per rank — counters, last phase entered, the flight ring — via atomic
+rename. Everything here only READS those sidecars (plus the rank JSONL
+streams for the merged timeline), so it runs out-of-process: in the
+launcher's watchdog thread, or on a box with no jax at all (the monitor
+and export CLI verbs). stdlib-only, like the rest of the read side.
+
+The stalled-collective signature
+--------------------------------
+Wall clock alone cannot name a wedged rank: when one rank dies or spins
+mid-collective, EVERY peer eventually blocks and all of them look
+equally idle. Progress counters can: the victim's step counter stopped
+first, so the cross-rank median of step counters (the same interpolating
+median aggregate.py's straggler detector uses) advances PAST it — peers
+bump their counter on entering the window the victim never reached, then
+block. `ProgressWatch` flags a rank when
+
+* its sidecar's progress content (counters + last phase) has not changed
+  for `stall_grace_s`, AND
+* the cross-rank median step counter is strictly ahead of its own.
+
+Only ranks that have PUBLISHED a step counter participate in the median
+and in verdicts (and at least two must have): a rank with no `step` yet
+has not entered an instrumented loop — it may be sitting out a
+weak-scaling rung it owns no devices in, or still compiling — and
+comparing its absence-of-progress against working ranks would get a
+healthy rank killed. The step counters of participating ranks are
+comparable by the writers' contract: apps bump one GLOBAL step count
+per process (weak_scaling banks skipped/completed rungs into the
+offset), never a per-phase restart that the recorder's monotonic guard
+would mask.
+
+A coordinated slow phase (everyone compiling, everyone in one long
+window) leaves every participating rank at the same counter — nobody is
+strictly behind the median, no verdict. That is the "by progress, not
+wall clock" contract the watchdog drill pins.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import pathlib
+import re
+import shutil
+import statistics
+import time
+
+from rocm_mpi_tpu.telemetry import aggregate
+from rocm_mpi_tpu.telemetry.flight import (
+    BUNDLE_SCHEMA,
+    BUNDLE_VERSION,
+    HEARTBEAT_SCHEMA,
+    POSTMORTEM_SCHEMA,
+    POSTMORTEM_VERSION,
+)
+
+DEFAULT_STALL_GRACE_S = 5.0
+
+_HEARTBEAT_RE = re.compile(r"heartbeat-rank(\d+)\.json$")
+_POSTMORTEM_RE = re.compile(r"postmortem-rank(\d+)\.json$")
+
+
+def heartbeat_paths(directory) -> dict[int, pathlib.Path]:
+    """{rank: sidecar path} under `directory`."""
+    out: dict[int, pathlib.Path] = {}
+    root = pathlib.Path(directory)
+    if not root.is_dir():
+        return out
+    for path in sorted(root.glob("heartbeat-rank*.json")):
+        m = _HEARTBEAT_RE.search(path.name)
+        if m:
+            out[int(m.group(1))] = path
+    return out
+
+
+def load_heartbeats(directory) -> tuple[dict[int, dict], int]:
+    """Parse every heartbeat sidecar. Returns ({rank: doc}, skipped).
+    A rank killed mid-write (or a reader racing the writer's rename on a
+    filesystem without atomic replace) leaves a torn file: counted and
+    skipped, never fatal — the surviving sidecars are the point."""
+    beats: dict[int, dict] = {}
+    skipped = 0
+    for rk, path in heartbeat_paths(directory).items():
+        try:
+            doc = json.loads(path.read_text())
+        except (OSError, ValueError):
+            skipped += 1
+            continue
+        if isinstance(doc, dict) and doc.get("schema") == HEARTBEAT_SCHEMA:
+            doc.setdefault("rank", rk)
+            beats[rk] = doc
+        else:
+            skipped += 1
+    return beats, skipped
+
+
+def _progress_key(doc: dict):
+    """What counts as progress: the counters and the phase — NOT the
+    wall stamp (a stalled rank's flusher may rewrite identical content
+    forever; that is liveness, not progress)."""
+    counters = doc.get("counters") or {}
+    return (tuple(sorted(counters.items())), doc.get("last_phase"),
+            doc.get("last_phase_name"))
+
+
+class ProgressWatch:
+    """Tracks per-rank progress across repeated sidecar observations and
+    issues stall verdicts (module docstring has the signature). Feed it
+    `observe(beats, now)` each poll; `now` is any monotonic clock."""
+
+    def __init__(self, stall_grace_s: float = DEFAULT_STALL_GRACE_S):
+        self.stall_grace_s = float(stall_grace_s)
+        self._state: dict[int, dict] = {}
+
+    def observe(self, beats: dict[int, dict], now: float) -> None:
+        for rk, doc in beats.items():
+            key = _progress_key(doc)
+            st = self._state.get(rk)
+            if st is None or st["key"] != key:
+                self._state[rk] = {"key": key, "changed_at": now, "doc": doc}
+            else:
+                st["doc"] = doc
+
+    def ages(self, now: float) -> dict[int, float]:
+        """Seconds since each rank's progress content last changed — the
+        per-rank ages the launcher's health heartbeat line reports."""
+        return {
+            rk: max(now - st["changed_at"], 0.0)
+            for rk, st in sorted(self._state.items())
+        }
+
+    def steps(self) -> dict[int, int]:
+        """Step counters of the PARTICIPATING ranks only (those that
+        have published a `step` at all — module docstring)."""
+        out = {}
+        for rk, st in self._state.items():
+            step = (st["doc"].get("counters") or {}).get("step")
+            if isinstance(step, (int, float)):
+                out[rk] = int(step)
+        return out
+
+    def verdicts(self, now: float) -> list[dict]:
+        """Ranks currently matching the stalled-collective signature,
+        worst (most-behind) first. Needs >= 2 ranks with published step
+        counters — there is no cross-rank median of one, and a rank
+        that never published progress cannot have stalled it."""
+        steps = self.steps()
+        if len(steps) < 2:
+            return []
+        median = statistics.median(steps.values())
+        out = []
+        for rk, st in sorted(self._state.items()):
+            if rk not in steps:
+                continue
+            stalled_for = now - st["changed_at"]
+            if stalled_for < self.stall_grace_s:
+                continue
+            if not steps[rk] < median:
+                continue
+            out.append({
+                "rank": rk,
+                "step": steps[rk],
+                "median_step": median,
+                "stalled_for_s": round(stalled_for, 3),
+                "last_phase": st["doc"].get("last_phase"),
+                "last_phase_name": st["doc"].get("last_phase_name"),
+            })
+        out.sort(key=lambda v: v["step"])
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Post-mortem composition and bundling (the watchdog's out-of-process half)
+# ---------------------------------------------------------------------------
+
+
+def write_postmortem(directory, rank: int, verdict: dict,
+                     traceback_text: str | None = None) -> pathlib.Path:
+    """Compose `postmortem-rank{k}.json` from the rank's last heartbeat,
+    the watchdog verdict, and the faulthandler dump (read from the
+    `.traceback` sidecar when not passed). Runs OUT of process — the
+    wedged rank only had to have flushed a heartbeat once and own a
+    registered faulthandler; everything else is the reader's job."""
+    root = pathlib.Path(directory)
+    # Wall-stamp the verdict IN PLACE (telemetry owns the clock reads —
+    # GL06): the caller's verdict list and the bundle's trace instants
+    # see the same stamp.
+    verdict.setdefault("t", time.time())
+    beats, _ = load_heartbeats(root)
+    if traceback_text is None:
+        tb_path = root / f"postmortem-rank{rank}.traceback"
+        try:
+            traceback_text = tb_path.read_text()
+        except OSError:
+            traceback_text = None
+    doc = {
+        "schema": POSTMORTEM_SCHEMA,
+        "v": POSTMORTEM_VERSION,
+        "rank": int(rank),
+        "t": time.time(),
+        "verdict": verdict,
+        "heartbeat": beats.get(rank),
+        "traceback": traceback_text,
+    }
+    path = root / f"postmortem-rank{rank}.json"
+    aggregate.write_json_atomic(path, doc)
+    return path
+
+
+def bundle_postmortem(directory, verdicts: list[dict]) -> pathlib.Path:
+    """Collect a run's wreckage into `<directory>/postmortem/`: the
+    per-rank post-mortems and heartbeats, a `bundle.json` naming the
+    verdicts, and a merged `timeline-trace.json` (the rank streams plus
+    progress counter tracks and one instant per verdict — the Chrome
+    trace an operator opens FIRST). Returns the bundle directory."""
+    from rocm_mpi_tpu.telemetry import trace
+
+    root = pathlib.Path(directory)
+    out = root / "postmortem"
+    if out.is_dir():
+        # The bundle describes THIS run's incident: a leftover bundle in
+        # a reused directory would mix last incident's per-rank files
+        # with the new verdicts and misattribute the wreckage.
+        shutil.rmtree(out, ignore_errors=True)
+    out.mkdir(parents=True, exist_ok=True)
+    copied = []
+    for pattern in ("postmortem-rank*.json", "postmortem-rank*.traceback",
+                    "heartbeat-rank*.json"):
+        for path in sorted(root.glob(pattern)):
+            try:
+                shutil.copy2(path, out / path.name)
+                copied.append(path.name)
+            except OSError:
+                continue
+    beats, _ = load_heartbeats(root)
+    streams, _ = aggregate.load_rank_streams(root)
+    try:
+        trace.write_chrome_trace(
+            streams, out / "timeline-trace.json",
+            heartbeats=beats, verdicts=verdicts,
+        )
+        copied.append("timeline-trace.json")
+    except Exception:  # noqa: BLE001 — the bundle must survive a bad stream
+        pass
+    bundle = {
+        "schema": BUNDLE_SCHEMA,
+        "v": BUNDLE_VERSION,
+        "t": time.time(),
+        "verdicts": verdicts,
+        "ranks": sorted(beats),
+        "files": sorted(set(copied)),
+    }
+    aggregate.write_json_atomic(out / "bundle.json", bundle)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Live monitor (the `monitor` CLI verb)
+# ---------------------------------------------------------------------------
+
+
+def monitor_rows(beats: dict[int, dict],
+                 prev: dict[int, dict] | None = None,
+                 now_wall: float | None = None) -> list[dict]:
+    """Per-rank monitor rows from one sidecar snapshot (plus the previous
+    snapshot for step rates). Stateless — the CLI loop owns the cadence."""
+    now_wall = time.time() if now_wall is None else now_wall
+    steps = {
+        rk: int((doc.get("counters") or {}).get("step", 0))
+        for rk, doc in beats.items()
+    }
+    median = statistics.median(steps.values()) if steps else 0.0
+    rows = []
+    for rk in sorted(beats):
+        doc = beats[rk]
+        rate = None
+        if prev and rk in prev:
+            d_step = steps[rk] - int(
+                (prev[rk].get("counters") or {}).get("step", 0)
+            )
+            d_t = (doc.get("t") or 0.0) - (prev[rk].get("t") or 0.0)
+            if d_t > 0:
+                rate = d_step / d_t
+        phase_t = doc.get("last_phase_t") or doc.get("t") or now_wall
+        rows.append({
+            "rank": rk,
+            "step": steps[rk],
+            "phase": doc.get("last_phase") or "-",
+            "age_s": max(now_wall - (doc.get("t") or now_wall), 0.0),
+            "phase_age_s": max(now_wall - phase_t, 0.0),
+            "rate": rate,
+            "delta_vs_median": steps[rk] - median,
+        })
+    return rows
+
+
+def format_monitor(rows: list[dict], skipped: int = 0) -> str:
+    lines = [
+        "rank  step      rate/s   phase         phase-age  Δmedian",
+    ]
+    for r in rows:
+        rate = f"{r['rate']:8.2f}" if r["rate"] is not None else "       ?"
+        lines.append(
+            f"{r['rank']:<5d} {r['step']:<9d} {rate} "
+            f"{r['phase']:<13s} {r['phase_age_s']:8.1f}s  "
+            f"{r['delta_vs_median']:+g}"
+        )
+    if skipped:
+        lines.append(f"({skipped} torn sidecar(s) skipped)")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics export (the `export-openmetrics` CLI verb)
+# ---------------------------------------------------------------------------
+
+
+def _om_escape(value: str) -> str:
+    return (
+        str(value).replace("\\", r"\\").replace('"', r'\"')
+        .replace("\n", r"\n")
+    )
+
+
+def _om_number(v) -> str:
+    f = float(v)
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    return repr(f) if isinstance(v, float) else str(v)
+
+
+def export_openmetrics(directory) -> str | None:
+    """A Prometheus/OpenMetrics text snapshot of the run's gauges,
+    counters, and per-rank progress. The run's own metric keys (e.g.
+    `run.gpts@4dev:scan`) contain characters OpenMetrics metric names
+    forbid, so every key rides VERBATIM in a `key` label under three
+    fixed metric families — the snapshot round-trips exactly, no lossy
+    renaming. Returns None when `directory` holds neither rank streams
+    nor heartbeat sidecars (the caller's exit-2 case)."""
+    streams, _ = aggregate.load_rank_streams(directory)
+    beats, _ = load_heartbeats(directory)
+    if not streams and not beats:
+        return None
+    summary = aggregate.summarize(streams) if streams else None
+    lines = []
+    if summary:
+        lines.append("# TYPE rmt_gauge gauge")
+        lines.append("# HELP rmt_gauge telemetry gauges, key verbatim "
+                     "(rank-median where multiple ranks emitted)")
+        for key in sorted(summary["gauges"]):
+            value = summary["gauges"][key]
+            if isinstance(value, (int, float)) and not isinstance(value, bool):
+                lines.append(
+                    f'rmt_gauge{{key="{_om_escape(key)}"}} '
+                    f"{_om_number(value)}"
+                )
+        lines.append("# TYPE rmt_counter counter")
+        lines.append("# HELP rmt_counter telemetry counters, key verbatim")
+        for key in sorted(summary["counters"]):
+            lines.append(
+                f'rmt_counter_total{{key="{_om_escape(key)}"}} '
+                f"{_om_number(summary['counters'][key])}"
+            )
+    if beats:
+        lines.append("# TYPE rmt_progress gauge")
+        lines.append("# HELP rmt_progress flight-recorder progress "
+                     "counters per rank (heartbeat sidecars)")
+        for rk in sorted(beats):
+            counters = beats[rk].get("counters") or {}
+            for name in sorted(counters):
+                value = counters[name]
+                if isinstance(value, (int, float)):
+                    lines.append(
+                        f'rmt_progress{{rank="{rk}",'
+                        f'counter="{_om_escape(name)}"}} '
+                        f"{_om_number(value)}"
+                    )
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+def parse_openmetrics(text: str) -> dict[str, dict]:
+    """Parse an export back into {family: {label-tuple or key: value}} —
+    the round-trip half the export test pins; also handy for scrapers
+    that want the values without a Prometheus client."""
+    out: dict[str, dict] = {}
+    sample_re = re.compile(
+        r'^(\w+)\{(.*)\}\s+(\S+)$'
+    )
+    label_re = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = sample_re.match(line)
+        if not m:
+            continue
+        family, labelstr, value = m.groups()
+        # Single-pass unescape (\\ \" \n): ordered str.replace would
+        # consume the second character of an escaped backslash as a
+        # fresh escape and corrupt values like 'a\\nb'.
+        unescape = {"n": "\n", '"': '"', "\\": "\\"}
+        labels = {
+            k: re.sub(
+                r"\\(.)", lambda m: unescape.get(m.group(1), m.group(1)), v
+            )
+            for k, v in label_re.findall(labelstr)
+        }
+        key = labels.get("key") or tuple(sorted(labels.items()))
+        out.setdefault(family, {})[key] = float(value)
+    return out

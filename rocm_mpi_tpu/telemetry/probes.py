@@ -130,3 +130,55 @@ def run_diffusion_phase_probes(model, iters: int = 10,
             ckpt.restore_state(checkpoint_dir, 0, (T,))
         except Exception as e:  # noqa: BLE001 — a probe must not kill the run
             events.record_event("probe-failed", error=f"checkpoint: {e!r}")
+
+
+def make_halo_heartbeat(model):
+    """Build the per-window halo heartbeat for the health plane: one
+    compiled single-exchange program over `model`'s grid, returned as
+    `beat(x) -> x` which runs the exchange under a
+    `halo.heartbeat` span (phase=halo, probe=True, real wire bytes).
+
+    Purpose (docs/TELEMETRY.md "Health plane"): the fused windowed run
+    gives the flight recorder nothing halo-shaped at runtime — the
+    exchanges live inside the compiled window. One real cross-rank
+    exchange per window boundary is a live probe of the collective
+    fabric: its span feeds the flight ring (so a rank wedged at a
+    boundary reads "last phase: halo", which is what it is blocked on),
+    its latency lands in the halo phase attribution marked probe:true,
+    and its cost is one exchange per WINDOW, not per step. Compile the
+    returned callable once (call it during warmup, before
+    compiles.mark_steady) — it is jitted and reused.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from rocm_mpi_tpu.parallel.halo import exchange_halo, exchange_nbytes
+    from rocm_mpi_tpu.utils.compat import shard_map
+
+    grid = model.grid
+    cfg = model.config
+    core = tuple(slice(1, -1) for _ in range(grid.ndim))
+    n_local_devices = sum(
+        1 for d in grid.mesh.devices.flat
+        if d.process_index == jax.process_index()
+    )
+    nbytes = exchange_nbytes(
+        grid.local_shape, jnp.dtype(cfg.jax_dtype).itemsize
+    ) * n_local_devices
+
+    @jax.jit
+    def one_exchange(x):
+        def local(xl):
+            return exchange_halo(xl, grid)[core]
+
+        return shard_map(
+            local, mesh=grid.mesh, in_specs=(grid.spec,),
+            out_specs=grid.spec, check_vma=False,
+        )(x)
+
+    def beat(x):
+        with span("halo.heartbeat", phase="halo", probe=True,
+                  bytes=nbytes) as sp:
+            return sp.sync(one_exchange(x))
+
+    return beat

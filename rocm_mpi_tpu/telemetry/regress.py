@@ -16,9 +16,15 @@ Comparable metrics are extracted from the summary schema
 (aggregate.SUMMARY_SCHEMA) with an explicit direction each:
 
     lower is better    steps.per_step_us.{mean,p50,p90,p99},
-                       phases.{halo,interior,checkpoint}.wall_s
-    higher is better   phases.halo.bytes_per_s, every numeric gauge
-                       (gauges are rates: gpts, t_eff — the driver metric)
+                       phases.{halo,interior,checkpoint}.wall_s,
+                       gauges.compiles.* (compile/recompile counts —
+                       included even at 0: "zero recompiles after
+                       warmup" is a real measurement, and a zero
+                       baseline makes ANY steady-state recompile a
+                       gated regression)
+    higher is better   phases.halo.bytes_per_s, every other numeric
+                       gauge (gauges are rates: gpts, t_eff — the
+                       driver metric)
 
 A baseline may be (a) a summary from a previous run — the normal flow:
 bank today's summary, gate tomorrow's run against it — or (b) a hand-flat
@@ -93,7 +99,14 @@ def extract_metrics(doc: dict) -> dict[str, tuple[float, str]]:
         if ph == "halo" and isinstance(bps, (int, float)) and bps > 0:
             out["phases.halo.bytes_per_s"] = (float(bps), HIGHER)
     for name, v in (doc.get("gauges") or {}).items():
-        if isinstance(v, (int, float)) and v > 0:
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            continue
+        if name.startswith("compiles."):
+            # Compile counts: fewer is better and ZERO is evidence (the
+            # steady-state contract), unlike the rate gauges where an
+            # absent/zero value means "not measured".
+            out[f"gauges.{name}"] = (float(v), LOWER)
+        elif v > 0:
             out[f"gauges.{name}"] = (float(v), HIGHER)
     return out
 
@@ -111,6 +124,16 @@ def compare(summary: dict, baseline: dict,
         b_val, direction = base[name]
         c_val, _ = cur[name]
         if b_val == 0:
+            if direction == HIGHER:
+                continue  # no meaningful relative change off a 0 rate
+            # A lower-is-better zero baseline is a hard pin (the
+            # compiles.steady_state == 0 contract): any rise regresses.
+            change = float("inf") if c_val > 0 else 0.0
+            worse = c_val > 0
+            deltas.append(Delta(
+                name=name, direction=direction, baseline=b_val,
+                current=c_val, change=change, regressed=worse,
+            ))
             continue
         change = (c_val - b_val) / abs(b_val)
         worse = change > tolerance if direction == LOWER \
@@ -143,9 +166,20 @@ def load_json(path) -> dict | None:
 
 def _classify_json(doc: dict) -> str | None:
     from rocm_mpi_tpu.telemetry.aggregate import SUMMARY_SCHEMA
+    from rocm_mpi_tpu.telemetry.flight import (
+        BUNDLE_SCHEMA,
+        HEARTBEAT_SCHEMA,
+        POSTMORTEM_SCHEMA,
+    )
 
-    if doc.get("schema") == SUMMARY_SCHEMA:
-        return "telemetry summary"
+    named = {
+        SUMMARY_SCHEMA: "telemetry summary",
+        HEARTBEAT_SCHEMA: "health heartbeat sidecar",
+        POSTMORTEM_SCHEMA: "health post-mortem",
+        BUNDLE_SCHEMA: "health post-mortem bundle",
+    }
+    if doc.get("schema") in named:
+        return named[doc["schema"]]
     if "metrics" in doc and isinstance(doc["metrics"], dict):
         return "flat metrics baseline"
     if "metric" in doc and "north_star" in doc:

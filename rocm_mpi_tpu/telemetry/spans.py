@@ -30,7 +30,7 @@ from __future__ import annotations
 import threading
 import time
 
-from rocm_mpi_tpu.telemetry import events
+from rocm_mpi_tpu.telemetry import events, flight
 
 _stack = threading.local()
 
@@ -52,6 +52,12 @@ class Span:
         self._depth = _depth()
         _stack.depth = self._depth + 1
         self._tid = threading.get_ident()
+        if flight.enabled():
+            # Entry note BEFORE the clock reads: a rank that wedges
+            # inside this span never reaches __exit__'s record, and the
+            # flight recorder's "last phase entered" must already say so
+            # (heartbeat sidecar, telemetry/flight.py).
+            flight.enter_phase(self.name, self.attrs)
         self._t_wall = time.time()
         self._t_mono = time.perf_counter()
         return self

@@ -15,6 +15,21 @@ standard trace-event JSON every Chrome/Perfetto build renders
             exchange) land in the rank's metadata args, not on the
             timeline (they have no duration).
 
+Health-plane inputs (optional — the post-mortem bundle's merged
+timeline, telemetry/health.py):
+
+* heartbeat sidecars -> one counter track per rank: each heartbeat's
+  progress counters become a "progress" counter sample (ph "C") at the
+  heartbeat's wall stamp, so the stalled rank's flat-lining step counter
+  is visible right on its track.
+* watchdog verdicts  -> one global instant (ph "i", scope "g") each,
+  pinned to the flagged rank's track and carrying the verdict args —
+  the first thing an operator should see when the trace opens.
+
+Events are emitted sorted by ts (metadata first): Perfetto tolerates
+unsorted input, but the post-mortem reader (and the tests) treat the
+file as a timeline and must not have to re-sort it.
+
 Cross-rank alignment uses the records' WALL timestamps (`t`): each
 process's monotonic origin is arbitrary, so `t_mono` orders within a
 rank but cannot place ranks against each other. The trace origin is the
@@ -31,15 +46,29 @@ import pathlib
 TRACE_REQUIRED_KEYS = ("name", "ph", "ts", "pid")
 
 
-def to_chrome_trace(streams: dict[int, list[dict]]) -> dict:
+def to_chrome_trace(streams: dict[int, list[dict]],
+                    heartbeats: dict[int, dict] | None = None,
+                    verdicts: list[dict] | None = None) -> dict:
     """Build the trace-event document from per-rank record streams
-    (aggregate.load_rank_streams shape)."""
+    (aggregate.load_rank_streams shape), optionally merged with health
+    sidecars and watchdog verdicts (module docstring)."""
     all_recs = [r for recs in streams.values() for r in recs]
     wall_stamps = [r["t"] for r in all_recs if isinstance(r.get("t"),
                                                           (int, float))]
+    for doc in (heartbeats or {}).values():
+        if isinstance(doc.get("t"), (int, float)):
+            wall_stamps.append(doc["t"])
     origin = min(wall_stamps) if wall_stamps else 0.0
 
     events: list[dict] = []
+    ranks = sorted(set(streams) | set(heartbeats or {}))
+    for rk in ranks:
+        if rk in streams:
+            continue
+        events.append({
+            "name": "process_name", "ph": "M", "pid": rk, "ts": 0,
+            "args": {"name": f"rank {rk}"},
+        })
     for rk in sorted(streams):
         events.append({
             "name": "process_name",
@@ -94,6 +123,39 @@ def to_chrome_trace(streams: dict[int, list[dict]]) -> dict:
                     "ts": 0,
                     "args": attrs,
                 })
+    for rk in sorted(heartbeats or {}):
+        doc = heartbeats[rk]
+        t = doc.get("t")
+        counters = doc.get("counters") or {}
+        if not isinstance(t, (int, float)) or not counters:
+            continue
+        events.append({
+            "name": "progress",
+            "ph": "C",
+            "ts": (t - origin) * 1e6,
+            "pid": rk,
+            "args": {
+                k: v for k, v in sorted(counters.items())
+                if isinstance(v, (int, float))
+            },
+        })
+    for v in verdicts or []:
+        rk = v.get("rank", 0)
+        t = v.get("t")
+        ts = (t - origin) * 1e6 if isinstance(t, (int, float)) else 0.0
+        events.append({
+            "name": "watchdog.verdict",
+            "ph": "i",
+            "s": "g",  # global scope: a verdict indicts the whole run
+            "ts": max(ts, 0.0),
+            "pid": rk,
+            "args": {
+                k: val for k, val in v.items()
+                if k in ("rank", "step", "median_step", "stalled_for_s",
+                         "last_phase", "last_phase_name")
+            },
+        })
+    events.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0)))
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",
@@ -101,10 +163,12 @@ def to_chrome_trace(streams: dict[int, list[dict]]) -> dict:
     }
 
 
-def write_chrome_trace(streams: dict[int, list[dict]], path) -> dict:
+def write_chrome_trace(streams: dict[int, list[dict]], path,
+                       heartbeats: dict[int, dict] | None = None,
+                       verdicts: list[dict] | None = None) -> dict:
     """Export `streams` as trace-event JSON at `path`; returns the doc."""
     from rocm_mpi_tpu.telemetry.aggregate import write_json_atomic
 
-    doc = to_chrome_trace(streams)
+    doc = to_chrome_trace(streams, heartbeats=heartbeats, verdicts=verdicts)
     write_json_atomic(pathlib.Path(path), doc, indent=None)
     return doc

@@ -28,6 +28,7 @@ import inspect
 __all__ = [
     "axis_size",
     "cost_analysis_dict",
+    "install_compile_listener",
     "multihost_utils",
     "out_struct_like",
     "pallas",
@@ -73,6 +74,106 @@ def cost_analysis_dict(compiled) -> dict:
     if isinstance(cost, (list, tuple)):
         cost = cost[0] if cost else {}
     return dict(cost or {})
+
+
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+_COMPILE_LISTENER_MODE: str | None = None
+
+
+def install_compile_listener(on_interval, on_event=None) -> str | None:
+    """Tap jax's compile pipeline for the compile tracker
+    (telemetry/compiles.py). Where the hook lands is pure version drift,
+    so it lives HERE, the one chokepoint a jax bump revisits:
+
+    * preferred ("named"): wrap `jax._src.dispatch.log_elapsed_time`,
+      the context manager every trace/lower/backend-compile interval in
+      0.4.x runs under — its `fun_name` is the per-program identity the
+      public monitoring API does not carry. Call sites resolve it as a
+      module attribute at call time, so the install-once rebind below is
+      effective without reimporting anything.
+    * fallback ("events"): `jax.monitoring`'s duration listener — same
+      events, `name=None` (per-program attribution degrades to totals,
+      the tracker still counts).
+
+    `on_interval(event, name, dur_s)` receives every completed interval
+    (event is e.g. BACKEND_COMPILE_EVENT); `on_event(event)` receives
+    point events (persistent-cache hit/miss). Both are wrapped so a
+    listener exception can never break a compile. Installs at most once
+    per process; returns the active mode ("named"/"events"/None).
+    """
+    global _COMPILE_LISTENER_MODE
+    if _COMPILE_LISTENER_MODE is not None:
+        return _COMPILE_LISTENER_MODE
+    import contextlib
+    import time
+
+    def _safe_interval(event, name, dur_s):
+        try:
+            on_interval(event, name, dur_s)
+        except Exception:  # noqa: BLE001 — never break a compile
+            pass
+
+    mode = None
+    try:
+        from jax._src import dispatch as _dispatch
+
+        _orig = _dispatch.log_elapsed_time
+
+        @contextlib.contextmanager
+        def _tapped_log_elapsed_time(*args, **kwargs):
+            # Signature-transparent on purpose: the pinned jax calls
+            # (fmt, fun_name=…, event=…), but a bumped jax that adds or
+            # renames a parameter must cost ATTRIBUTION, not the run —
+            # a TypeError here would propagate out of every jit trace.
+            fun_name = kwargs.get("fun_name")
+            event = kwargs.get("event")
+            if len(args) > 1 and fun_name is None:
+                fun_name = args[1]
+            if len(args) > 2 and event is None:
+                event = args[2]
+            t0 = time.monotonic()
+            with _orig(*args, **kwargs):
+                yield
+            # Only a COMPLETED interval counts (an aborted compile is an
+            # error, not a compile); jax's own listeners already fired
+            # inside _orig's exit.
+            _safe_interval(event, fun_name, time.monotonic() - t0)
+
+        # The install-once seam this function exists for — not a
+        # trace-time knob (GL02's hazard); cached programs are
+        # unaffected, only future compiles pass through the tap.
+        _dispatch.log_elapsed_time = _tapped_log_elapsed_time  # graftlint: disable=GL02
+        mode = "named"
+    except Exception:  # noqa: BLE001 — private-module drift: fall back
+        try:
+            import jax.monitoring
+
+            jax.monitoring.register_event_duration_secs_listener(
+                lambda event, duration, **kw: _safe_interval(
+                    event, None, duration
+                )
+            )
+            mode = "events"
+        except Exception:  # noqa: BLE001
+            return None
+    if on_event is not None:
+        try:
+            import jax.monitoring
+
+            def _safe_event(event, **kw):
+                try:
+                    on_event(event)
+                except Exception:  # noqa: BLE001
+                    pass
+
+            jax.monitoring.register_event_listener(_safe_event)
+        except Exception:  # noqa: BLE001 — hit/miss counts degrade to 0
+            pass
+    _COMPILE_LISTENER_MODE = mode
+    return mode
 
 
 def _resolve_lazy(name: str):

@@ -207,6 +207,18 @@ def events(kind: str | None = None) -> list[RunEvent]:
 
 
 def clear_events() -> None:
-    """Drop the event trail only — buffered spans/gauges and the
-    trace-annotation dedup state belong to telemetry and survive."""
-    _tel.clear(kind="event")
+    """Deprecated alias for `telemetry.clear_events()` — the one public
+    reset for the event trail (events dropped, buffered spans/gauges and
+    the trace-annotation dedup state preserved). The two spellings used
+    to live side by side with the behavior defined only here; the
+    telemetry side now owns it (the flight recorder's reset path goes
+    through the same function), and this shim just forwards."""
+    import warnings
+
+    warnings.warn(
+        "utils.metrics.clear_events() is deprecated; call "
+        "telemetry.clear_events()",
+        DeprecationWarning,
+        stacklevel=2,
+    )
+    _tel.clear_events()

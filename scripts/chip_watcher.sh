@@ -105,11 +105,20 @@ archive_telemetry() {
   local found=0 f
   if [ -d "$tdir" ]; then
     for f in "$tdir"/telemetry-rank*.jsonl "$tdir"/telemetry-summary.json \
-             "$tdir"/telemetry-trace.json; do
+             "$tdir"/telemetry-trace.json "$tdir"/heartbeat-rank*.json \
+             "$tdir"/postmortem-rank*.json "$tdir"/postmortem-rank*.traceback; do
       [ -s "$f" ] || continue
       mkdir -p docs/telemetry_r5
       cp -p "$f" docs/telemetry_r5/ && found=$((found + 1))
     done
+    # A watchdog verdict leaves a postmortem/ bundle (docs/TELEMETRY.md
+    # "Health plane"): the one artifact that explains a wedged window
+    # after the tunnel flaps — archive it whole, next to the telemetry.
+    if [ -d "$tdir/postmortem" ]; then
+      mkdir -p docs/telemetry_r5/postmortem
+      cp -pr "$tdir/postmortem/." docs/telemetry_r5/postmortem/ \
+        && found=$((found + 1))
+    fi
   fi
   # The bench trajectory (BENCH_r{n}.json, written by bench.py --suite in
   # the telemetry regress flat-metrics format) is banked alongside: a

@@ -29,10 +29,26 @@ env JAX_PLATFORMS=cpu python -m rocm_mpi_tpu.analysis \
 # failing loudly (exit 2 "missing") if their files disappear.
 shopt -s nullglob
 bench_records=(BENCH_r*.json)
+# Health-plane sidecars (heartbeat-rank*.json, postmortem-rank*.json,
+# postmortem/bundle.json — docs/TELEMETRY.md "Health plane") are runtime
+# artifacts: they exist only after a --health run or a watchdog verdict,
+# under the default sink and wherever chip_watcher archived them. When
+# present they must parse as their committed schema — a drifted writer
+# would brick every watchdog/monitor reader at the next real incident.
+health_records=(
+  output/telemetry/heartbeat-rank*.json
+  output/telemetry/postmortem-rank*.json
+  output/telemetry/postmortem/postmortem-rank*.json
+  output/telemetry/postmortem/bundle*.json
+  docs/telemetry_r*/heartbeat-rank*.json
+  docs/telemetry_r*/postmortem/postmortem-rank*.json
+  docs/telemetry_r*/postmortem/bundle*.json
+)
 shopt -u nullglob
 env JAX_PLATFORMS=cpu python -m rocm_mpi_tpu.telemetry regress \
   --check-schema BASELINE.json MULTICHIP_r0*.json \
   ${bench_records[@]+"${bench_records[@]}"} \
+  ${health_records[@]+"${health_records[@]}"} \
   docs/weak_scaling_*mechanics*.jsonl 1>&2 || exit $?
 # Compiled HBM-traffic gate (docs/PERF.md): lowers + audits every
 # distributed step driver against perf/budgets.json on virtual CPU

@@ -1,0 +1,26 @@
+"""GL07 true negative: the sanctioned signal idioms — constants,
+delivery, and non-signal lookalikes; no handler installs."""
+
+import os
+import signal
+import subprocess
+
+
+def nudge_rank(proc: subprocess.Popen):
+    # DELIVERING a signal is fine anywhere; only handler installation
+    # is owned by telemetry/flight.py + resilience/.
+    if hasattr(signal, "SIGUSR2"):
+        proc.send_signal(signal.SIGUSR2)
+
+
+def kill_by_pid(pid: int):
+    os.kill(pid, signal.SIGTERM)
+
+
+class Radio:
+    def signal(self, strength):
+        return strength * 2
+
+
+def not_the_signal_module(radio: Radio):
+    return radio.signal(3)  # attribute named `signal` on a non-module

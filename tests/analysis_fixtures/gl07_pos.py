@@ -1,0 +1,15 @@
+"""GL07 true positive: handler installs and faulthandler outside the
+health-plane owners, all flagged spellings."""
+
+import faulthandler  # GL07: importing the capability
+import signal
+from signal import signal as install_handler
+
+
+def hijack_sigusr2():
+    signal.signal(signal.SIGUSR2, lambda *_: None)   # GL07: steals the hook
+    faulthandler.enable()
+
+
+def hijack_from_import():
+    install_handler(signal.SIGTERM, lambda *_: None)  # GL07: alias spelling

@@ -40,7 +40,9 @@ def live_rules(findings) -> set[str]:
 # ---------------------------------------------------------------------------
 
 
-@pytest.mark.parametrize("rule_id", ["GL01", "GL02", "GL03", "GL04", "GL05", "GL06"])
+@pytest.mark.parametrize(
+    "rule_id", ["GL01", "GL02", "GL03", "GL04", "GL05", "GL06", "GL07"]
+)
 def test_rule_true_positive(rule_id):
     findings = lint_fixture(f"{rule_id.lower()}_pos.py")
     assert rule_id in live_rules(findings), (
@@ -51,7 +53,9 @@ def test_rule_true_positive(rule_id):
     assert live_rules(findings) == {rule_id}
 
 
-@pytest.mark.parametrize("rule_id", ["GL01", "GL02", "GL03", "GL04", "GL05", "GL06"])
+@pytest.mark.parametrize(
+    "rule_id", ["GL01", "GL02", "GL03", "GL04", "GL05", "GL06", "GL07"]
+)
 def test_rule_true_negative(rule_id):
     findings = lint_fixture(f"{rule_id.lower()}_neg.py")
     assert rule_id not in live_rules(findings), (
@@ -87,6 +91,39 @@ def test_gl06_monotonic_and_sleep_stay_clean():
         "time.sleep(0.1)\n"
     )
     assert lint_source(src, "repo/apps/foo.py") == []
+
+
+def test_gl07_owners_are_exempt():
+    """telemetry/flight.py and resilience/ own signal handlers; the same
+    source is a finding anywhere else — including the launcher, which
+    may SEND signals but never install handlers."""
+    src = (
+        "import faulthandler\nimport signal\n"
+        "faulthandler.register(signal.SIGUSR2)\n"
+        "signal.signal(signal.SIGTERM, None)\n"
+    )
+    for owner in (
+        "repo/rocm_mpi_tpu/telemetry/flight.py",
+        "repo/rocm_mpi_tpu/resilience/faults.py",
+        "repo/rocm_mpi_tpu/resilience/supervisor.py",
+    ):
+        assert "GL07" not in live_rules(lint_source(src, owner)), owner
+    for elsewhere in (
+        "repo/rocm_mpi_tpu/parallel/launcher.py",
+        "repo/rocm_mpi_tpu/telemetry/events.py",
+        "repo/apps/foo.py",
+    ):
+        assert "GL07" in live_rules(lint_source(src, elsewhere)), elsewhere
+
+
+def test_gl07_sending_signals_stays_clean():
+    src = (
+        "import os\nimport signal\n"
+        "def f(p):\n"
+        "    p.send_signal(signal.SIGUSR2)\n"
+        "    os.kill(1234, signal.SIGTERM)\n"
+    )
+    assert lint_source(src, "repo/rocm_mpi_tpu/parallel/launcher.py") == []
 
 
 def test_gl02_flags_cross_module_and_traced_global():

@@ -40,10 +40,15 @@ NT, EVERY = 32, 8
 
 @pytest.fixture(autouse=True)
 def _clean_events_and_faults():
-    metrics.clear_events()
+    # The unified public reset (telemetry.clear_events): events dropped,
+    # annotation dedup preserved; metrics.clear_events is the deprecated
+    # alias over the same behavior.
+    from rocm_mpi_tpu import telemetry
+
+    telemetry.clear_events()
     yield
     faults.install(None)
-    metrics.clear_events()
+    telemetry.clear_events()
 
 
 def _model(dims=(2, 4)):

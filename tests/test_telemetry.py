@@ -111,7 +111,9 @@ def test_record_event_is_versioned_and_monotonic():
     assert metrics.events("backoff")[0].wait_s == 0.5
     doc = json.loads(b.to_json())
     assert doc["v"] == 2 and "t_mono" in doc
-    metrics.clear_events()
+    # the unified public reset (telemetry.clear_events); the deprecated
+    # metrics.clear_events alias is pinned in tests/test_health.py
+    events.clear_events()
     assert metrics.events() == []
 
 
