@@ -52,11 +52,18 @@ class DeepSchedule(NamedTuple):
     loop-invariant operands once (returning block-padded global arrays —
     each shard's slice is its k-padded block), `sweep(state…, prepared)`
     advances the state k steps with one state exchange. Callers jit
-    `prepare` outside their step loop and carry only the state."""
+    `prepare` outside their step loop and carry only the state.
+
+    `rebuild(new_grid)` re-derives the SAME schedule (physics constants,
+    depth, local form) for a new decomposition — the elastic-resume path
+    (rebuild_for_mesh below): ghost widths, padded block geometry, face
+    masks, and the VMEM-vs-HBM local-kernel routing all depend on the
+    shard shape, so nothing built for the old mesh may be reused."""
 
     prepare: Callable
     sweep: Callable
     k: int
+    rebuild: Callable | None = None
 
 
 def _validate_depth(grid: GlobalGrid, k: int, label: str = "sweep depth"):
@@ -98,6 +105,27 @@ def padded_update_coefficient(Cp_padded, grid: GlobalGrid, width: int,
     mask = padded_hold_mask(Cp_padded.shape, grid, width)
     safe = jnp.where(Cp_padded == 0, jnp.ones_like(Cp_padded), Cp_padded)
     return jnp.where(mask, jnp.zeros_like(Cp_padded), (dt * lam) / safe)
+
+
+def rebuild_for_mesh(sched: DeepSchedule, new_grid: GlobalGrid,
+                     dims=None, devices=None) -> DeepSchedule:
+    """Re-derive `sched` for a new decomposition of the same global
+    domain (docs/RESILIENCE.md "Elastic recovery"). `new_grid` is the
+    rebuilt GlobalGrid (mesh.rebuild_for_mesh output), or the OLD grid
+    together with `dims`/`devices` to rebuild here. Depth validation is
+    the builder's own (_validate_depth): a mesh grown so far that k
+    exceeds a shard extent fails loudly, exactly as a fresh build would."""
+    if sched.rebuild is None:
+        raise ValueError(
+            "this DeepSchedule predates the rebuild path (built by hand?) "
+            "— reconstruct it with its make_*_deep_sweep builder"
+        )
+    if dims is not None or devices is not None:
+        from rocm_mpi_tpu.parallel import mesh as _mesh
+
+        new_grid = _mesh.rebuild_for_mesh(new_grid, dims=dims,
+                                          devices=devices)
+    return sched.rebuild(new_grid)
 
 
 def make_deep_sweep(grid: GlobalGrid, k: int, lam, dt, spacing,
@@ -217,7 +245,11 @@ def make_deep_sweep(grid: GlobalGrid, k: int, lam, dt, spacing,
             check_vma=False,
         )(T, Cm)
 
-    return DeepSchedule(prepare, sweep, k)
+    return DeepSchedule(
+        prepare, sweep, k,
+        rebuild=lambda g: make_deep_sweep(g, k, lam, dt, spacing,
+                                          local_form=local_form),
+    )
 
 
 def padded_face_mask(shape, grid: GlobalGrid, axis: int, width: int, dtype):
@@ -315,7 +347,10 @@ def make_swe_deep_sweep(grid: GlobalGrid, k: int, dt, spacing, H,
         )(h, *us, *Mus_padded)
         return outs[0], tuple(outs[1:])
 
-    return DeepSchedule(prepare, sweep, k)
+    return DeepSchedule(
+        prepare, sweep, k,
+        rebuild=lambda ng: make_swe_deep_sweep(ng, k, dt, spacing, H, g),
+    )
 
 
 def make_wave_deep_sweep(grid: GlobalGrid, k: int, dt,
@@ -387,4 +422,7 @@ def make_wave_deep_sweep(grid: GlobalGrid, k: int, dt,
             check_vma=False,
         )(U, Uprev, M, Cw)
 
-    return DeepSchedule(prepare, sweep, k)
+    return DeepSchedule(
+        prepare, sweep, k,
+        rebuild=lambda g: make_wave_deep_sweep(g, k, dt, spacing),
+    )

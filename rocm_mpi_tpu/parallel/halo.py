@@ -39,6 +39,7 @@ correctness oracle — "is it the device collective or my math?" (SURVEY.md §4.
 from __future__ import annotations
 
 import math
+from typing import Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -199,6 +200,60 @@ def exchange_halo(u, grid: GlobalGrid, width: int = 1, axes=None):
             block=tuple(int(n) for n in u.shape),
         )
     return exchange_into(place_core(u, width, axes), grid, width, axes)
+
+
+class HaloProgram(NamedTuple):
+    """A halo exchange family bound to one decomposition: the grid it was
+    derived for, the ghost width, the bound `exchange(u)` closure (inside
+    shard_map), and `nbytes(itemsize)` — the per-interior-device wire
+    bytes of one call (the telemetry/traffic accounting figure)."""
+
+    grid: GlobalGrid
+    width: int
+    exchange: Callable
+    nbytes: Callable
+
+
+def build_for_mesh(grid: GlobalGrid, width: int = 1) -> HaloProgram:
+    """Bind the halo exchange family to `grid` — the derivation
+    `rebuild_for_mesh` re-runs when the decomposition changes."""
+    return HaloProgram(
+        grid=grid,
+        width=width,
+        exchange=lambda u, axes=None: exchange_halo(u, grid, width, axes),
+        nbytes=lambda itemsize, axes=None: exchange_nbytes(
+            grid.local_shape, itemsize, width, axes
+        ),
+    )
+
+
+def rebuild_for_mesh(
+    program_or_grid, dims=None, devices=None, width: int | None = None
+) -> HaloProgram:
+    """Re-derive the halo programs for a NEW decomposition of the same
+    global domain (docs/RESILIENCE.md "Elastic recovery"): an elastic
+    resume lands a checkpoint on a different mesh, and every per-mesh
+    derived quantity — neighbor structure, ghost slice shapes, wire
+    bytes, the boundary-mask geometry the exchange's zero-ghost
+    convention leans on — must come from the NEW dims, never be reused
+    from the old. Accepts a HaloProgram (rebuilds its grid and width) or
+    a GlobalGrid; `dims`/`devices` follow mesh.rebuild_for_mesh (default:
+    the plan_dims sub-mesh over the current devices)."""
+    from rocm_mpi_tpu.parallel import mesh as _mesh
+
+    if isinstance(program_or_grid, HaloProgram):
+        old_grid = program_or_grid.grid
+        width = program_or_grid.width if width is None else width
+    else:
+        old_grid = program_or_grid
+        width = 1 if width is None else width
+    new_grid = _mesh.rebuild_for_mesh(old_grid, dims=dims, devices=devices)
+    if any(width > ln for ln in new_grid.local_shape):
+        raise ValueError(
+            f"halo width {width} exceeds a local shard extent "
+            f"{new_grid.local_shape} on the rebuilt mesh {new_grid.dims}"
+        )
+    return build_for_mesh(new_grid, width)
 
 
 def global_boundary_mask(grid: GlobalGrid, dtype=bool):

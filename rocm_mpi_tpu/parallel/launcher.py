@@ -80,6 +80,11 @@ class LaunchReport:
     # Progress-watchdog verdicts (health_dir runs): one dict per flagged
     # rank — rank, step, median_step, stalled_for_s, last phase, t.
     watchdog_verdicts: list[dict] = dataclasses.field(default_factory=list)
+    # Vanish detection (vanish_grace_s runs): the rank that exited rc=0
+    # while its peers were still running past the grace — the
+    # preempted/evicted-rank signature (a clean rc that orphans a
+    # collective). first_failure is set alongside, with rc 0.
+    vanished: int | None = None
 
     def note(self, msg: str) -> None:
         self.events.append(msg)
@@ -107,6 +112,7 @@ def spawn_ranks(
     health_dir=None,
     stall_grace_s: float = 6.0,
     postmortem_grace_s: float = 1.5,
+    vanish_grace_s: float | None = None,
 ):
     """Spawn `nprocs` ranks of `[sys.executable] + argv` under the RMT_*
     launcher contract; return RankResults of (proc, (stdout, stderr)) in
@@ -119,7 +125,23 @@ def spawn_ranks(
     sidecars (`stall_grace_s` of no progress while the cross-rank median
     is ahead; `postmortem_grace_s` between SIGUSR2 and the kill, so the
     in-process faulthandler gets to write its dump) — module docstring
-    has the full story."""
+    has the full story.
+
+    `vanish_grace_s` (default off — legacy behavior is byte-identical)
+    arms VANISH detection: a rank that exits rc=0 while peers are still
+    running looks like normal completion skew for the grace window, but
+    past it — peers still alive, almost certainly wedged in a collective
+    the clean-exited rank abandoned — the exit is reclassified as a
+    death (`report.vanished`, first_failure with rc 0) and the wedged
+    peers are killed. With `health_dir` armed the verdict additionally
+    requires every surviving rank's PROGRESS content to be at least the
+    grace old (a slow-but-progressing straggler — e.g. the final save on
+    a loaded box — is never reclassified); without the health plane,
+    elapsed time is all there is, so size the grace above the ranks'
+    normal completion skew. This is how a preempted/evicted rank (fault
+    kind `die`) is caught without a nonzero rc to scan for; the elastic
+    supervisor (resilience.elastic) turns the verdict into a mesh
+    shrink."""
     port = _free_port()
     base = os.environ.copy()
     # Ranks size their own device count (--cpu-devices); an inherited
@@ -263,6 +285,7 @@ def spawn_ranks(
         t0 = time.monotonic()
         next_beat = t0 + heartbeat_s
         failure_t = None
+        first_clean_exit = None  # (rank, t) — vanish_grace_s runs only
         while not done.is_set():
             now = time.monotonic()
             alive = [i for i, p in enumerate(procs) if p.poll() is None]
@@ -285,6 +308,46 @@ def spawn_ranks(
                             "grace"
                         )
                         break
+            if (
+                vanish_grace_s is not None
+                and report.first_failure is None
+            ):
+                if first_clean_exit is None:
+                    for i, p in enumerate(procs):
+                        if p.poll() == 0:
+                            first_clean_exit = (i, now)
+                            break
+                elif now - first_clean_exit[1] >= vanish_grace_s and (
+                    watch is None
+                    or all(
+                        age >= vanish_grace_s
+                        for rk, age in watch.ages(now).items()
+                        if rk in alive
+                    )
+                ):
+                    # Peers are STILL running this long after a clean
+                    # exit: not completion skew — the exited rank
+                    # abandoned a collective its peers are wedged in.
+                    # With the health plane on, elapsed time alone is
+                    # not enough: a slow-but-progressing survivor (its
+                    # sidecar content still changing — e.g. the final
+                    # save on a loaded box) must never be reclassified
+                    # as orphaned; only peers whose progress is as old
+                    # as the vanish grace are.
+                    rank, exit_t = first_clean_exit
+                    report.vanished = rank
+                    report.first_failure = (rank, 0, exit_t - t0)
+                    report.note(
+                        f"vanish: rank {rank} exited rc=0 at "
+                        f"{exit_t - t0:.1f}s but ranks {alive} are still "
+                        f"running {vanish_grace_s}s later — treating the "
+                        "exit as a death and killing the orphaned peers"
+                    )
+                    for i in alive:
+                        if procs[i].poll() is None:
+                            procs[i].kill()
+                            report.killed_after_failure.append(i)
+                    return
             elif failure_t is not None and now - failure_t >= peer_grace_s:
                 for i in alive:
                     if procs[i].poll() is None:

@@ -68,6 +68,30 @@ def suggest_dims(nprocs: int, ndim: int) -> tuple[int, ...]:
     return tuple(dims)
 
 
+def plan_dims(
+    global_shape: Sequence[int], max_devices: int
+) -> tuple[int, ...]:
+    """The largest valid sub-mesh for `global_shape` using at most
+    `max_devices` devices: the biggest p <= max_devices whose near-square
+    factorization (suggest_dims) divides every grid axis.
+
+    This is the elastic-recovery decomposition planner (docs/RESILIENCE.md
+    "Elastic recovery"): when a rank dies, the supervisor re-plans the
+    mesh over the survivors, and a checkpoint restored without a template
+    (utils.checkpoint.restore_state(like=None)) plans its mesh over
+    whatever devices the resumed process has. p=1 always divides, so a
+    plan always exists.
+    """
+    if max_devices < 1:
+        raise ValueError(f"max_devices must be >= 1, got {max_devices}")
+    ndim = len(global_shape)
+    for p in range(int(max_devices), 0, -1):
+        dims = suggest_dims(p, ndim)
+        if all(n % d == 0 for n, d in zip(global_shape, dims)):
+            return dims
+    raise AssertionError("unreachable: p=1 divides every shape")
+
+
 @dataclasses.dataclass(frozen=True)
 class GlobalGrid:
     """A global cartesian grid of cells sharded over a device mesh.
@@ -252,3 +276,46 @@ def init_global_grid(
     dev_grid = np.asarray(devices[:nproc]).reshape(dims)
     mesh = Mesh(dev_grid, tuple(axis_names))
     return GlobalGrid(mesh=mesh, global_shape=shape, lengths=lengths)
+
+
+def rebuild_for_mesh(
+    grid: GlobalGrid,
+    dims: Sequence[int] | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> GlobalGrid:
+    """Re-derive `grid` for a NEW decomposition of the SAME global domain.
+
+    Topology is a run-time variable (docs/RESILIENCE.md "Elastic
+    recovery"): a run checkpointed on one mesh resumes on another, and
+    everything derived from the decomposition — shardings, local shapes,
+    halo programs, deep-halo schedules — must be rebuilt from the new
+    dims while the global problem (global_shape, lengths, axis names)
+    stays fixed. This is that rebuild for the grid itself;
+    `parallel.halo.rebuild_for_mesh` / `parallel.deep_halo.rebuild_for_mesh`
+    layer the communication programs on top.
+
+    `dims` defaults to the plan_dims sub-mesh over `devices` (default:
+    all of jax.devices()). Divisibility is validated by GlobalGrid
+    itself, so an invalid explicit dims fails loudly here, not at trace
+    time.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if dims is None:
+        dims = plan_dims(grid.global_shape, len(devices))
+    dims = tuple(int(d) for d in dims)
+    if len(dims) != grid.ndim:
+        raise ValueError(
+            f"dims {dims} rank != grid rank {grid.ndim}"
+        )
+    nproc = int(np.prod(dims))
+    if nproc > len(devices):
+        raise ValueError(
+            f"dims {dims} need {nproc} devices, have {len(devices)}"
+        )
+    dev_grid = np.asarray(list(devices)[:nproc]).reshape(dims)
+    return GlobalGrid(
+        mesh=Mesh(dev_grid, grid.axis_names),
+        global_shape=grid.global_shape,
+        lengths=grid.lengths,
+    )

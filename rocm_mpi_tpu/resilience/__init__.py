@@ -8,12 +8,26 @@ The layer spans three levels, matching where failure actually strikes:
 * `utils.checkpoint` — per-save integrity manifests +
   `latest_valid_step` fallback (torn/corrupt checkpoints are skipped,
   never restored);
-* `faults` — deterministic fault injection (crash/kill/truncate/delay at
-  exact steps), wired through `run_segmented`, the launcher, and the
-  apps' `--inject-fault` flag, so every recovery path above is exercised
-  by tests (tests/test_resilience.py), not just by outages.
+* `faults` — deterministic fault injection (crash/kill/die/truncate/
+  delay/stall at exact steps), wired through `run_segmented`, the
+  launcher, and the apps' `--inject-fault` flag, so every recovery path
+  above is exercised by tests (tests/test_resilience.py), not just by
+  outages;
+* `elastic.run_elastic` — launcher-level TOPOLOGY supervision: when a
+  rank dies for good (watchdog kill, vanish, nonzero rc), shrink to the
+  largest valid sub-mesh and resume from the latest valid step instead
+  of aborting (docs/RESILIENCE.md "Elastic recovery");
+* `reshard` — the topology-portability substrate: checkpoint manifest
+  metadata (mesh dims + per-leaf partition specs), restore-template
+  planning for the current device set, and the host gather/scatter slab
+  path for live state.
 """
 
+from rocm_mpi_tpu.resilience.elastic import (  # noqa: F401
+    ElasticExhausted,
+    ElasticReport,
+    run_elastic,
+)
 from rocm_mpi_tpu.resilience.faults import (  # noqa: F401
     FaultPlan,
     InjectedCrash,

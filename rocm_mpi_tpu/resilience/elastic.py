@@ -1,0 +1,241 @@
+"""Elastic launch supervision: shrink the mesh on rank death instead of
+aborting the run (docs/RESILIENCE.md "Elastic recovery").
+
+`run_supervised` (supervisor.py) retries a run on the SAME topology —
+the right answer when the failure was transient. When a device is gone
+(watchdog-killed wedged rank, preempted pod, vanished container), the
+same topology no longer exists, and before this module the only outcome
+was an aborted run. `run_elastic` is the launcher-level supervisor that
+treats topology as a run-time variable:
+
+    report = run_elastic(argv, nprocs=4, checkpoint_dir=d,
+                         global_shape=(64, 64), health_dir=h)
+
+launches `nprocs` ranks of `argv` under the spawn_ranks contract and,
+when a launch fails — a rank killed (`kill`), wedged and put down by the
+PR-5 progress watchdog (`stall`), or vanished with a clean rc
+(`die`, caught by the launcher's vanish_grace_s detection) — it:
+
+ 1. plans the LARGEST VALID SUB-MESH for the survivors
+    (parallel.mesh.plan_dims against the global shape: the biggest
+    p <= n-1 whose near-square factorization divides every grid axis);
+ 2. emits a structured `elastic.shrink` event — old/new mesh dims, dead
+    ranks, reason, the resume step — to the run's `elastic.jsonl`
+    sidecar (telemetry.health owns the record format; the monitor CLI
+    shows the mesh + a SHRUNK badge from it) and, when the supervising
+    process itself collects telemetry, as telemetry events/gauges;
+ 3. respawns on the smaller rank count. The ranks themselves resume
+    from the latest VALID checkpoint step exactly as any --resume run
+    does — the v2 manifest topology metadata + orbax re-slicing
+    (utils.checkpoint.restore_state) land the old mesh's shard slabs on
+    the new decomposition bit-exactly.
+
+The injected fault spec (when drilling) is forwarded to the FIRST launch
+only: the fault already happened; a respawn must not re-arm it.
+
+Shrinking stops at `min_ranks`; a failure there raises ElasticExhausted
+after an `elastic.gave-up` event — like run_supervised, the elastic
+layer never converts persistent failure into silence. Clean launches
+never shrink: success is every rank exiting 0 with no watchdog verdict
+and no vanish.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import pathlib
+
+
+class ElasticExhausted(RuntimeError):
+    """The run kept failing all the way down to `min_ranks`."""
+
+
+@dataclasses.dataclass
+class ElasticReport:
+    """What the elastic supervisor did: one entry per launch, the
+    elastic.* event records (also in the sidecar), and the last launch's
+    RankResults (`.results`)."""
+
+    launches: list = dataclasses.field(default_factory=list)
+    events: list = dataclasses.field(default_factory=list)
+    shrinks: int = 0
+    final_nprocs: int | None = None
+    results: object = None
+
+    def note_event(self, rec: dict) -> None:
+        self.events.append(rec)
+
+
+def _judge(results) -> tuple[bool, list[int], str]:
+    """(ok, dead_ranks, reason) for one finished launch. Dead ranks are
+    the CAUSE (watchdog-flagged / vanished / first nonzero rc), not the
+    peers the launcher reaped after them."""
+    report = results.report
+    if report.watchdog_verdicts:
+        ranks = sorted({v["rank"] for v in report.watchdog_verdicts})
+        return False, ranks, "watchdog-stall"
+    if report.vanished is not None:
+        return False, [report.vanished], "vanished (clean rc mid-run)"
+    if report.first_failure is not None:
+        rank, rc, _ = report.first_failure
+        return False, [rank], f"rank {rank} rc={rc}"
+    bad = [i for i, (p, _) in enumerate(results) if p.returncode != 0]
+    if bad:
+        return False, bad[:1], f"rank {bad[0]} rc={results[bad[0]][0].returncode}"
+    return True, [], "ok"
+
+
+def run_elastic(
+    argv,
+    nprocs: int,
+    *,
+    checkpoint_dir=None,
+    global_shape=None,
+    min_ranks: int = 1,
+    inject_fault: str | None = None,
+    sidecar_dir=None,
+    launch=None,
+    log=None,
+    **spawn_kwargs,
+) -> ElasticReport:
+    """Launch `argv` on `nprocs` ranks, shrinking the mesh and resuming
+    on failure; returns the ElasticReport (`.results` is the last
+    launch). `argv` may be a callable `(nprocs, attempt) -> argv` when
+    ranks need per-launch arguments.
+
+    `global_shape` drives the sub-mesh planning (plan_dims); without it
+    the shrink is a plain n-1. `checkpoint_dir` is only read here to
+    stamp the resume step on events — the ranks own the actual restore.
+    `sidecar_dir` (default: health_dir, then telemetry_dir, then
+    checkpoint_dir) receives `elastic.jsonl`. `launch` is injectable for
+    tests (default parallel.launcher.spawn_ranks); remaining kwargs pass
+    through to it — `vanish_grace_s` defaults ON here (10 s) because
+    vanish detection is the only way a `die`-class death is seen at all.
+    """
+    from rocm_mpi_tpu import telemetry
+    from rocm_mpi_tpu.telemetry import health as _health
+
+    if nprocs < 1 or min_ranks < 1 or min_ranks > nprocs:
+        raise ValueError(
+            f"need 1 <= min_ranks <= nprocs, got {min_ranks}, {nprocs}"
+        )
+    if launch is None:
+        from rocm_mpi_tpu.parallel.launcher import spawn_ranks
+
+        launch = spawn_ranks
+    spawn_kwargs.setdefault("vanish_grace_s", 10.0)
+    log = log or (lambda *_: None)
+    sidecar = (
+        sidecar_dir
+        or spawn_kwargs.get("health_dir")
+        or spawn_kwargs.get("telemetry_dir")
+        or checkpoint_dir
+    )
+    report = ElasticReport()
+
+    def event(name: str, **attrs) -> None:
+        if sidecar is not None:
+            rec = _health.append_elastic_event(sidecar, name, **attrs)
+        else:
+            rec = {"name": name, **attrs}
+        report.note_event(rec)
+        # The supervising process may itself collect telemetry (tests,
+        # a driving notebook): mirror the decision there too. No-ops
+        # when collection is off.
+        telemetry.record_event(name)
+        if name in ("elastic.launch", "elastic.shrink"):
+            telemetry.gauge("elastic.ranks", attrs.get("new_nprocs",
+                                                       attrs.get("nprocs")))
+
+    def resume_step():
+        if checkpoint_dir is None:
+            return None
+        from rocm_mpi_tpu.utils import checkpoint as ckpt
+
+        return ckpt.latest_valid_step(checkpoint_dir, log=log)
+
+    def mesh_for(n: int):
+        if global_shape is None:
+            return None
+        from rocm_mpi_tpu.parallel.mesh import plan_dims
+
+        return list(plan_dims(global_shape, n))
+
+    def next_nprocs(n: int, dead_count: int) -> int:
+        # The survivors are what's left after EVERY dead rank, not n-1:
+        # a launch that lost two pods must not re-plan for a device
+        # budget that includes one of them.
+        budget = n - max(dead_count, 1)
+        mesh = mesh_for(budget)
+        if mesh is None:
+            return budget
+        return int(math.prod(mesh))
+
+    if sidecar is not None:
+        # elastic.jsonl is THIS run's record: a reused directory must not
+        # show last run's shrinks as this run's (same hygiene the
+        # launcher applies to stale heartbeat sidecars).
+        stale = pathlib.Path(sidecar) / _health.ELASTIC_FILE
+        stale.unlink(missing_ok=True)
+
+    n = nprocs
+    attempt = 0
+    start = resume_step()
+    while True:
+        mesh = mesh_for(n)
+        event("elastic.launch", attempt=attempt, nprocs=n, mesh=mesh,
+              resume_step=start)
+        log(f"elastic: launch {attempt} on {n} rank(s)"
+            + (f", mesh {tuple(mesh)}" if mesh else "")
+            + (f", resuming step {start}" if start else ""))
+        this_argv = argv(n, attempt) if callable(argv) else argv
+        results = launch(
+            this_argv,
+            nprocs=n,
+            inject_fault=inject_fault if attempt == 0 else None,
+            **spawn_kwargs,
+        )
+        ok, dead, reason = _judge(results)
+        report.launches.append({
+            "attempt": attempt,
+            "nprocs": n,
+            "mesh": mesh,
+            "resume_step": start,
+            "ok": ok,
+            "dead_ranks": dead,
+            "reason": reason,
+            "returncodes": [p.returncode for p, _ in results],
+        })
+        report.results = results
+        if ok:
+            report.final_nprocs = n
+            event("elastic.complete", nprocs=n, mesh=mesh,
+                  shrinks=report.shrinks)
+            log(f"elastic: run complete on {n} rank(s) after "
+                f"{report.shrinks} shrink(s)")
+            return report
+        if n <= min_ranks:
+            event("elastic.gave-up", nprocs=n, reason=reason,
+                  dead_ranks=dead)
+            log(f"elastic: giving up — failed at min_ranks={min_ranks} "
+                f"({reason})")
+            raise ElasticExhausted(
+                f"run failed at the minimum rank count {min_ranks}: "
+                f"{reason}"
+            )
+        new_n = max(next_nprocs(n, len(dead)), min_ranks)
+        new_mesh = mesh_for(new_n)
+        # Re-resolve AFTER the failed launch (its ranks saved steps) —
+        # then carry the value: nothing runs between this shrink and
+        # the next launch, so re-walking every manifest again at the
+        # loop top would be pure repeated validation I/O.
+        start = resume_step()
+        event("elastic.shrink", old_nprocs=n, new_nprocs=new_n,
+              old_mesh=mesh, new_mesh=new_mesh, dead_ranks=dead,
+              reason=reason, resume_step=start)
+        log(f"elastic: shrinking {n} → {new_n} rank(s) "
+            f"({reason}; dead {dead}), resuming from step {start}")
+        report.shrinks += 1
+        n = new_n
+        attempt += 1

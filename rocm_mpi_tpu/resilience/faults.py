@@ -16,6 +16,17 @@ rank):
                             no-cleanup SIGKILL analog (mid-collective
                             peers are left hanging; the launcher's
                             first-failure reporting is the defense)
+    die@step=K              os._exit(0) at step K — the rank VANISHES
+                            with a clean exit code: no crash, no
+                            post-mortem, no nonzero rc for the
+                            launcher's first-failure scan to see. The
+                            preempted-pod / evicted-container analog,
+                            distinct from `kill` (nonzero rc) and
+                            `stall` (still alive). Only the launcher's
+                            vanish detection (spawn_ranks
+                            vanish_grace_s) and the elastic supervisor
+                            (docs/RESILIENCE.md "Elastic recovery")
+                            handle it
     truncate-latest         after the next completed save, truncate the
                             largest file of the newest checkpoint step
     delay=S@step=K          sleep S seconds at step K (flapping-tunnel
@@ -34,6 +45,20 @@ Any clause may be rank-scoped with `rank=R`:
 
     kill@step=4,rank=1      only process R injects (other ranks run clean)
 
+and site-scoped with `at=SITE` (SITE = an instrumented fault-point name
+below). An unscoped clause fires at the FIRST site that matches its
+step — the legacy semantics; `at=` pins it to one site when the same
+step count passes several. The elastic stall drill needs this:
+
+    stall@step=8,rank=1,at=segment-pre
+
+wedges rank 1 after the segment's collectives but BEFORE its progress
+bump and the save barrier, so its peers bump PAST it and the watchdog's
+stalled-vs-median signature names the right victim (an unscoped stall
+at the post-save "segment" site freezes every peer inside the next
+segment's collective at the same counter — the coordinated-slowness
+shape the watchdog deliberately never flags).
+
 Every trigger is exact-match ("crash at step K", not "at or after"):
 a supervisor retry that re-runs past the same step must NOT re-fire the
 fault, so `fault_point` arms each clause at most MAX_FIRES times per
@@ -43,6 +68,13 @@ no wall-clock dependence (delays excepted, by definition).
 Instrumented fault points:
     "segment"  — utils/checkpoint.run_segmented, after each completed
                  save (step = absolute step count, directory = ckpt dir)
+    "segment-pre" — utils/checkpoint.run_segmented, after a segment's
+                 advance but BEFORE the flight-recorder step bump and
+                 the save (same step count the following save will
+                 carry). OPT-IN: only `at=segment-pre` clauses fire
+                 here — unscoped step clauses keep firing at the
+                 post-save "segment" site exactly as before this site
+                 existed, so legacy specs are unchanged
     "init"     — parallel/distributed.maybe_initialize_distributed,
                  before jax.distributed.initialize (step = None)
     "window"   — apps/weak_scaling.telemetry_windowed_run, at each
@@ -60,7 +92,13 @@ import os
 import time
 
 RC_INJECTED_KILL = 43  # distinctive rc: a killed rank is diagnosable
+RC_INJECTED_DIE = 0  # the point of `die`: the exit code says nothing
 ENV_VAR = "RMT_INJECT_FAULT"
+
+# Sites that only fire for clauses explicitly scoped there (at=SITE):
+# they share step numbering with an adjacent legacy site, and an
+# unscoped clause must keep firing at the legacy one.
+OPTIN_SITES = frozenset({"segment-pre"})
 
 
 class InjectedCrash(RuntimeError):
@@ -68,15 +106,17 @@ class InjectedCrash(RuntimeError):
 
 
 class FaultClause:
-    __slots__ = ("kind", "step", "segment", "rank", "delay_s", "fires")
+    __slots__ = ("kind", "step", "segment", "rank", "delay_s", "site",
+                 "fires")
 
     def __init__(self, kind, step=None, segment=None, rank=None,
-                 delay_s=0.0):
+                 delay_s=0.0, site=None):
         self.kind = kind
         self.step = step
         self.segment = segment
         self.rank = rank
         self.delay_s = delay_s
+        self.site = site
         self.fires = 0
 
     def __repr__(self):
@@ -87,6 +127,8 @@ class FaultClause:
             parts.append(f"segment={self.segment}")
         if self.rank is not None:
             parts.append(f"rank={self.rank}")
+        if self.site is not None:
+            parts.append(f"at={self.site}")
         if self.delay_s:
             parts.append(f"delay={self.delay_s}")
         return f"FaultClause({', '.join(parts)})"
@@ -100,7 +142,8 @@ def _parse_clause(raw: str) -> FaultClause:
     if kind.startswith("delay="):
         delay_s = float(kind[len("delay="):])
         kind = "delay"
-    if kind not in ("crash", "kill", "truncate-latest", "delay", "stall"):
+    if kind not in ("crash", "kill", "die", "truncate-latest", "delay",
+                    "stall"):
         raise ValueError(f"unknown fault kind {kind!r} in {raw!r}")
     clause = FaultClause(kind, delay_s=delay_s)
     triggers = [t for t in [trigger.strip()] + mods if t]
@@ -113,10 +156,12 @@ def _parse_clause(raw: str) -> FaultClause:
             clause.segment = int(val)
         elif key == "rank":
             clause.rank = int(val)
+        elif key == "at":
+            clause.site = val.strip()
         else:
             raise ValueError(f"unknown fault trigger {t!r} in {raw!r}")
-    if kind in ("crash", "kill", "delay", "stall") and clause.step is None \
-            and clause.segment is None:
+    if kind in ("crash", "kill", "die", "delay", "stall") \
+            and clause.step is None and clause.segment is None:
         raise ValueError(
             f"{kind} fault needs a step=K or segment=N trigger: {raw!r}"
         )
@@ -232,6 +277,13 @@ def fault_point(name: str, step=None, directory=None) -> None:
             continue
         if clause.rank is not None and clause.rank != rank:
             continue
+        if clause.site is not None:
+            if clause.site != name:
+                continue
+        elif name in OPTIN_SITES:
+            # Opt-in sites never match unscoped clauses: a legacy spec's
+            # step trigger must keep firing where it always fired.
+            continue
         hit = False
         if clause.step is not None:
             hit = step is not None and int(step) == clause.step
@@ -259,6 +311,12 @@ def fault_point(name: str, step=None, directory=None) -> None:
                 _truncate_latest(directory)
         elif clause.kind == "kill":
             os._exit(RC_INJECTED_KILL)  # noqa: SLF001 — the point: no cleanup
+        elif clause.kind == "die":
+            # The vanished rank: a CLEAN exit mid-run. No exception, no
+            # post-mortem, rc 0 — everything downstream must infer death
+            # from the peers it orphaned, which is exactly the path the
+            # elastic drills need to exercise deterministically.
+            os._exit(RC_INJECTED_DIE)  # noqa: SLF001 — no cleanup either
         elif clause.kind == "crash":
             raise InjectedCrash(
                 f"injected crash at fault point {name!r} "

@@ -1,0 +1,246 @@
+"""Topology resharding: move a global state between device meshes
+(docs/RESILIENCE.md "Elastic recovery").
+
+The paper's workflow fixes the Cartesian grid for the life of a run;
+production does not get that luxury — a device dies, a pod slice shrinks,
+a resumed run lands on a different machine. This module makes the
+decomposition a run-time variable for STATE: given a pytree of global
+sharded arrays (or a checkpoint manifest's topology metadata), it plans a
+valid mesh for whatever devices exist now and moves the data there.
+
+Three layers, smallest first:
+
+* `gather_slabs` / `scatter_slabs` — the slab path: pull every leaf's
+  global content to host memory (per-shard slabs assembled by the
+  runtime), then place it shard-by-shard under new shardings. This is
+  the explicit form of what a cross-mesh checkpoint restore does through
+  orbax/tensorstore, usable on LIVE state (no checkpoint round-trip).
+* `reshard_state` — gather + scatter against a target grid/shardings;
+  the result is freshly placed device memory, so it is donation-safe by
+  construction (the same contract `checkpoint.restore_state` gives).
+* `state_meta` / `template_from_meta` — the manifest glue: record a
+  state's topology (mesh dims/axes + per-leaf partition specs) at save
+  time, and rebuild an orbax restore template for the CURRENT device set
+  from that record alone — no caller-provided `like` pytree needed
+  (`restore_state(dir, step, like=None)`).
+
+Donation hazard (GL01): `reshard_state`'s gather READS its input leaves.
+Never reshard a state that has already been donated into a jitted
+advance — gather first, step after — and never re-read the pre-reshard
+state once a donating program consumed it. The analyzer's GL01 rule
+polices the pattern (tests/analysis_fixtures/gl01_pos.py pins the
+reshard-after-donate shape).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from rocm_mpi_tpu.parallel.mesh import suggest_dims
+
+
+def _spec_entry_to_json(entry):
+    if entry is None:
+        return None
+    if isinstance(entry, (tuple, list)):
+        return [str(e) for e in entry]
+    return str(entry)
+
+
+def _spec_entry_from_json(entry):
+    if entry is None:
+        return None
+    if isinstance(entry, list):
+        return tuple(entry)
+    return entry
+
+
+def sharding_spec(leaf) -> list | None:
+    """The leaf's partition spec as JSON-serializable entries (one per
+    array axis; axis name, list of names, or None), or None when the leaf
+    has no NamedSharding (single-device / replicated placement)."""
+    sharding = getattr(leaf, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return None
+    entries = [_spec_entry_to_json(e) for e in spec]
+    # Pad to the array rank: PartitionSpec omits trailing None entries.
+    entries += [None] * (leaf.ndim - len(entries))
+    return entries
+
+
+def state_meta(state) -> dict | None:
+    """The topology metadata block a checkpoint manifest records for
+    `state`: the mesh (dims + axis names, from the first NamedSharding
+    leaf) and one partition spec per leaf. None when no leaf carries a
+    NamedSharding — there is no topology to record, and the manifest
+    stays restorable the pre-metadata way (caller-provided `like`)."""
+    import jax
+
+    mesh = None
+    specs = []
+    for leaf in jax.tree_util.tree_leaves(state):
+        sharding = getattr(leaf, "sharding", None)
+        leaf_mesh = getattr(sharding, "mesh", None)
+        if leaf_mesh is not None and mesh is None:
+            mesh = leaf_mesh
+        specs.append(sharding_spec(leaf))
+    if mesh is None:
+        return None
+    return {
+        "mesh": {
+            "dims": [int(d) for d in mesh.devices.shape],
+            "axes": [str(a) for a in mesh.axis_names],
+        },
+        "specs": specs,
+    }
+
+
+def plan_mesh_dims(
+    meta: dict, leaf_shapes: Sequence[Sequence[int]], max_devices: int
+) -> tuple[int, ...]:
+    """The largest valid mesh dims for the CURRENT device budget given a
+    manifest's topology metadata: the biggest p <= max_devices whose
+    near-square factorization divides every sharded axis of every leaf
+    (per that leaf's recorded partition spec). p=1 always works."""
+    axes = [str(a) for a in meta["mesh"]["axes"]]
+    specs = meta.get("specs") or [None] * len(leaf_shapes)
+    ndim = len(axes)
+
+    def divides(dims) -> bool:
+        by_axis = dict(zip(axes, dims))
+        for shape, spec in zip(leaf_shapes, specs):
+            if spec is None:
+                continue
+            for size, entry in zip(shape, spec):
+                entry = _spec_entry_from_json(entry)
+                if entry is None:
+                    continue
+                names = entry if isinstance(entry, tuple) else (entry,)
+                factor = 1
+                for name in names:
+                    factor *= by_axis.get(name, 1)
+                if size % factor:
+                    return False
+        return True
+
+    for p in range(int(max_devices), 0, -1):
+        dims = suggest_dims(p, ndim)
+        if divides(dims):
+            return dims
+    raise AssertionError("unreachable: p=1 divides every shape")
+
+
+def template_from_meta(manifest: dict, devices=None) -> list:
+    """Rebuild the orbax restore template from a v2 manifest ALONE: one
+    jax.ShapeDtypeStruct per recorded leaf, sharded over a mesh planned
+    for the current `devices` (default jax.devices()).
+
+    Policy: when the saved mesh still fits the device budget exactly
+    (prod(saved dims) == len(devices)) it is reused — a same-topology
+    resume stays bit-for-bit the legacy restore. Otherwise the mesh is
+    re-planned as the largest valid sub-mesh for the current budget
+    (plan_mesh_dims), which is how a run checkpointed on (4,2) resumes
+    on 4, 2, or 1 devices. Returns a LIST of leaves in tree order — the
+    metadata path restores leaf structure, not an arbitrary treedef; the
+    framework's states are tuples of arrays, so callers tuple() it.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    meta = manifest.get("meta")
+    if not meta:
+        raise ValueError("manifest has no topology metadata (v1 manifest)")
+    if devices is None:
+        devices = jax.devices()
+    leaves = manifest.get("leaves", [])
+    shapes = [tuple(int(n) for n in rec["shape"]) for rec in leaves]
+    saved_dims = tuple(int(d) for d in meta["mesh"]["dims"])
+    if int(np.prod(saved_dims)) == len(devices):
+        dims = saved_dims
+    else:
+        dims = plan_mesh_dims(meta, shapes, len(devices))
+    axes = tuple(str(a) for a in meta["mesh"]["axes"])
+    grid = np.asarray(list(devices)[: int(np.prod(dims))]).reshape(dims)
+    mesh = jax.sharding.Mesh(grid, axes)
+    specs = meta.get("specs") or [None] * len(leaves)
+    template = []
+    for rec, spec in zip(leaves, specs):
+        if spec is None:
+            pspec = PartitionSpec()
+        else:
+            pspec = PartitionSpec(
+                *(_spec_entry_from_json(e) for e in spec)
+            )
+        template.append(
+            jax.ShapeDtypeStruct(
+                tuple(int(n) for n in rec["shape"]),
+                jnp.dtype(rec["dtype"]),
+                sharding=NamedSharding(mesh, pspec),
+            )
+        )
+    return template
+
+
+# ---------------------------------------------------------------------------
+# The slab path: gather to host, scatter under new shardings
+# ---------------------------------------------------------------------------
+
+
+def gather_slabs(state) -> list:
+    """Every leaf's GLOBAL content as host numpy arrays, in tree order.
+
+    Requires each leaf fully addressable (every shard visible to this
+    process — single-process meshes, or post-allgather state). Multi-host
+    live resharding goes through the checkpoint round-trip instead: save
+    on the old mesh, restore on the new (orbax reads the slabs from
+    disk, which every process can address).
+    """
+    import jax
+    import numpy as np
+
+    slabs = []
+    for i, leaf in enumerate(jax.tree_util.tree_leaves(state)):
+        if not getattr(leaf, "is_fully_addressable", True):
+            raise ValueError(
+                f"leaf {i} is not fully addressable from this process; "
+                "live cross-process resharding must round-trip through a "
+                "checkpoint (save on the old mesh, restore on the new)"
+            )
+        slabs.append(np.asarray(jax.device_get(leaf)))
+    return slabs
+
+
+def scatter_slabs(slabs, shardings):
+    """Place host slabs under `shardings` (one per slab, or one shared
+    sharding): the scatter half of the slab path. Returns a tuple of
+    device arrays — freshly placed, so donation-safe."""
+    import jax
+
+    if not isinstance(shardings, (tuple, list)):
+        shardings = [shardings] * len(slabs)
+    if len(shardings) != len(slabs):
+        raise ValueError(
+            f"{len(slabs)} slab(s) but {len(shardings)} sharding(s)"
+        )
+    return tuple(
+        jax.device_put(slab, sh) for slab, sh in zip(slabs, shardings)
+    )
+
+
+def reshard_state(state, target):
+    """Move `state` (a pytree of fully-addressable global arrays) onto a
+    new decomposition. `target` is a GlobalGrid (every leaf gets its
+    grid-sharding), a single Sharding, or a flat sequence of Shardings in
+    leaf order. Returns the resharded state with `state`'s tree
+    structure. The gather READS every input leaf — reshard BEFORE
+    donating the state into an advance, never after (module docstring).
+    """
+    import jax
+
+    sharding = getattr(target, "sharding", target)
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    out = scatter_slabs(gather_slabs(leaves), sharding)
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -31,6 +31,15 @@ is `utils/checkpoint.run_segmented` wrapped in a supervision loop:
 
 The advance contract is unchanged (`advance(state, n) -> state`, traced
 n) — supervision composes around the compiled program, never inside it.
+
+Scope: this supervisor retries on the SAME topology — right when the
+failure was transient (backend flap, IO hiccup). When the topology
+itself died (watchdog-killed rank, preempted pod, vanished container),
+retrying the same mesh can only fail again; that case belongs to the
+launcher-level ELASTIC supervisor (resilience.elastic.run_elastic),
+which shrinks to the largest valid sub-mesh and resumes from the latest
+valid step through the v2 manifests' topology metadata
+(docs/RESILIENCE.md "Elastic recovery").
 """
 
 from __future__ import annotations

@@ -24,10 +24,13 @@
     monitor DIR [--interval S] [--iterations N]
         Live per-rank view from the health-plane heartbeat sidecars
         (docs/TELEMETRY.md "Health plane"): step counter, step rate,
-        current phase, phase age, delta vs the cross-rank median.
-        Curses-free — redraws in place on a TTY, appends snapshots
-        otherwise. Exit 0 after N iterations (default: run until ^C),
-        2 when DIR has no heartbeat sidecars to watch.
+        current phase, phase age, delta vs the cross-rank median. When
+        the elastic supervisor left an elastic.jsonl sidecar in DIR
+        (docs/RESILIENCE.md "Elastic recovery"), the header shows the
+        CURRENT mesh shape and a SHRUNK badge for runs that resumed on
+        fewer ranks. Curses-free — redraws in place on a TTY, appends
+        snapshots otherwise. Exit 0 after N iterations (default: run
+        until ^C), 2 when DIR has no heartbeat sidecars to watch.
 
     export-openmetrics DIR [--out FILE]
         One Prometheus/OpenMetrics text snapshot of the run's gauges,
@@ -175,6 +178,16 @@ def _cmd_monitor(args) -> int:
                 print("\x1b[H\x1b[2J", end="")
             print(f"health monitor: {args.dir}  "
                   f"({len(beats)} rank(s), poll {args.interval:g}s)")
+            # Elastic runs (resilience.elastic) leave an elastic.jsonl
+            # next to the sidecars: surface the current mesh and the
+            # SHRUNK badge — an operator must see at a glance that this
+            # run is no longer on the mesh it started with.
+            elastic_events, _ = health.load_elastic_events(args.dir)
+            elastic_line = health.format_elastic_status(
+                health.elastic_status(elastic_events)
+            )
+            if elastic_line:
+                print(elastic_line)
             print(health.format_monitor(rows, skipped))
             sys.stdout.flush()
             i += 1

@@ -175,6 +175,136 @@ class ProgressWatch:
 
 
 # ---------------------------------------------------------------------------
+# Elastic supervisor events (docs/RESILIENCE.md "Elastic recovery")
+# ---------------------------------------------------------------------------
+#
+# The elastic supervisor (resilience.elastic.run_elastic) outlives every
+# rank — its decisions (launch on this mesh, shrink to that one, give up)
+# cannot ride a rank's telemetry stream. They land in one append-only
+# `elastic.jsonl` sidecar next to the heartbeat sidecars, written here
+# (telemetry owns the clock reads — GL06) and read back by the monitor
+# verb, which shows the current mesh shape and a SHRUNK badge for runs
+# that resumed on fewer ranks. scripts/lint.sh schema-checks the records
+# (regress.check_schema) wherever they get archived.
+
+ELASTIC_SCHEMA = "rocm_mpi_tpu.resilience.elastic"
+ELASTIC_VERSION = 1
+ELASTIC_FILE = "elastic.jsonl"
+
+
+def append_elastic_event(directory, name: str, **attrs) -> dict:
+    """Append one supervisor event (`elastic.launch` / `elastic.shrink` /
+    `elastic.complete` / `elastic.gave-up`) to `<directory>/elastic.jsonl`,
+    wall-stamped here. Returns the record."""
+    rec = {
+        "schema": ELASTIC_SCHEMA,
+        "v": ELASTIC_VERSION,
+        "kind": "event",
+        "name": name,
+        "t": time.time(),
+        **attrs,
+    }
+    root = pathlib.Path(directory)
+    root.mkdir(parents=True, exist_ok=True)
+    with open(root / ELASTIC_FILE, "a") as fh:
+        fh.write(json.dumps(rec) + "\n")
+    return rec
+
+
+def load_elastic_events(directory) -> tuple[list[dict], int]:
+    """Parse `<directory>/elastic.jsonl`. Returns (records, skipped) —
+    torn/foreign lines are counted and skipped, never fatal (the same
+    tolerance every sidecar reader here has)."""
+    path = pathlib.Path(directory) / ELASTIC_FILE
+    records: list[dict] = []
+    skipped = 0
+    try:
+        text = path.read_text()
+    except OSError:
+        return records, skipped
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            skipped += 1
+            continue
+        if isinstance(doc, dict) and doc.get("schema") == ELASTIC_SCHEMA:
+            records.append(doc)
+        else:
+            skipped += 1
+    return records, skipped
+
+
+def elastic_status(events: list[dict]) -> dict | None:
+    """The monitor's one-line view of the elastic record: current mesh
+    dims, rank count, whether the run ever SHRANK (and from what). None
+    when there are no elastic events (non-elastic run: no badge)."""
+    mesh = None
+    nprocs = None
+    first_mesh = None
+    shrinks = 0
+    for e in events:
+        name = e.get("name")
+        if name == "elastic.launch":
+            mesh = e.get("mesh") or mesh
+            nprocs = e.get("nprocs", nprocs)
+            if first_mesh is None:
+                first_mesh = e.get("mesh")
+        elif name == "elastic.shrink":
+            shrinks += 1
+            mesh = e.get("new_mesh") or mesh
+            nprocs = e.get("new_nprocs", nprocs)
+            if first_mesh is None:
+                first_mesh = e.get("old_mesh")
+    if mesh is None and nprocs is None:
+        return None
+    return {
+        "mesh": mesh,
+        "nprocs": nprocs,
+        "shrunk": shrinks > 0,
+        "shrinks": shrinks,
+        "first_mesh": first_mesh,
+    }
+
+
+def _mesh_str(mesh) -> str | None:
+    """Render mesh dims for the monitor header; None when the elastic
+    run never recorded dims (run_elastic without a global shape plans
+    plain rank counts — the header then shows ranks only, never the
+    literal string 'None')."""
+    if isinstance(mesh, list):
+        return "(" + ", ".join(str(d) for d in mesh) + ")"
+    return None
+
+
+def format_elastic_status(status: dict | None) -> str | None:
+    """`mesh (2, 1)  2 rank(s)` — plus the SHRUNK badge once a shrink
+    happened: `mesh (1, 1)  1 rank(s)  [SHRUNK from (2, 1), 1
+    shrink(s)]`. Mesh fragments are omitted when the events carry no
+    dims."""
+    if not status:
+        return None
+    parts = []
+    mesh_s = _mesh_str(status.get("mesh"))
+    if mesh_s is not None:
+        parts.append(f"mesh {mesh_s}")
+    if status.get("nprocs") is not None:
+        parts.append(f"{status['nprocs']} rank(s)")
+    if status.get("shrunk"):
+        first_s = _mesh_str(status.get("first_mesh"))
+        origin = (
+            f"from {first_s}" if first_s is not None
+            else "from more ranks"
+        )
+        parts.append(
+            f"[SHRUNK {origin}, {status['shrinks']} shrink(s)]"
+        )
+    return "  ".join(parts) if parts else None
+
+
+# ---------------------------------------------------------------------------
 # Post-mortem composition and bundling (the watchdog's out-of-process half)
 # ---------------------------------------------------------------------------
 

@@ -180,6 +180,8 @@ def _classify_json(doc: dict) -> str | None:
     }
     if doc.get("schema") in named:
         return named[doc["schema"]]
+    if "step" in doc and "leaves" in doc and "files" in doc:
+        return "checkpoint manifest"
     if "metrics" in doc and isinstance(doc["metrics"], dict):
         return "flat metrics baseline"
     if "metric" in doc and "north_star" in doc:
@@ -191,10 +193,43 @@ def _classify_json(doc: dict) -> str | None:
     return None
 
 
+def _validate_classified(doc: dict, kind: str) -> list[str]:
+    """Deep checks for families with committed inner structure. The
+    checkpoint-manifest topology metadata is the load-bearing one: a
+    drifted/hand-edited meta block would brick every template-less
+    elastic resume that reads it (utils.checkpoint — its
+    validate_manifest_meta is stdlib-only, shared here on purpose)."""
+    if kind == "checkpoint manifest":
+        from rocm_mpi_tpu.utils.checkpoint import validate_manifest_meta
+
+        return [f"manifest {p}" for p in validate_manifest_meta(doc)]
+    return []
+
+
+def _validate_elastic_record(doc: dict) -> list[str]:
+    """elastic.jsonl record validation (telemetry.health owns the
+    format; resilience.elastic writes it): every record names its event
+    and is wall-stamped; a shrink must carry the old→new rank counts the
+    monitor's SHRUNK badge is computed from."""
+    problems = []
+    name = doc.get("name")
+    if not isinstance(name, str) or not name.startswith("elastic."):
+        problems.append(f"elastic record name {name!r} (want elastic.*)")
+    if not isinstance(doc.get("t"), (int, float)):
+        problems.append("elastic record missing wall stamp t")
+    if name == "elastic.shrink":
+        for key in ("old_nprocs", "new_nprocs"):
+            if not isinstance(doc.get(key), int):
+                problems.append(f"elastic.shrink missing {key}")
+    return problems
+
+
 def check_schema(paths) -> list[str]:
     """Validate committed measurement artifacts. Returns problem strings
     (empty = all recognized). `.jsonl` files are checked line-by-line;
     `.json` files as one document."""
+    from rocm_mpi_tpu.telemetry.health import ELASTIC_SCHEMA
+
     problems: list[str] = []
     for raw in paths:
         path = pathlib.Path(raw)
@@ -222,16 +257,24 @@ def check_schema(paths) -> list[str]:
                         f"{raw}:{i}: unrecognized JSONL record "
                         "(want a mechanics row or a telemetry event)"
                     )
+                    continue
+                if doc.get("schema") == ELASTIC_SCHEMA:
+                    for p in _validate_elastic_record(doc):
+                        problems.append(f"{raw}:{i}: {p}")
         else:
             try:
                 doc = json.loads(text)
             except ValueError as e:
                 problems.append(f"{raw}: bad JSON ({e})")
                 continue
-            if not isinstance(doc, dict) or _classify_json(doc) is None:
+            kind = _classify_json(doc) if isinstance(doc, dict) else None
+            if kind is None:
                 problems.append(
                     f"{raw}: unrecognized schema (known: telemetry "
                     "summary, flat metrics, BASELINE, multichip probe, "
-                    "bench row)"
+                    "bench row, checkpoint manifest)"
                 )
+            else:
+                for p in _validate_classified(doc, kind):
+                    problems.append(f"{raw}: {p}")
     return problems

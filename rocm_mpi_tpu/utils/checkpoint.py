@@ -35,14 +35,33 @@ jax 0.4.37 CPU stack, pinned by tests/test_resilience.py):
 
 Manifest format (manifest-<step>.json next to orbax's step dir):
     {"step": int,
+     "v": 2,
      "treedef": str(jax.tree_util.tree_structure(state)),
      "leaves": [{"shape": [...], "dtype": "...", "crc32": int|null}, ...],
-     "files": {"<relpath under the step dir>": size_bytes, ...}}
+     "files": {"<relpath under the step dir>": size_bytes, ...},
+     "meta": {"mesh": {"dims": [...], "axes": [...]},
+              "specs": [[axis|[axes]|null per array dim] | null, ...],
+              "extra": {...caller fingerprint...}} | null}
 crc32 is over the leaf's row-major host bytes; null for non-fully-
 addressable (multi-host) leaves, where no single process sees the data.
 Validation (latest_valid_step / verify_step) re-walks the step dir and
 compares the file inventory — a truncated or missing file changes a size
 — and restore_state(verify=True) re-hashes the restored leaves.
+
+Topology portability (v2, docs/RESILIENCE.md "Elastic recovery"): the
+`meta` block records the decomposition the state was saved under —
+global shapes/dtypes already live in `leaves`, `meta` adds the mesh
+(dims + axis names) and one partition spec per leaf. That makes the
+checkpoint self-describing: `restore_state(dir, step, like=None)`
+rebuilds the restore template from disk alone, planning the mesh for
+whatever devices the RESUMED process has (resilience.reshard) — a run
+checkpointed on (4,2) resumes on (2,2), (2,1), or (4,4), with shard
+slabs re-sliced by orbax/tensorstore against the new shardings. A
+caller-provided `like` that contradicts the manifest (leaf count,
+global shape, dtype) raises TopologyMismatch — a clear refusal instead
+of an orbax shape error. v1 manifests (pre-metadata) keep restoring
+with a caller template, with a warning; a v2 manifest whose metadata
+fails validation is treated as corrupt (latest_valid_step skips it).
 """
 
 from __future__ import annotations
@@ -52,6 +71,7 @@ import pathlib
 import zlib
 
 from rocm_mpi_tpu.telemetry import enabled as _telemetry_enabled
+from rocm_mpi_tpu.telemetry import flight as _flight
 from rocm_mpi_tpu.telemetry import span
 
 
@@ -69,8 +89,19 @@ def _drain(state) -> None:
     jax.tree_util.tree_map(force, state)
 
 
+MANIFEST_VERSION = 2  # v2 = topology metadata (meta block); v1 = none
+
+
 class CheckpointCorruptionError(RuntimeError):
     """A checkpoint failed integrity validation (manifest mismatch)."""
+
+
+class TopologyMismatch(ValueError):
+    """The caller's restore template contradicts the checkpoint manifest
+    (leaf count / global shape / dtype), or a template-less restore was
+    asked of a checkpoint with no topology metadata. A ValueError on
+    purpose: this is a configuration error that reproduces identically —
+    the supervisor must surface it, never retry it."""
 
 
 def _manager(directory, keep: int = 3):
@@ -128,22 +159,48 @@ def _file_inventory(step_dir: pathlib.Path) -> dict:
     }
 
 
-def write_manifest(directory, step: int, state) -> None:
+def write_manifest(directory, step: int, state, extra_meta=None) -> None:
     """Record the integrity manifest for a COMPLETED save at `step`.
 
     Must run after the save is durable (run_segmented waits first): the
     file inventory hashes what orbax actually wrote. Process-0-only on
     multi-host runs — one writer, one manifest.
+
+    v2: the manifest also records the state's topology (mesh dims/axes +
+    per-leaf partition specs, resilience.reshard.state_meta) so a resume
+    can rebuild the restore template — on a DIFFERENT mesh — from disk
+    alone. `extra_meta` (a JSON-able dict: physics/config fingerprint)
+    rides along under meta.extra. Metadata is best-effort: a state whose
+    shardings defy description saves a meta-less (v1-compatible)
+    manifest with a warning rather than failing the save.
     """
     import jax
 
     if jax.process_index() != 0:
         return
+    try:
+        from rocm_mpi_tpu.resilience.reshard import state_meta
+
+        meta = state_meta(state)
+    except Exception as exc:  # noqa: BLE001 — durability over description
+        import warnings
+
+        warnings.warn(
+            f"checkpoint step {step}: could not record topology metadata "
+            f"({exc!r}); the save is valid but will only restore with a "
+            "caller-provided template",
+            stacklevel=2,
+        )
+        meta = None
+    if meta is not None and extra_meta:
+        meta["extra"] = dict(extra_meta)
     manifest = {
         "step": int(step),
+        "v": MANIFEST_VERSION,
         "treedef": str(jax.tree_util.tree_structure(state)),
         "leaves": _leaf_entries(state),
         "files": _file_inventory(_step_dir(directory, step)),
+        "meta": meta,
     }
     path = _manifest_path(directory, step)
     tmp = path.with_suffix(".json.tmp")
@@ -173,6 +230,80 @@ def read_manifest(directory, step: int) -> dict | None:
         return json.loads(path.read_text())
     except (OSError, ValueError):
         return None  # unreadable/truncated manifest = no manifest
+
+
+def validate_manifest_meta(manifest: dict) -> list[str]:
+    """Structural validation of a manifest's topology metadata. Returns
+    problem strings (empty = ok, including the meta-less v1 case — the
+    legacy policy is latest_valid_step's business, not a schema error).
+    stdlib-only on purpose: the telemetry schema gate
+    (regress.check_schema via scripts/lint.sh) runs this on committed
+    manifest artifacts without importing jax."""
+    meta = manifest.get("meta")
+    if meta is None:
+        return []
+    problems: list[str] = []
+    if not isinstance(meta, dict):
+        return ["meta: not a mapping"]
+    mesh = meta.get("mesh")
+    if not isinstance(mesh, dict):
+        problems.append("meta.mesh: missing or not a mapping")
+        mesh = {}
+    dims = mesh.get("dims")
+    axes = mesh.get("axes")
+    if not (
+        isinstance(dims, list)
+        and dims
+        and all(isinstance(d, int) and d >= 1 for d in dims)
+    ):
+        problems.append(f"meta.mesh.dims: want positive ints, got {dims!r}")
+        dims = []
+    if not (
+        isinstance(axes, list)
+        and all(isinstance(a, str) for a in axes)
+        and len(axes) == len(dims)
+    ):
+        problems.append(
+            f"meta.mesh.axes: want {len(dims)} axis name(s), got {axes!r}"
+        )
+        axes = []
+    leaves = manifest.get("leaves", [])
+    specs = meta.get("specs")
+    if not isinstance(specs, list) or len(specs) != len(leaves):
+        problems.append(
+            f"meta.specs: want one spec per leaf ({len(leaves)}), got "
+            f"{len(specs) if isinstance(specs, list) else specs!r}"
+        )
+        specs = []
+    by_axis = dict(zip(axes, dims))
+    for i, (rec, spec) in enumerate(zip(leaves, specs)):
+        if spec is None:
+            continue
+        shape = rec.get("shape", [])
+        if not isinstance(spec, list) or len(spec) != len(shape):
+            problems.append(
+                f"meta.specs[{i}]: want {len(shape)} entr(ies), got {spec!r}"
+            )
+            continue
+        for d, (size, entry) in enumerate(zip(shape, spec)):
+            if entry is None:
+                continue
+            names = entry if isinstance(entry, list) else [entry]
+            factor = 1
+            for name in names:
+                if name not in by_axis:
+                    problems.append(
+                        f"meta.specs[{i}][{d}]: unknown mesh axis {name!r}"
+                    )
+                    break
+                factor *= by_axis[name]
+            else:
+                if isinstance(size, int) and size % factor:
+                    problems.append(
+                        f"meta.specs[{i}][{d}]: global size {size} not "
+                        f"divisible by mesh factor {factor}"
+                    )
+    return problems
 
 
 def verify_step(directory, step: int) -> tuple[bool, str]:
@@ -207,6 +338,17 @@ def _verify_step(directory, step: int) -> tuple[bool, str]:
         return False, (
             f"file inventory mismatch (missing={missing[:3]}, "
             f"extra={extra[:3]}, resized={resized[:3]})"
+        )
+    meta_problems = validate_manifest_meta(manifest)
+    if meta_problems:
+        # Garbage topology metadata is corruption like any other: a
+        # template-less resume would plan a mesh from it. Fall back to
+        # the previous kept step (latest_valid_step skips this one).
+        return False, (
+            f"topology metadata failed validation ({meta_problems[0]}"
+            + (f", +{len(meta_problems) - 1} more" if len(meta_problems) > 1
+               else "")
+            + ")"
         )
     return True, "ok"
 
@@ -279,11 +421,24 @@ def save_state(directory, step: int, state, keep: int = 3) -> None:
         _prune_stale_manifests(directory)
 
 
-def restore_state(directory, step: int, like, verify: bool = True):
-    """Restore the pytree saved at `step`, placed/sharded like the
-    abstract template `like` (pass the freshly-initialized state — shapes,
-    dtypes, and shardings are taken from it, so a restored run lands
-    exactly where the initializer would have put it).
+def restore_state(directory, step: int, like=None, verify: bool = True,
+                  devices=None):
+    """Restore the pytree saved at `step`.
+
+    `like` is the abstract template (pass the freshly-initialized state —
+    shapes, dtypes, and shardings are taken from it, so a restored run
+    lands exactly where the initializer would have put it). Since v2
+    manifests it is OPTIONAL: with `like=None` the restore template is
+    rebuilt from the manifest's topology metadata alone, sharded over a
+    mesh planned for the current `devices` (default jax.devices(),
+    resilience.reshard.template_from_meta) — possibly a DIFFERENT mesh
+    than the save's; orbax re-slices the shard slabs against the new
+    shardings. The metadata path returns a TUPLE of leaves in tree
+    order (the framework's state convention). A template-less restore of
+    a pre-metadata (v1) checkpoint raises TopologyMismatch; a `like`
+    that contradicts the manifest (leaf count / global shape / dtype)
+    raises TopologyMismatch too — a different MESH in `like` is not a
+    mismatch, it is the elastic-resume path.
 
     verify=True re-hashes every fully-addressable restored leaf against
     the manifest's crc32 (when a manifest exists) and raises
@@ -296,24 +451,88 @@ def restore_state(directory, step: int, like, verify: bool = True):
     (measured; tests/test_resilience.py pins the safe behavior).
     """
     with span("checkpoint.restore", step=int(step)):
-        return _restore_body(directory, step, like, verify)
+        return _restore_body(directory, step, like, verify, devices)
 
 
-def _restore_body(directory, step, like, verify):
+def _check_like_against_manifest(like, manifest, step) -> None:
+    """TopologyMismatch when `like` contradicts the manifest's GLOBAL
+    facts (leaf count, global shape, dtype). Shardings are deliberately
+    not compared: restoring onto a different mesh is the point."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(like)
+    want = manifest.get("leaves", [])
+    if len(want) != len(leaves):
+        raise TopologyMismatch(
+            f"step {step}: template has {len(leaves)} leaves, manifest "
+            f"records {len(want)} — was this checkpoint written by a "
+            "different workload/state layout?"
+        )
+    for i, (leaf, rec) in enumerate(zip(leaves, want)):
+        shape = tuple(int(n) for n in rec.get("shape", []))
+        if tuple(leaf.shape) != shape:
+            raise TopologyMismatch(
+                f"step {step} leaf {i}: template global shape "
+                f"{tuple(leaf.shape)} != checkpointed {shape} — the mesh "
+                "may change on resume, the global domain may not"
+            )
+        if str(leaf.dtype) != rec.get("dtype"):
+            raise TopologyMismatch(
+                f"step {step} leaf {i}: template dtype {leaf.dtype} != "
+                f"checkpointed {rec.get('dtype')}"
+            )
+
+
+def _restore_body(directory, step, like, verify, devices=None):
+    import warnings
+
     import jax
     import jax.numpy as jnp
     import numpy as np
     import orbax.checkpoint as ocp
 
+    manifest = read_manifest(directory, step)
+    as_tuple = False
+    if like is None:
+        if manifest is None or not manifest.get("meta"):
+            raise TopologyMismatch(
+                f"step {step}: template-less restore needs a manifest "
+                "with topology metadata (v2); this checkpoint predates "
+                "it — pass `like` (the freshly-initialized state)"
+            )
+        meta_problems = validate_manifest_meta(manifest)
+        if meta_problems:
+            raise CheckpointCorruptionError(
+                f"step {step}: topology metadata failed validation: "
+                f"{meta_problems[0]}"
+            )
+        from rocm_mpi_tpu.resilience.reshard import template_from_meta
+
+        template = template_from_meta(manifest, devices=devices)
+        as_tuple = True
+    else:
+        if manifest is not None:
+            _check_like_against_manifest(like, manifest, step)
+            if not manifest.get("meta"):
+                warnings.warn(
+                    f"checkpoint step {step} has a v1 (pre-topology-"
+                    "metadata) manifest: restoring with the caller "
+                    "template; same-mesh resume only — re-save to "
+                    "upgrade it for elastic recovery",
+                    stacklevel=3,
+                )
+        template = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(
+                a.shape, a.dtype, sharding=a.sharding
+            ),
+            like,
+        )
     mgr = _manager(directory)
-    template = jax.tree_util.tree_map(
-        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding),
-        like,
-    )
     out = mgr.restore(step, args=ocp.args.StandardRestore(template))
     mgr.close()
+    if as_tuple:
+        out = tuple(out)
     if verify:
-        manifest = read_manifest(directory, step)
         if manifest is not None:
             leaves = jax.tree_util.tree_leaves(out)
             want = manifest.get("leaves", [])
@@ -386,6 +605,22 @@ def run_segmented(
             state = advance(state, n)
             step += n
             _drain(state)
+            # Opt-in pre-save fault site (at=segment-pre): after the
+            # segment's collectives, BEFORE the progress bump and the
+            # save barrier — a rank stalled here lags the counters its
+            # peers are about to publish, which is what lets the
+            # watchdog name it (a post-save stall freezes every peer
+            # inside the next segment's collective at the same count).
+            faults.fault_point("segment-pre", step=step,
+                               directory=directory)
+            # Health-plane progress bump (no-op unless the flight
+            # recorder is armed), BEFORE the save's blocking collective:
+            # a rank wedged in the save barrier must already have
+            # published the step it reached, or the watchdog's
+            # stalled-vs-median signature cannot name the victim
+            # (telemetry.flight module docstring has the ordering
+            # contract).
+            _flight.progress(step=step)
             with span("checkpoint.save", step=step):
                 mgr.save(step, args=ocp.args.StandardSave(state))
                 mgr.wait_until_finished()

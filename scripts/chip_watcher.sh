@@ -106,11 +106,16 @@ archive_telemetry() {
   if [ -d "$tdir" ]; then
     for f in "$tdir"/telemetry-rank*.jsonl "$tdir"/telemetry-summary.json \
              "$tdir"/telemetry-trace.json "$tdir"/heartbeat-rank*.json \
-             "$tdir"/postmortem-rank*.json "$tdir"/postmortem-rank*.traceback; do
+             "$tdir"/postmortem-rank*.json "$tdir"/postmortem-rank*.traceback \
+             "$tdir"/elastic.jsonl "$tdir"/manifest-*.json; do
       [ -s "$f" ] || continue
       mkdir -p docs/telemetry_r5
       cp -p "$f" docs/telemetry_r5/ && found=$((found + 1))
     done
+    # elastic.jsonl + manifest-*.json above: an elastic drill's shrink
+    # record and the v2 topology-metadata manifests (docs/RESILIENCE.md
+    # "Elastic recovery") — the artifacts that explain WHY a window
+    # finished on fewer ranks than it started with.
     # A watchdog verdict leaves a postmortem/ bundle (docs/TELEMETRY.md
     # "Health plane"): the one artifact that explains a wedged window
     # after the tunnel flaps — archive it whole, next to the telemetry.

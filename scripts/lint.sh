@@ -44,6 +44,20 @@ health_records=(
   docs/telemetry_r*/postmortem/postmortem-rank*.json
   docs/telemetry_r*/postmortem/bundle*.json
 )
+# Elastic-recovery artifacts (docs/RESILIENCE.md "Elastic recovery"),
+# still inside the same nullglob scope: the supervisor's elastic.jsonl
+# event sidecars and the checkpoint manifests' v2 topology metadata. A
+# drifted elastic record bricks the monitor's SHRUNK badge; drifted
+# manifest metadata bricks every template-less resume that plans a mesh
+# from it — catch both here, not at the next real incident.
+# (wildcard-bearing paths only: a literal path would survive nullglob
+# and report "missing" when the artifact legitimately doesn't exist)
+health_records+=(
+  output/*/elastic.jsonl
+  docs/telemetry_r*/elastic.jsonl
+  output/*/manifest-*.json
+  docs/telemetry_r*/manifest-*.json
+)
 shopt -u nullglob
 env JAX_PLATFORMS=cpu python -m rocm_mpi_tpu.telemetry regress \
   --check-schema BASELINE.json MULTICHIP_r0*.json \

@@ -25,6 +25,24 @@ def safe_segmented(advance_fn, state, directory):
     return state
 
 
+def safe_reshard_order(restored, new_grid):
+    # The safe elastic-resume shape: gather the slabs BEFORE any
+    # donating step consumes the restored buffers, then step the
+    # freshly-scattered copy (which is rebound every call).
+    slabs = gather_slabs(restored)
+    state = scatter_slabs(slabs, new_grid)
+    state = advance(state, state, 1)
+    return state
+
+
+def gather_slabs(state):
+    return list(state)
+
+
+def scatter_slabs(slabs, grid):
+    return tuple(slabs)
+
+
 def branches_do_not_leak(state, flag):
     if flag:
         out = advance(state, state, 2)

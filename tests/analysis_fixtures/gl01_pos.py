@@ -26,6 +26,23 @@ def rebinds_during_async_save(advance_fn, state, directory):
     return state
 
 
+def reshards_after_donate(restored, new_grid):
+    # The elastic-resume hazard: a restored state stepped with the
+    # donating advance, then handed to the reshard gather — which READS
+    # every leaf of the already-donated buffer.
+    stepped = advance(restored, 1)  # donates `restored`
+    slabs = gather_slabs(restored)  # GL01: restored was donated
+    return stepped, scatter_slabs(slabs, new_grid)
+
+
+def gather_slabs(state):
+    return list(state)
+
+
+def scatter_slabs(slabs, grid):
+    return tuple(slabs)
+
+
 def make_manager(directory):
     return CheckpointManager(directory)
 

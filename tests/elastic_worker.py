@@ -1,0 +1,98 @@
+"""Gloo-real rank worker for the elastic-recovery drills
+(tests/test_elastic.py drives it via resilience.elastic.run_elastic).
+
+Each rank joins the jax.distributed cluster the launcher contract
+describes, builds the diffusion model on WHATEVER mesh the current
+process count yields (one virtual CPU device per rank — the mesh IS the
+rank count), resumes from the latest valid checkpoint step using the
+manifest's topology metadata alone (`restore_state(like=None)` — the
+elastic tentpole path: a checkpoint written on the old mesh lands on the
+new one), and runs the segmented checkpointed loop to nt.
+
+Fault drills ride the forwarded RMT_INJECT_FAULT exactly as in the
+resilience tier: `kill@…` (nonzero rc), `die@…` (clean-rc vanish), and
+`stall@…` (watchdog kill) all strike at the run_segmented "segment"
+fault points, after which the surviving rank wedges in the next orbax
+save barrier — the state the elastic supervisor must shrink out of.
+run_segmented's own flight-recorder step bumps (armed via the
+launcher's health_dir → RMT_HEALTH env) give the watchdog its
+stalled-vs-median signature.
+"""
+
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+from rocm_mpi_tpu.utils.backend import set_cpu_device_count
+
+jax.config.update("jax_platforms", "cpu")
+set_cpu_device_count(1)  # one device per rank: the mesh is the rank count
+jax.config.update("jax_enable_x64", True)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--nx", type=int, default=16)
+    p.add_argument("--ny", type=int, default=16)
+    p.add_argument("--nt", type=int, default=16)
+    p.add_argument("--every", type=int, default=4)
+    p.add_argument("--keep", type=int, default=3)
+    p.add_argument("--dir", required=True)
+    args = p.parse_args()
+
+    import jax.numpy as jnp
+
+    from rocm_mpi_tpu.config import DiffusionConfig
+    from rocm_mpi_tpu.models import HeatDiffusion
+    from rocm_mpi_tpu.parallel.distributed import (
+        maybe_initialize_distributed,
+        process_id,
+    )
+    from rocm_mpi_tpu.telemetry import flight
+    from rocm_mpi_tpu.utils import checkpoint as ckpt
+
+    distributed = maybe_initialize_distributed()
+    # Relaunches (and the straight-run twins) re-pay identical XLA:CPU
+    # compiles without this; the RMT_CPU_CACHE gate keeps it test-only.
+    from rocm_mpi_tpu.utils.backend import enable_persistent_cache
+
+    enable_persistent_cache()
+    # The launcher's health_dir contract (RMT_HEALTH/RMT_HEALTH_DIR):
+    # heartbeat sidecars + the SIGUSR2 post-mortem hook, as
+    # apps/_common.setup_health wires it.
+    if flight.enable_from_env():
+        flight.install_postmortem_handler()
+
+    cfg = DiffusionConfig(
+        global_shape=(args.nx, args.ny), lengths=(10.0, 10.0),
+        nt=args.nt, warmup=0, dtype="f64",
+    )
+    model = HeatDiffusion(cfg)
+    T, Cp = model.init_state()
+    advance = model.advance_fn("perf")
+    adv = lambda s, n: (advance(s[0], Cp, n),)  # noqa: E731
+
+    start = ckpt.latest_valid_step(args.dir) or 0
+    if start:
+        # The elastic restore: template rebuilt from manifest topology
+        # metadata alone, mesh planned for THIS launch's devices — which
+        # may be fewer than the mesh the checkpoint was written on.
+        state = ckpt.restore_state(args.dir, start, like=None)
+    else:
+        state = (jnp.copy(T),)
+    if start < args.nt:
+        ckpt.run_segmented(adv, state, args.nt, args.dir, args.every,
+                           start_step=start, keep=args.keep)
+    print(f"ELASTIC_WORKER_DONE rank={process_id()} start={start}",
+          flush=True)
+    if distributed:
+        jax.distributed.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

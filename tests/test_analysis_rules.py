@@ -72,6 +72,19 @@ def test_gl01_flags_both_patterns():
     assert "async save" in messages
 
 
+def test_gl01_flags_reshard_gather_after_donate():
+    """The elastic-resume hazard (resilience.reshard module docstring):
+    the reshard gather READS every leaf, so gathering a state that a
+    donating advance already consumed is a read-after-donate — the
+    fixture's reshards_after_donate shape must fire, and the safe
+    gather-before-donate ordering in the negative fixture must not
+    (covered by test_rule_true_negative)."""
+    findings = [f for f in lint_fixture("gl01_pos.py") if f.rule == "GL01"]
+    assert any(
+        "restored" in f.message and f.line > 0 for f in findings
+    ), [(f.line, f.message) for f in findings]
+
+
 def test_gl06_owners_are_exempt():
     """The measurement chokepoints may read the raw clocks; the same
     source is a finding anywhere else."""

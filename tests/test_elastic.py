@@ -1,0 +1,731 @@
+"""Topology-elastic checkpoints and mesh-elastic launches
+(docs/RESILIENCE.md "Elastic recovery").
+
+The claims, pinned:
+
+  * v2 manifests are self-describing: mesh dims/axes + per-leaf
+    partition specs ride next to the integrity records, validated by
+    verify_step (garbage metadata = corrupt checkpoint, fall back);
+  * restores are topology-portable: a checkpoint written on one mesh
+    restores — template-less (`like=None`, metadata only) or with a
+    differently-sharded `like` — onto another, BITWISE; mismatched
+    global facts raise TopologyMismatch, v1 manifests keep the legacy
+    same-template path with a warning;
+  * rebuild_for_mesh re-derives the per-mesh machinery (grid, halo
+    programs, deep-halo schedules) for a new decomposition, matching a
+    fresh build exactly;
+  * the launcher detects VANISHED ranks (clean rc mid-run, fault kind
+    `die`) that no nonzero-rc scan can see;
+  * run_elastic shrinks to the largest valid sub-mesh and resumes
+    instead of aborting — policy unit-tested with an injected launcher,
+    then proven gloo-real: kill / die / stall a rank mid-run on 2 ranks,
+    shrink to 1, resume from the latest valid step, final checkpoint
+    bitwise-equal to an uninterrupted 1-rank continuation of the same
+    global state. Clean runs never shrink.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rocm_mpi_tpu.config import DiffusionConfig
+from rocm_mpi_tpu.models import HeatDiffusion
+from rocm_mpi_tpu.parallel import deep_halo
+from rocm_mpi_tpu.parallel import halo as phalo
+from rocm_mpi_tpu.parallel import mesh as pmesh
+from rocm_mpi_tpu.parallel.launcher import spawn_ranks
+from rocm_mpi_tpu.resilience import (
+    ElasticExhausted,
+    faults,
+    reshard,
+    run_elastic,
+)
+from rocm_mpi_tpu.telemetry import health
+from rocm_mpi_tpu.utils import checkpoint as ckpt
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+NT, EVERY = 16, 4
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.install(None)
+
+
+def _model(dims=(2, 4), shape=(32, 32)):
+    cfg = DiffusionConfig(
+        global_shape=shape, lengths=(10.0, 10.0), nt=NT, warmup=0,
+        dtype="f64", dims=dims,
+    )
+    model = HeatDiffusion(cfg)
+    T, Cp = model.init_state()
+    advance = model.advance_fn("perf")
+    adv = lambda s, n: (advance(s[0], Cp, n),)  # noqa: E731
+    return model, adv, (T,)
+
+
+# ---------------------------------------------------------------------------
+# Manifest v2: topology metadata, validation, legacy fallbacks
+# ---------------------------------------------------------------------------
+
+
+def test_manifest_records_topology_metadata(tmp_path):
+    _, adv, state = _model()
+    ckpt.run_segmented(adv, state, NT, tmp_path, every=EVERY)
+    for step in ckpt.all_steps(tmp_path):
+        man = ckpt.read_manifest(tmp_path, step)
+        assert man["v"] == ckpt.MANIFEST_VERSION
+        assert man["meta"]["mesh"] == {"dims": [2, 4], "axes": ["gx", "gy"]}
+        assert man["meta"]["specs"] == [["gx", "gy"]]
+        assert ckpt.validate_manifest_meta(man) == []
+        ok, reason = ckpt.verify_step(tmp_path, step)
+        assert ok, reason
+
+
+def test_corrupt_metadata_invalidates_step(tmp_path):
+    """latest_valid_step must skip a step whose topology metadata fails
+    validation — a template-less resume would plan a mesh from it."""
+    _, adv, state = _model()
+    ckpt.run_segmented(adv, state, NT, tmp_path, every=EVERY)
+    latest = ckpt.latest_step(tmp_path)
+    prev = ckpt.all_steps(tmp_path)[-2]
+    mpath = tmp_path / f"manifest-{latest}.json"
+    man = json.loads(mpath.read_text())
+    man["meta"]["specs"] = [["no-such-axis", "gy"]]
+    mpath.write_text(json.dumps(man))
+    ok, reason = ckpt.verify_step(tmp_path, latest)
+    assert not ok and "metadata" in reason
+    msgs = []
+    assert ckpt.latest_valid_step(tmp_path, log=msgs.append) == prev
+    assert any("metadata" in m for m in msgs), msgs
+
+
+def _strip_to_v1(directory, step):
+    mpath = pathlib.Path(directory) / f"manifest-{step}.json"
+    man = json.loads(mpath.read_text())
+    man.pop("meta", None)
+    man.pop("v", None)
+    mpath.write_text(json.dumps(man))
+
+
+def test_v1_manifest_restores_same_mesh_with_warning(tmp_path):
+    _, adv, state = _model()
+    ref = adv((jnp.copy(state[0]),), NT // 2)
+    ckpt.run_segmented(adv, state, NT, tmp_path, every=EVERY)
+    _strip_to_v1(tmp_path, NT // 2)
+    ok, reason = ckpt.verify_step(tmp_path, NT // 2)
+    assert ok, reason  # v1 stays a VALID step (legacy contract)
+    _, _, like = _model()
+    with pytest.warns(UserWarning, match="v1"):
+        out = ckpt.restore_state(tmp_path, NT // 2, like)
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(ref[0]))
+
+
+def test_v1_manifest_refuses_templateless_restore(tmp_path):
+    _, adv, state = _model()
+    ckpt.run_segmented(adv, state, NT, tmp_path, every=EVERY)
+    _strip_to_v1(tmp_path, NT)
+    with pytest.raises(ckpt.TopologyMismatch, match="pass `like`"):
+        ckpt.restore_state(tmp_path, NT, like=None)
+
+
+def test_mismatched_like_raises_topology_mismatch(tmp_path):
+    _, adv, state = _model()
+    ckpt.run_segmented(adv, state, NT, tmp_path, every=EVERY)
+    # Wrong GLOBAL shape: a clear refusal, not an orbax shape error.
+    _, _, small = _model(dims=(2, 2), shape=(16, 16))
+    with pytest.raises(ckpt.TopologyMismatch, match="global shape"):
+        ckpt.restore_state(tmp_path, NT, small)
+    # Wrong dtype, same shape.
+    cfg = DiffusionConfig(global_shape=(32, 32), lengths=(10.0, 10.0),
+                          nt=NT, warmup=0, dtype="f32", dims=(2, 2))
+    T32, _ = HeatDiffusion(cfg).init_state()
+    with pytest.raises(ckpt.TopologyMismatch, match="dtype"):
+        ckpt.restore_state(tmp_path, NT, (T32,))
+    # Wrong leaf count.
+    _, _, like = _model()
+    with pytest.raises(ckpt.TopologyMismatch, match="leaves"):
+        ckpt.restore_state(tmp_path, NT, (like[0], like[0]))
+
+
+# ---------------------------------------------------------------------------
+# Cross-mesh restore: the topology-portable tentpole
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("new_dims", [(2, 2), (1, 1), (4, 2), (1, 2)])
+def test_restore_onto_different_mesh_is_bitwise(tmp_path, new_dims):
+    """A checkpoint written on (2,4) restores onto other decompositions
+    (shrunk, grown, transposed) via a re-sharded `like` with identical
+    global content, and the restored state advances on the new mesh
+    exactly as a device_put of the same global state does."""
+    _, adv, state = _model()
+    ckpt.run_segmented(adv, state, NT // 2, tmp_path, every=EVERY)
+    model2, adv2, like2 = _model(dims=new_dims)
+    got = ckpt.restore_state(tmp_path, NT // 2, like2)
+    assert got[0].sharding.mesh.devices.shape == new_dims
+    base = ckpt.restore_state(tmp_path, NT // 2, like=None)
+    np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(base[0]))
+    # Continue on the new mesh: restored-and-advanced == device_put'd
+    # global state advanced (same mesh, same program) — the "straight
+    # run on the small mesh from the same global state" contract.
+    straight = jax.device_put(np.asarray(got[0]), model2.grid.sharding)
+    out_restored = adv2((got[0],), NT // 2)
+    out_straight = adv2((straight,), NT // 2)
+    np.testing.assert_array_equal(
+        np.asarray(out_restored[0]), np.asarray(out_straight[0])
+    )
+
+
+@pytest.mark.parametrize(
+    "n_dev,planned",
+    [(8, (2, 4)),  # same budget: the saved decomposition is reused
+     (4, (2, 2)), (2, (2, 1)), (1, (1, 1)),
+     (3, (2, 1))],  # 3 cannot tile 32x32 as (3,1): largest valid is 2
+)
+def test_templateless_restore_plans_largest_submesh(tmp_path, n_dev,
+                                                    planned):
+    _, adv, state = _model()
+    ref = np.asarray(state[0])
+    ckpt.run_segmented(adv, state, NT // 2, tmp_path, every=EVERY)
+    got = ckpt.restore_state(
+        tmp_path, NT // 2, like=None, devices=jax.devices()[:n_dev]
+    )
+    assert got[0].sharding.mesh.devices.shape == planned
+    assert got[0].shape == ref.shape  # global domain untouched
+
+
+def test_restored_state_is_donation_safe_after_reshard(tmp_path):
+    """The GL01 contract holds on the elastic path too: a cross-mesh
+    restored state donates straight into the new mesh's advance."""
+    _, adv, state = _model()
+    ckpt.run_segmented(adv, state, NT // 2, tmp_path, every=EVERY)
+    got = ckpt.restore_state(tmp_path, NT // 2, like=None,
+                             devices=jax.devices()[:4])
+    ref = np.asarray(got[0])
+    _, adv2, _ = _model(dims=(2, 2))
+    out = adv2(got, EVERY)  # donates got[0]
+    again = ckpt.restore_state(tmp_path, NT // 2, like=None,
+                               devices=jax.devices()[:4])
+    np.testing.assert_array_equal(np.asarray(again[0]), ref)
+    assert np.isfinite(np.asarray(out[0])).all()
+
+
+# ---------------------------------------------------------------------------
+# Reshard + rebuild_for_mesh: the slab path and the per-mesh re-derivation
+# ---------------------------------------------------------------------------
+
+
+def test_reshard_state_roundtrip():
+    model, _, state = _model()
+    new_grid = pmesh.rebuild_for_mesh(model.grid, dims=(4, 2))
+    out = reshard.reshard_state(state, new_grid)
+    assert out[0].sharding.mesh.devices.shape == (4, 2)
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(state[0]))
+
+
+def test_plan_dims_policy():
+    assert pmesh.plan_dims((32, 32), 8) == (4, 2)
+    assert pmesh.plan_dims((32, 32), 3) == (2, 1)
+    assert pmesh.plan_dims((30, 30), 8) == (3, 2)
+    assert pmesh.plan_dims((7, 7), 8) == (7, 1)
+    with pytest.raises(ValueError):
+        pmesh.plan_dims((8, 8), 0)
+
+
+def test_mesh_rebuild_validates_divisibility():
+    grid = pmesh.init_global_grid(32, 32, dims=(2, 4))
+    new = pmesh.rebuild_for_mesh(grid, dims=(2, 2))
+    assert new.global_shape == grid.global_shape
+    assert new.lengths == grid.lengths
+    assert new.dims == (2, 2)
+    with pytest.raises(ValueError, match="not divisible"):
+        pmesh.rebuild_for_mesh(grid, dims=(3, 1))
+
+
+def test_halo_rebuild_for_mesh_rederives_geometry():
+    grid = pmesh.init_global_grid(32, 32, dims=(2, 4))
+    prog = phalo.build_for_mesh(grid, width=2)
+    re = phalo.rebuild_for_mesh(prog, dims=(2, 2))
+    assert re.grid.dims == (2, 2) and re.width == 2
+    assert re.grid.local_shape == (16, 16)
+    assert re.nbytes(8) == phalo.exchange_nbytes((16, 16), 8, 2)
+    assert re.nbytes(8) != prog.nbytes(8)
+    with pytest.raises(ValueError, match="width"):
+        phalo.rebuild_for_mesh(grid, dims=(1, 1), width=33)
+
+
+def test_deep_schedule_rebuild_matches_fresh_build():
+    cfg = DiffusionConfig(global_shape=(32, 32), lengths=(10.0, 10.0),
+                          nt=NT, warmup=0, dtype="f64", dims=(2, 4))
+    model = HeatDiffusion(cfg)
+    dt = cfg.jax_dtype(cfg.dt)
+    sched = deep_halo.make_deep_sweep(model.grid, 4, cfg.lam, dt,
+                                      cfg.spacing, local_form="jnp")
+    new_grid = pmesh.rebuild_for_mesh(model.grid, dims=(2, 2))
+    rebuilt = deep_halo.rebuild_for_mesh(sched, new_grid)
+    fresh = deep_halo.make_deep_sweep(new_grid, 4, cfg.lam, dt,
+                                      cfg.spacing, local_form="jnp")
+    assert rebuilt.k == fresh.k == 4
+    T, Cp = model.init_state()
+    Tn = jax.device_put(np.asarray(T), new_grid.sharding)
+    Cpn = jax.device_put(np.asarray(Cp), new_grid.sharding)
+    np.testing.assert_array_equal(
+        np.asarray(rebuilt.sweep(Tn, rebuilt.prepare(Cpn))),
+        np.asarray(fresh.sweep(Tn, fresh.prepare(Cpn))),
+    )
+
+
+def test_deep_schedule_without_rebuild_fails_loudly():
+    sched = deep_halo.DeepSchedule(lambda x: x, lambda x, c: x, 4)
+    grid = pmesh.init_global_grid(32, 32, dims=(2, 2))
+    with pytest.raises(ValueError, match="rebuild"):
+        deep_halo.rebuild_for_mesh(sched, grid)
+
+
+# ---------------------------------------------------------------------------
+# Fault kind `die` + launcher vanish detection
+# ---------------------------------------------------------------------------
+
+
+def test_die_fault_parses_and_requires_trigger():
+    plan = faults.FaultPlan.parse("die@step=4,rank=1")
+    assert plan.clauses[0].kind == "die"
+    assert plan.clauses[0].step == 4 and plan.clauses[0].rank == 1
+    with pytest.raises(ValueError, match="needs a step"):
+        faults.FaultPlan.parse("die")
+
+
+def test_fault_site_scoping_is_opt_in():
+    """segment-pre only fires for clauses explicitly scoped there: an
+    unscoped legacy spec must keep firing at the post-save site."""
+    plan = faults.install("crash@step=8")
+    faults.fault_point("segment-pre", step=8)
+    assert plan.clauses[0].fires == 0
+    with pytest.raises(faults.InjectedCrash):
+        faults.fault_point("segment", step=8)
+    plan = faults.install("crash@step=8,at=segment-pre")
+    faults.fault_point("segment", step=8)  # wrong site: no fire
+    assert plan.clauses[0].fires == 0
+    with pytest.raises(faults.InjectedCrash):
+        faults.fault_point("segment-pre", step=8)
+    assert "at=segment-pre" in repr(plan.clauses[0])
+
+
+def test_launcher_flags_vanished_rank_and_reaps_peers():
+    """A rank exiting rc=0 mid-run (fault kind `die`) while its peer
+    hangs must be reclassified as a death once the vanish grace passes —
+    no nonzero rc ever appears for the legacy first-failure scan."""
+    results = spawn_ranks(
+        [str(ROOT / "tests" / "resilience_worker.py"), "--hang-after"],
+        nprocs=2,
+        timeout=60,
+        inject_fault="die@step=3,rank=1",
+        heartbeat_s=1.0,
+        peer_grace_s=3.0,
+        vanish_grace_s=3.0,
+    )
+    (p0, (out0, _)), (p1, (out1, _)) = results
+    assert p1.returncode == 0, out1
+    assert "WORKER_DONE" not in out1  # it died mid-loop, cleanly
+    report = results.report
+    assert report.vanished == 1
+    assert report.first_failure is not None
+    assert report.first_failure[:2] == (1, 0)
+    assert report.killed_after_failure == [0]
+    assert p0.returncode != 0
+    assert any("vanish" in e for e in report.events), report.events
+
+
+def test_launcher_clean_run_with_vanish_grace_reports_nothing():
+    results = spawn_ranks(
+        [str(ROOT / "tests" / "resilience_worker.py")],
+        nprocs=2, timeout=60, peer_grace_s=3.0, vanish_grace_s=2.0,
+    )
+    for pid, (p, (out, err)) in enumerate(results):
+        assert p.returncode == 0, (pid, err[-500:])
+    assert results.report.vanished is None
+    assert results.report.first_failure is None
+    assert results.report.killed_after_failure == []
+
+
+# ---------------------------------------------------------------------------
+# Elastic policy (injected launcher — no processes)
+# ---------------------------------------------------------------------------
+
+
+class _FakeProc:
+    def __init__(self, rc):
+        self.returncode = rc
+
+
+def _fake_results(rcs, first_failure=None, vanished=None, verdicts=()):
+    from rocm_mpi_tpu.parallel.launcher import LaunchReport, RankResults
+
+    r = RankResults((_FakeProc(rc), ("", "")) for rc in rcs)
+    r.report = LaunchReport()
+    r.report.first_failure = first_failure
+    r.report.vanished = vanished
+    r.report.watchdog_verdicts = list(verdicts)
+    return r
+
+
+def test_elastic_shrinks_once_then_completes(tmp_path):
+    calls = []
+
+    def launch(argv, nprocs, inject_fault=None, **kw):
+        calls.append((nprocs, inject_fault))
+        if len(calls) == 1:
+            return _fake_results([0, 43], first_failure=(1, 43, 1.0))
+        return _fake_results([0] * nprocs)
+
+    report = run_elastic(
+        ["worker.py"], 2, global_shape=(32, 32), sidecar_dir=tmp_path,
+        inject_fault="kill@step=8,rank=1", launch=launch,
+    )
+    assert [c[0] for c in calls] == [2, 1]
+    # The fault spec arms the FIRST launch only: it already happened.
+    assert calls[0][1] == "kill@step=8,rank=1" and calls[1][1] is None
+    assert report.shrinks == 1 and report.final_nprocs == 1
+    names = [e["name"] for e in report.events]
+    assert names == ["elastic.launch", "elastic.shrink",
+                     "elastic.launch", "elastic.complete"]
+    shrink = report.events[1]
+    assert shrink["old_mesh"] == [2, 1] and shrink["new_mesh"] == [1, 1]
+    assert shrink["dead_ranks"] == [1]
+    # Sidecar round-trips through the health reader.
+    events, skipped = health.load_elastic_events(tmp_path)
+    assert skipped == 0 and [e["name"] for e in events] == names
+
+
+def test_elastic_judges_watchdog_and_vanish(tmp_path):
+    seen = []
+
+    def launch(argv, nprocs, inject_fault=None, **kw):
+        if len(seen) == 0:
+            seen.append("stall")
+            return _fake_results(
+                [0, -9], verdicts=[{"rank": 1, "step": 8,
+                                    "median_step": 10.0,
+                                    "stalled_for_s": 6.0,
+                                    "last_phase": "checkpoint"}],
+                first_failure=(1, -9, 9.0),
+            )
+        if len(seen) == 1:
+            seen.append("vanish")
+            return _fake_results([0, 0], vanished=0,
+                                 first_failure=(0, 0, 4.0))
+        return _fake_results([0] * nprocs)
+
+    report = run_elastic(
+        ["worker.py"], 4, global_shape=(32, 32), sidecar_dir=tmp_path,
+        launch=launch, min_ranks=1,
+    )
+    assert report.shrinks == 2
+    reasons = [l["reason"] for l in report.launches]
+    assert reasons[0] == "watchdog-stall"
+    assert "vanished" in reasons[1]
+    assert report.launches[0]["dead_ranks"] == [1]
+    assert report.launches[1]["dead_ranks"] == [0]
+
+
+def test_elastic_shrinks_past_every_dead_rank(tmp_path):
+    """Two ranks dead in one launch → the next budget excludes BOTH:
+    4 ranks with two watchdog verdicts re-plans for 2, not 3."""
+    calls = []
+
+    def launch(argv, nprocs, inject_fault=None, **kw):
+        calls.append(nprocs)
+        if len(calls) == 1:
+            return _fake_results(
+                [0, -9, -9, 0],
+                verdicts=[{"rank": 1, "step": 4, "median_step": 8.0,
+                           "stalled_for_s": 6.0, "last_phase": "halo"},
+                          {"rank": 2, "step": 4, "median_step": 8.0,
+                           "stalled_for_s": 6.0, "last_phase": "halo"}],
+                first_failure=(1, -9, 5.0),
+            )
+        return _fake_results([0] * nprocs)
+
+    report = run_elastic(["worker.py"], 4, global_shape=(32, 32),
+                         sidecar_dir=tmp_path, launch=launch)
+    assert calls == [4, 2]
+    assert report.launches[0]["dead_ranks"] == [1, 2]
+    shrink = report.events[1]
+    assert shrink["old_nprocs"] == 4 and shrink["new_nprocs"] == 2
+
+
+def test_elastic_gives_up_at_min_ranks(tmp_path):
+    def launch(argv, nprocs, inject_fault=None, **kw):
+        return _fake_results([1] * nprocs, first_failure=(0, 1, 0.5))
+
+    with pytest.raises(ElasticExhausted, match="minimum rank count"):
+        run_elastic(["worker.py"], 2, sidecar_dir=tmp_path, launch=launch)
+    events, _ = health.load_elastic_events(tmp_path)
+    assert events[-1]["name"] == "elastic.gave-up"
+
+
+def test_elastic_clean_run_never_shrinks(tmp_path):
+    def launch(argv, nprocs, inject_fault=None, **kw):
+        return _fake_results([0] * nprocs)
+
+    report = run_elastic(["worker.py"], 2, global_shape=(32, 32),
+                         sidecar_dir=tmp_path, launch=launch)
+    assert report.shrinks == 0 and report.final_nprocs == 2
+    assert [e["name"] for e in report.events] == ["elastic.launch",
+                                                  "elastic.complete"]
+    st = health.elastic_status(report.events)
+    assert st["shrunk"] is False
+    assert "SHRUNK" not in health.format_elastic_status(st)
+
+
+def test_elastic_callable_argv_gets_rank_count(tmp_path):
+    argvs = []
+
+    def launch(argv, nprocs, inject_fault=None, **kw):
+        if len(argvs) == 1:
+            return _fake_results([0, 1], first_failure=(1, 1, 1.0))
+        return _fake_results([0] * nprocs)
+
+    def make_argv(nprocs, attempt):
+        argvs.append((nprocs, attempt))
+        return ["worker.py", f"--n={nprocs}"]
+
+    run_elastic(make_argv, 2, sidecar_dir=tmp_path, launch=launch)
+    assert argvs == [(2, 0), (1, 1)]
+
+
+# ---------------------------------------------------------------------------
+# Schema gate + monitor badge
+# ---------------------------------------------------------------------------
+
+
+def test_check_schema_validates_manifests_and_elastic_records(tmp_path):
+    from rocm_mpi_tpu.telemetry import regress
+
+    _, adv, state = _model()
+    ckpt.run_segmented(adv, state, NT // 2, tmp_path, every=EVERY)
+    step = ckpt.latest_step(tmp_path)
+    mpath = tmp_path / f"manifest-{step}.json"
+    health.append_elastic_event(tmp_path, "elastic.launch", attempt=0,
+                                nprocs=2, mesh=[2, 1], resume_step=None)
+    health.append_elastic_event(tmp_path, "elastic.shrink", old_nprocs=2,
+                                new_nprocs=1, old_mesh=[2, 1],
+                                new_mesh=[1, 1], dead_ranks=[1],
+                                reason="drill", resume_step=8)
+    assert regress.check_schema(
+        [str(mpath), str(tmp_path / health.ELASTIC_FILE)]
+    ) == []
+    # Corrupt the manifest metadata: the gate must catch it.
+    man = json.loads(mpath.read_text())
+    man["meta"]["mesh"]["dims"] = [0]
+    mpath.write_text(json.dumps(man))
+    problems = regress.check_schema([str(mpath)])
+    assert problems and "dims" in problems[0]
+    # A shrink record missing its rank counts must be caught too.
+    bad = tmp_path / "bad-elastic.jsonl"
+    bad.write_text(json.dumps({
+        "schema": health.ELASTIC_SCHEMA, "v": 1, "kind": "event",
+        "name": "elastic.shrink", "t": 1.0,
+    }) + "\n")
+    problems = regress.check_schema([str(bad)])
+    assert any("old_nprocs" in p for p in problems)
+
+
+def _write_heartbeat(directory, rank, step):
+    from rocm_mpi_tpu.telemetry.flight import (
+        HEARTBEAT_SCHEMA,
+        HEARTBEAT_VERSION,
+    )
+
+    doc = {"schema": HEARTBEAT_SCHEMA, "v": HEARTBEAT_VERSION,
+           "rank": rank, "t": 0.0, "t_mono": 0.0, "started_t": 0.0,
+           "counters": {"step": step}, "last_phase": "step",
+           "last_phase_name": "step_window", "last_phase_t": 0.0,
+           "ring": []}
+    (pathlib.Path(directory) / f"heartbeat-rank{rank}.json").write_text(
+        json.dumps(doc)
+    )
+
+
+def test_monitor_shows_mesh_and_shrunk_badge(tmp_path, capsys):
+    from rocm_mpi_tpu.telemetry.__main__ import main as telemetry_main
+
+    _write_heartbeat(tmp_path, 0, 12)
+    health.append_elastic_event(tmp_path, "elastic.launch", attempt=0,
+                                nprocs=2, mesh=[2, 1], resume_step=None)
+    health.append_elastic_event(tmp_path, "elastic.shrink", old_nprocs=2,
+                                new_nprocs=1, old_mesh=[2, 1],
+                                new_mesh=[1, 1], dead_ranks=[1],
+                                reason="drill", resume_step=8)
+    health.append_elastic_event(tmp_path, "elastic.launch", attempt=1,
+                                nprocs=1, mesh=[1, 1], resume_step=8)
+    rc = telemetry_main(["monitor", str(tmp_path), "--iterations", "1"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "mesh (1, 1)" in out
+    assert "SHRUNK from (2, 1)" in out
+
+
+def test_monitor_without_elastic_sidecar_has_no_badge(tmp_path, capsys):
+    from rocm_mpi_tpu.telemetry.__main__ import main as telemetry_main
+
+    _write_heartbeat(tmp_path, 0, 12)
+    rc = telemetry_main(["monitor", str(tmp_path), "--iterations", "1"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "SHRUNK" not in out and "mesh" not in out
+
+
+# ---------------------------------------------------------------------------
+# The acceptance drills: gloo-real shrink on kill / die / stall
+# ---------------------------------------------------------------------------
+
+DRILL = dict(nx=16, ny=16, nt=16, every=4)
+
+
+def _drill_argv(ck):
+    return [
+        str(ROOT / "tests" / "elastic_worker.py"),
+        "--nx", str(DRILL["nx"]), "--ny", str(DRILL["ny"]),
+        "--nt", str(DRILL["nt"]), "--every", str(DRILL["every"]),
+        # keep every step: the bitwise reference re-restores the exact
+        # step the shrink resumed from AFTER the run finished, which
+        # the default keep=3 would have pruned in the stall drill.
+        "--keep", "8",
+        "--dir", str(ck),
+    ]
+
+
+def _reference_continuation(ck, start):
+    """The uninterrupted 1-rank twin: restore the SAME checkpoint at
+    `start` (template-less, 1-device plan), advance to nt on a (1,1)
+    mesh — what the shrunken run's final state must equal bitwise."""
+    devices = jax.devices()[:1]
+    state = ckpt.restore_state(ck, start, like=None, devices=devices)
+    cfg = DiffusionConfig(
+        global_shape=(DRILL["nx"], DRILL["ny"]), lengths=(10.0, 10.0),
+        nt=DRILL["nt"], warmup=0, dtype="f64", dims=(1, 1),
+    )
+    grid = pmesh.init_global_grid(
+        DRILL["nx"], DRILL["ny"], dims=(1, 1), devices=devices
+    )
+    model = HeatDiffusion(cfg, grid=grid)
+    _, Cp = model.init_state()
+    advance = model.advance_fn("perf")
+    return advance(state[0], Cp, DRILL["nt"] - start)
+
+
+@pytest.mark.parametrize(
+    "kind,spec,resume",
+    [
+        # kill/die strike AFTER the step-8 save completed: resume = 8.
+        ("kill", "kill@step=8,rank=1", 8),
+        ("die", "die@step=8,rank=1", 8),
+        # The stall wedges rank 1 at the opt-in PRE-save site, so its
+        # peer bumps past it (the watchdog's stalled-vs-median
+        # signature) while the step-8 save itself is torn: resume = 4.
+        ("stall", "stall@step=8,rank=1,at=segment-pre", 4),
+    ],
+)
+def test_elastic_drill_shrinks_and_resumes_bitwise(tmp_path, kind, spec,
+                                                   resume):
+    """THE acceptance drill: 2-rank gloo run, rank 1 killed / vanished /
+    stalled mid-run → the supervisor shrinks to 1 rank → resumes from
+    the latest valid step → the final checkpoint is bitwise-equal to an
+    uninterrupted 1-rank continuation of the same global state."""
+    ck = tmp_path / "ck"
+    hdir = tmp_path / "health"
+    report = run_elastic(
+        _drill_argv(ck), 2,
+        checkpoint_dir=ck,
+        global_shape=(DRILL["nx"], DRILL["ny"]),
+        health_dir=hdir,
+        inject_fault=spec,
+        timeout=100,
+        init_timeout_s=60,
+        heartbeat_s=2.0,
+        peer_grace_s=3.5,
+        stall_grace_s=5.0,
+        postmortem_grace_s=1.2,
+        vanish_grace_s=5.0,
+    )
+    assert report.shrinks == 1, report.launches
+    assert report.final_nprocs == 1
+    first, second = report.launches
+    assert first["nprocs"] == 2 and not first["ok"]
+    assert first["dead_ranks"] == [1], first
+    assert second["nprocs"] == 1 and second["ok"]
+    if kind == "stall":
+        assert first["reason"] == "watchdog-stall"
+        assert report.launches[0]["mesh"] == [2, 1]
+    if kind == "die":
+        assert "vanished" in first["reason"]
+    # The shrink resumed from the last step durably saved by BOTH ranks.
+    shrink = next(e for e in report.events
+                  if e["name"] == "elastic.shrink")
+    assert shrink["resume_step"] == resume
+    assert shrink["old_mesh"] == [2, 1] and shrink["new_mesh"] == [1, 1]
+    # Final state: the run checkpointed through nt on the shrunken mesh.
+    assert ckpt.latest_valid_step(ck) == DRILL["nt"]
+    final = ckpt.restore_state(ck, DRILL["nt"], like=None,
+                               devices=jax.devices()[:1])
+    ref = _reference_continuation(ck, resume)
+    np.testing.assert_array_equal(np.asarray(final[0]), np.asarray(ref))
+    if kind == "stall":
+        # The monitor reads the supervisor's record: mesh + SHRUNK
+        # badge (subprocess once per drill family — the in-process
+        # badge rendering is pinned separately above).
+        proc = subprocess.run(
+            [sys.executable, "-m", "rocm_mpi_tpu.telemetry", "monitor",
+             str(hdir), "--iterations", "1"],
+            capture_output=True, text=True, timeout=60, cwd=ROOT,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "SHRUNK from (2, 1)" in proc.stdout, proc.stdout
+
+
+def test_elastic_drill_clean_run_never_shrinks(tmp_path):
+    """The control: same harness, no fault — one launch, no shrink, no
+    SHRUNK badge, and the legacy same-mesh contract intact (the final
+    checkpoint equals a straight 2-rank reference restored in-process)."""
+    ck = tmp_path / "ck"
+    hdir = tmp_path / "health"
+    report = run_elastic(
+        _drill_argv(ck), 2,
+        checkpoint_dir=ck,
+        global_shape=(DRILL["nx"], DRILL["ny"]),
+        health_dir=hdir,
+        timeout=100,
+        init_timeout_s=60,
+        heartbeat_s=2.0,
+        peer_grace_s=3.5,
+        vanish_grace_s=6.0,
+    )
+    assert report.shrinks == 0 and report.final_nprocs == 2
+    assert [e["name"] for e in report.events] == ["elastic.launch",
+                                                  "elastic.complete"]
+    for pid, (p, (out, err)) in enumerate(report.results):
+        assert p.returncode == 0, (pid, err[-800:])
+    assert ckpt.latest_valid_step(ck) == DRILL["nt"]
+    # No watchdog wreckage on a clean elastic run.
+    assert not (hdir / "postmortem").exists()
+    st = health.elastic_status(
+        health.load_elastic_events(hdir)[0]
+    )
+    assert st is not None and st["shrunk"] is False
+    # Legacy bitwise contract: the 2-rank checkpoint restores in-process
+    # (different process count, same mesh shape) to the straight result.
+    _, adv, state = _model(dims=(2, 1), shape=(DRILL["nx"], DRILL["ny"]))
+    ref = adv((jnp.copy(state[0]),), DRILL["nt"])
+    final = ckpt.restore_state(ck, DRILL["nt"], like=None,
+                               devices=jax.devices()[:2])
+    np.testing.assert_array_equal(np.asarray(final[0]),
+                                  np.asarray(ref[0]))
