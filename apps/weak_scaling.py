@@ -29,7 +29,7 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 def telemetry_windowed_run(model, variant: str, nt: int, warmup: int,
                            windows: int, driver: str = "step",
-                           step_base: int = 0):
+                           step_base: int = 0, config: str | None = None):
     """The --telemetry run path (diffusion): the same warmup/timed
     protocol as model.run, but the timed loop split into `windows`
     spanned windows — per-step PERCENTILES need more than the single
@@ -77,7 +77,8 @@ def telemetry_windowed_run(model, variant: str, nt: int, warmup: int,
         # loudly here, not as a later divide-by-zero or a negative rate.
         raise ValueError(f"need 0 <= warmup < nt, got {warmup}, {nt}")
     if driver == "scan":
-        advance, unit = model.scan_advance_fn(variant, nt=nt, warmup=warmup)
+        advance, unit = model.scan_advance_fn(variant, nt=nt, warmup=warmup,
+                                              config=config)
     else:
         advance, unit = model.advance_fn(variant), 1
     T, Cp = model.init_state()
@@ -178,6 +179,15 @@ def main(argv=None) -> int:
                    help="with --telemetry: skip the halo/interior/"
                    "checkpoint phase-attribution probes "
                    "(telemetry.probes)")
+    p.add_argument("--autotune", action="store_true",
+                   help="consult the persistent tuning cache "
+                   "(config='auto', docs/PERF.md 'Autotuning'): the scan "
+                   "chunk and deep-halo depth resolve per "
+                   "(shape, dtype, topology, backend) key, falling back "
+                   "to the hand defaults on a miss; cache hit/miss and "
+                   "the chosen configs land in the run gauges "
+                   "(tune.hits/tune.misses) so `telemetry regress` can "
+                   "gate tuned-vs-default ladders")
     args = p.parse_args(argv)
 
     jax = setup_jax(args)  # distributed init + --cpu-devices + x64, shared
@@ -262,10 +272,17 @@ def main(argv=None) -> int:
         model = model_cls(cfg_cls(**common), devices=jax.devices()[:n])
         from rocm_mpi_tpu import telemetry
 
+        run_config = "auto" if args.autotune else None
         if args.variant == "deep":
             # Both models default None to their own depth policy and
-            # reject explicit invalid depths loudly.
-            r = model.run_deep(block_steps=args.deep_k)
+            # reject explicit invalid depths loudly. --autotune lets an
+            # unset depth consult the tuning cache (diffusion only — the
+            # other models keep their own policies).
+            if args.workload == "diffusion":
+                r = model.run_deep(block_steps=args.deep_k,
+                                   config=run_config)
+            else:
+                r = model.run_deep(block_steps=args.deep_k)
         elif (telemetry.enabled() and args.workload == "diffusion"
               and model.config.halo_transport != "host"):
             # The windowed path drives the advance directly; under
@@ -275,10 +292,11 @@ def main(argv=None) -> int:
             r = telemetry_windowed_run(
                 model, args.variant, args.nt, args.warmup,
                 args.telemetry_windows, driver=args.driver,
-                step_base=steps_banked,
+                step_base=steps_banked, config=run_config,
             )
         else:
-            r = model.run(variant=args.variant, driver=args.driver)
+            r = model.run(variant=args.variant, driver=args.driver,
+                          config=run_config)
         steps_banked += args.nt
         probe_model = model  # the last rung this process participated in
         per_dev = r.gpts / n
@@ -331,6 +349,13 @@ def main(argv=None) -> int:
         from rocm_mpi_tpu.telemetry import compiles
 
         compiles.emit_gauges()
+        # Autotuner resolve outcomes (tune.hits/tune.misses + per-key
+        # tune.resolve annotations): a tuned ladder and a hand-default
+        # ladder are different measurements — the gauges say which this
+        # was, so regress never compares them silently.
+        from rocm_mpi_tpu.tuning import resolve as tuning_resolve
+
+        tuning_resolve.emit_gauges()
 
     if (telemetry.enabled() and args.probes and probe_model is not None
             and args.workload == "diffusion"):

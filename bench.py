@@ -70,6 +70,11 @@ import time
 REF_ESTIMATE_GPTS = 29.9  # estimated MI50 fused-kernel rate (see docstring)
 DEFAULT_BUDGET_S = 300.0
 METRIC = "Gpts/s/chip (2D diffusion, 252²/chip)"
+# THE benchmark geometry — one constant shared by _bench_model and the
+# ladder's pad-label planner, so the planned and measured programs
+# cannot drift (the same no-drift rule as the cache primer).
+BENCH_SHAPE = (252, 252)
+BENCH_DTYPE = "float32"  # a spelling both DiffusionConfig and np.dtype take
 
 # Child exit codes (anything else = unexpected crash, retried).
 RC_OK = 0
@@ -163,11 +168,11 @@ def _bench_model(nt: int, warmup: int):
     from rocm_mpi_tpu.models import HeatDiffusion
 
     cfg = DiffusionConfig(
-        global_shape=(252, 252),
+        global_shape=BENCH_SHAPE,
         lengths=(10.0, 10.0),
         nt=nt,
         warmup=warmup,
-        dtype="f32",
+        dtype=BENCH_DTYPE,
         dims=(1, 1),
     )
     return HeatDiffusion(cfg)
@@ -207,6 +212,13 @@ def child_main(budget_s: float) -> int:
         return RC_NO_TPU
 
     best = 0.0
+    # One compiled-program cache across every rung (models.diffusion
+    # _run_single_shard keys it by the full trace identity): identical
+    # configs at different step counts — the flagship calibration, a
+    # re-measured rung, the long window riding the winner — reuse ONE
+    # trace instead of re-tracing per call. Pinned by the compiles.total
+    # assertion in tests/test_bench.py.
+    programs: dict = {}
 
     def emit_if_better(r, label):
         nonlocal best
@@ -225,7 +237,9 @@ def child_main(budget_s: float) -> int:
     # accelerator number lands on stdout almost immediately; everything
     # after this line is upgrade, not risk.
     t0 = time.monotonic()
-    r = model(4_096 + 262_144, 4_096).run_vmem_resident(chunk=16)
+    r = model(4_096 + 262_144, 4_096).run_vmem_resident(
+        chunk=16, program_cache=programs
+    )
     print(
         f"floor (chunk=16) compile+run {time.monotonic() - t0:.1f} s",
         file=sys.stderr,
@@ -238,7 +252,9 @@ def child_main(budget_s: float) -> int:
         return RC_OK
     warmup = 32_768
     t0 = time.monotonic()
-    r2 = model(warmup + 262_144, warmup).run_vmem_resident()
+    r2 = model(warmup + 262_144, warmup).run_vmem_resident(
+        program_cache=programs
+    )
     print(
         f"flagship (chunk=256) compile+run {time.monotonic() - t0:.1f} s",
         file=sys.stderr,
@@ -268,14 +284,19 @@ def child_main(budget_s: float) -> int:
         label = f"252² chunk-256 {form}{'+pad256' if pad else ''}"
         t0 = time.monotonic()
         rv = model(warmup + 262_144, warmup).run_vmem_resident(
-            body_form=form, pad_pow2=pad
+            body_form=form, pad_pow2=pad, program_cache=programs
         )
-        # The trace can refuse a requested pad (VMEM budget): then neither
+        # The plan can refuse a requested pad (VMEM budget): then neither
         # this row nor — should the rung win — the long-window record may
         # carry a pad label for an unpadded program (ADVICE r5 #4). The
         # winner keeps the EFFECTIVE config, so the long window re-runs
-        # and labels what was actually measured.
-        eff_pad = pad and pk.last_pad_applied() is not False
+        # and labels what was actually measured. plan_vmem_loop is the
+        # pure planner — valid even when the compiled program came from
+        # the cache, which the retired last_pad_applied() flag never was.
+        eff_pad = pad and pk.plan_vmem_loop(
+            BENCH_SHAPE, BENCH_DTYPE, warmup + 262_144,
+            body_form=form, pad_pow2=pad,
+        ).pad_applied is not False
         if pad and not eff_pad:
             label += " (pad skipped)"
         print(
@@ -313,7 +334,7 @@ def child_main(budget_s: float) -> int:
         file=sys.stderr,
     )
     r3 = model(warmup + timed, warmup).run_vmem_resident(
-        body_form=best_cfg[0], pad_pow2=best_cfg[1]
+        body_form=best_cfg[0], pad_pow2=best_cfg[1], program_cache=programs
     )
     win = f"{best_cfg[0]}{'+pad256' if best_cfg[1] else ''}"
     emit_if_better(r3, f"252² chunk-256 {win} x{timed}")
@@ -433,8 +454,14 @@ def run_suite() -> None:
         model = HeatDiffusion(cfg)
         report(label, getattr(model, runner)(**kw))
 
+    # config="auto" on the VMEM-resident rows: the suite measures what a
+    # tuned deployment would run — a cache hit steers the row to the
+    # banked winner (bitwise-safe knobs only at this op), a miss falls
+    # back to the hand defaults, and either way the tune.hits/tune.misses
+    # gauges below record which happened so `telemetry regress` can gate
+    # tuned-vs-default suites instead of comparing them silently.
     row("252² VMEM-resident loop", (252, 252), "run_vmem_resident",
-        32_768 + 1_048_576, 32_768)
+        32_768 + 1_048_576, 32_768, config="auto")
     row("252² per-step perf (ppermute)", (252, 252), "run",
         220_000, 20_000, variant="perf")
     row("252² per-step hide (overlap)", (252, 252), "run",
@@ -493,8 +520,16 @@ def run_suite() -> None:
         )
         report(
             f"252² {name} VMEM-resident loop",
-            model_cls(mcfg_v).run_vmem_resident(),
+            model_cls(mcfg_v).run_vmem_resident(config="auto"),
         )
+
+    # Bank the autotuner's resolve outcomes (tune.hits / tune.misses run
+    # gauges + the per-key tune.resolve annotations) before the record:
+    # a suite steered by a warm cache and one running hand defaults are
+    # different measurements and must say so in their telemetry.
+    from rocm_mpi_tpu.tuning import resolve as tuning_resolve
+
+    tuning_resolve.emit_gauges()
 
     # The trajectory record is written only when the whole ladder ran —
     # a partial (killed) suite prints its rows to stderr but does not

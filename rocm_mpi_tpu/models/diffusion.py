@@ -96,6 +96,35 @@ def default_deep_depth(local_shape, itemsize: int) -> int:
     return max(1, k)
 
 
+def auto_scan_chunk(op: str, grid, dtype, config: str | None) -> int | None:
+    """The scan drivers' `config="auto"` seam, shared by all three
+    models: the tuning cache's preferred chunk for `op` at this
+    shard/topology, or None (= the default whole-window policy) on a
+    miss or a non-auto config. The caller still gcd's the preference
+    against its windows (effective_block_steps) — auto never breaks the
+    divisibility contract, it only prefers a different quantum."""
+    if config in (None, "default"):
+        return None
+    if config != "auto":
+        raise ValueError(
+            f"config must be None, 'default' or 'auto', got {config!r}"
+        )
+    if jax.process_count() > 1:
+        # Multi-controller: every process resolves from ITS OWN cache
+        # file, and a divergent chunk means divergently traced programs
+        # across ranks. The defaults are deterministic everywhere; auto
+        # stays hands-off until a broadcast-consistent resolve exists.
+        return None
+    from rocm_mpi_tpu.tuning import resolve as tuning_resolve
+
+    tuned = tuning_resolve.resolve(
+        op, grid.local_shape, dtype, topology=grid.dims
+    )
+    if tuned and tuned.get("chunk"):
+        return int(tuned["chunk"])
+    return None
+
+
 def warn_host_transport_ignored(variant: str, stacklevel: int = 3) -> None:
     """The one warning for halo_transport='host' on a variant that keeps its
     device-side communication (only 'shard' routes to the host-staged
@@ -454,6 +483,7 @@ class HeatDiffusion:
         nt: int | None = None,
         warmup: int | None = None,
         chunk: int | None = None,
+        config: str | None = None,
     ):
         """(jitted (T, Cp, n) -> T, chunk q) — the donation-aware scan
         driver: the hot loop is a `lax.scan` over a STATIC q-step chunk
@@ -468,8 +498,12 @@ class HeatDiffusion:
         one compiled program (gcd of warmup and the timed window —
         effective_block_steps); `n` must be a multiple of q (the outer
         trip count floors, the step-count convention the deep advance
-        shares). The caller must rebind T from the result (GL01: the
-        passed-in buffer is donated).
+        shares). `config="auto"` treats a tuning-cache chunk (op
+        "diffusion.scan", keyed per shard/topology) as the preference an
+        unset `chunk` gcd's from — traffic-neutral and bitwise-identical
+        at any q (scan==step is pinned), so auto only moves window
+        quantization. The caller must rebind T from the result (GL01:
+        the passed-in buffer is donated).
         """
         cfg, grid = self.config, self.grid
         step = self._get_step(variant)
@@ -477,9 +511,13 @@ class HeatDiffusion:
         dt = cfg.jax_dtype(cfg.dt)
         nt_v = cfg.nt if nt is None else nt
         wu_v = cfg.warmup if warmup is None else warmup
+        explicit = chunk is not None
+        if not explicit:
+            chunk = auto_scan_chunk("diffusion.scan", grid, cfg.jax_dtype,
+                                    config)
         q = effective_block_steps(
             nt_v, wu_v, (nt_v - wu_v) if chunk is None else chunk,
-            label="scan driver chunk", warn=chunk is not None,
+            label="scan driver chunk", warn=explicit,
         )
 
         @functools.partial(jax.jit, donate_argnums=0)
@@ -502,6 +540,7 @@ class HeatDiffusion:
     def run(
         self, variant: str = "ap", nt: int | None = None,
         warmup: int | None = None, driver: str = "step",
+        config: str | None = None,
     ) -> RunResult:
         """Run `nt` steps; time all but the first `warmup` (perf.jl:47-53).
 
@@ -510,7 +549,8 @@ class HeatDiffusion:
         driver (scan_advance_fn — allocation-free steady state). Both run
         the same step program in the same order; results are bitwise
         identical. The host-staged oracle path ignores the driver (it is
-        a numpy loop).
+        a numpy loop). `config="auto"` lets the scan driver's chunk
+        consult the tuning cache (scan_advance_fn).
         """
         cfg = self.config
         nt = cfg.nt if nt is None else nt
@@ -526,7 +566,8 @@ class HeatDiffusion:
         T, Cp = self.init_state()
         if driver == "scan":
             # q divides both windows by construction (gcd).
-            advance, _ = self.scan_advance_fn(variant, nt=nt, warmup=warmup)
+            advance, _ = self.scan_advance_fn(variant, nt=nt, warmup=warmup,
+                                              config=config)
         else:
             advance = self.advance_fn(variant)
         timer = metrics.Timer(label="step_window", phase="step",
@@ -541,7 +582,7 @@ class HeatDiffusion:
 
     def _run_single_shard(
         self, nt, warmup, multi_step_fn, granularity: int, granularity_kw: str,
-        explicit: bool = False, extra_kw=None,
+        explicit: bool = False, extra_kw=None, program_cache=None,
     ) -> RunResult:
         """Shared scaffold of the single-shard fast paths: validate, pick a
         step granularity dividing both the warmup and timed windows (so one
@@ -553,6 +594,15 @@ class HeatDiffusion:
         `explicit` marks a caller-requested granularity: degradation (gcd
         against the windows, or the large-field chunk cap) then warns
         instead of staying silent.
+
+        `program_cache` (a caller-held dict) keys the jitted advance by
+        the full trace identity — physics config, granularity, kernel
+        kwargs — so two runs of the SAME configuration reuse one
+        compiled program instead of re-tracing per call (jax's jit cache
+        keys on function identity, and each call here otherwise builds a
+        fresh closure). bench.py's kernel-form ladder holds one dict
+        across its rungs; the step counts stay out of the key on purpose
+        (they ride the dynamic `n`).
         """
         cfg = self.config
         nt = cfg.nt if nt is None else nt
@@ -575,9 +625,25 @@ class HeatDiffusion:
         if extra_kw:
             kw.update(extra_kw)
 
-        @functools.partial(jax.jit, donate_argnums=0)
-        def advance(T, Cp, n):
-            return multi_step_fn(T, Cp, cfg.lam, dt, cfg.spacing, n, **kw)
+        cache_key = None
+        advance = None
+        if program_cache is not None:
+            cache_key = (
+                getattr(multi_step_fn, "__qualname__", repr(multi_step_fn)),
+                cfg.global_shape, cfg.lengths, cfg.dtype,
+                cfg.lam, cfg.cp0,
+                tuple(sorted(kw.items())),
+            )
+            advance = program_cache.get(cache_key)
+
+        if advance is None:
+
+            @functools.partial(jax.jit, donate_argnums=0)
+            def advance(T, Cp, n):
+                return multi_step_fn(T, Cp, cfg.lam, dt, cfg.spacing, n, **kw)
+
+            if cache_key is not None:
+                program_cache[cache_key] = advance
 
         timer = metrics.Timer(label="step_window", phase="step",
                               steps=nt - warmup, variant=key,
@@ -595,6 +661,8 @@ class HeatDiffusion:
         chunk: int | None = None,
         body_form: str | None = None,
         pad_pow2: bool | None = None,
+        config: str | None = None,
+        program_cache: dict | None = None,
     ) -> RunResult:
         """Single-shard fast path: the whole nt-step loop inside one Pallas
         kernel, field VMEM-resident (ops.pallas_kernels.fused_multi_step).
@@ -609,21 +677,63 @@ class HeatDiffusion:
 
         `body_form`/`pad_pow2` select the kernel-form A/B candidates as
         trace-time kwargs (bench.py's stage-2.5 ladder); None keeps the
-        module-constant hardware defaults.
+        module-constant hardware defaults. `config="auto"` fills any knob
+        left None from the persistent tuning cache instead
+        (tuning/resolve.py; a miss keeps the defaults, bitwise) — the
+        resolution happens HERE, outside any trace, and the winners
+        travel down as the same explicit kwargs. `program_cache` reuses
+        compiled advances across same-config runs (_run_single_shard).
         """
+        import rocm_mpi_tpu.ops.pallas_kernels as _pk
         from rocm_mpi_tpu.ops.pallas_kernels import (
             DEFAULT_STEP_CHUNK,
             fused_multi_step,
         )
 
+        cfg = self.config
+        if config == "auto":
+            from rocm_mpi_tpu.tuning import resolve as tuning_resolve
+
+            tuned = tuning_resolve.resolve(
+                "diffusion.vmem_loop", cfg.global_shape, cfg.jax_dtype
+            ) or {}
+            if chunk is None and _pk.adoptable_vmem_chunk(
+                tuned.get("chunk")
+            ):
+                chunk = tuned["chunk"]
+                # Auto-resolved, not caller-requested: the gcd against
+                # the windows below must not warn (explicit stays False).
+                auto_chunk = True
+            else:
+                auto_chunk = False
+            if body_form is None:
+                body_form = tuned.get("body_form")
+            if pad_pow2 is None:
+                pad_pow2 = tuned.get("pad_pow2")
+        elif config in (None, "default"):
+            auto_chunk = False
+        else:
+            raise ValueError(
+                f"config must be None, 'default' or 'auto', got {config!r}"
+            )
+        # Normalize the knobs to their effective values HERE (the same
+        # resolution plan_vmem_loop would do at trace time): the
+        # program-cache key must see "None" and the module default as
+        # the identical trace they are, or bench's winner re-run would
+        # re-trace the program its calibration rung already compiled.
+        if body_form is None:
+            body_form = _pk.EQC_BODY_FORM
+        if pad_pow2 is None:
+            pad_pow2 = _pk.VMEM_PAD_POW2
         return self._run_single_shard(
             nt,
             warmup,
             fused_multi_step,
             DEFAULT_STEP_CHUNK if chunk is None else chunk,
             "chunk",
-            explicit=chunk is not None,
+            explicit=chunk is not None and not auto_chunk,
             extra_kw={"body_form": body_form, "pad_pow2": pad_pow2},
+            program_cache=program_cache,
         )
 
     def run_hbm_blocked(
@@ -661,24 +771,32 @@ class HeatDiffusion:
         warmup: int | None = None,
         block_steps: int | None = None,
         warn: bool = True,
+        config: str | None = None,
     ) -> int:
         """The sweep depth run_deep will actually execute for these
         arguments — THE source of truth for callers labeling artifacts by
         depth (apps/_common.py), so label and executed k cannot drift.
         Policy: defaults route through default_deep_depth (VMEM-aware,
-        shard-clamped); explicit depths keep make_deep_sweep's strict
-        shard-extent validation; either is then gcd'd against both timing
-        windows.
+        shard-clamped) — unless `config="auto"` finds a tuned depth for
+        this shard/topology in the tuning cache
+        (parallel.deep_halo.resolve_deep_k; note a different k is a
+        different sweep SCHEDULE, fp-reordered vs the default depth, not
+        a bitwise-neutral knob like the kernel forms); explicit depths
+        keep make_deep_sweep's strict shard-extent validation; any of
+        the three is then gcd'd against both timing windows.
         """
         cfg = self.config
         if block_steps is None:
-            # bf16 is storage-only in the local kernels (f32 in-kernel):
-            # size the depth at the compute width.
             from rocm_mpi_tpu.ops.pallas_kernels import _compute_itemsize
+            from rocm_mpi_tpu.parallel.deep_halo import resolve_deep_k
 
-            k = default_deep_depth(
-                self.grid.local_shape, _compute_itemsize(cfg.jax_dtype)
-            )
+            k = resolve_deep_k(self.grid, cfg.jax_dtype, config)
+            if k is None:
+                # bf16 is storage-only in the local kernels (f32
+                # in-kernel): size the depth at the compute width.
+                k = default_deep_depth(
+                    self.grid.local_shape, _compute_itemsize(cfg.jax_dtype)
+                )
         else:
             k = block_steps
         return effective_block_steps(
@@ -695,13 +813,15 @@ class HeatDiffusion:
         block_steps: int | None = None,
         nt: int | None = None,
         warmup: int | None = None,
+        config: str | None = None,
     ):
         """(jitted (T, Cp, n_steps) -> T, executed depth k) — the deep
         schedule's advance as a first-class function, so callers beyond
         run_deep (the --checkpoint segmented loop) can drive the sweep.
         `n_steps` must be a multiple of k (the fori_loop trip count
         floors) — the step-count convention every model's deep advance
-        shares (wave/swe match)."""
+        shares (wave/swe match). `config="auto"` lets an unset
+        block_steps consult the tuning cache (effective_deep_depth)."""
         from rocm_mpi_tpu.parallel.deep_halo import make_deep_sweep
 
         cfg = self.config
@@ -709,7 +829,8 @@ class HeatDiffusion:
             # The warning lives with the schedule builder so EVERY deep
             # caller (run_deep, the --checkpoint segmented loop) gets it.
             warn_host_transport_ignored("deep", stacklevel=3)
-        k = self.effective_deep_depth(nt, warmup, block_steps)
+        k = self.effective_deep_depth(nt, warmup, block_steps,
+                                      config=config)
         dt = cfg.jax_dtype(cfg.dt)
         sched = make_deep_sweep(self.grid, k, cfg.lam, dt, cfg.spacing)
 
@@ -730,6 +851,7 @@ class HeatDiffusion:
         nt: int | None = None,
         warmup: int | None = None,
         block_steps: int | None = None,
+        config: str | None = None,
     ) -> RunResult:
         """Sharded fast path: deep-halo sweeps (parallel.deep_halo) — one
         width-k ghost exchange per k steps, the multi-chip form of temporal
@@ -748,7 +870,7 @@ class HeatDiffusion:
         if not 0 <= warmup < nt:
             raise ValueError(f"need 0 <= warmup < nt, got {warmup}, {nt}")
         advance, _ = self.deep_advance_fn(
-            block_steps=block_steps, nt=nt, warmup=warmup
+            block_steps=block_steps, nt=nt, warmup=warmup, config=config
         )
         T, Cp = self.init_state()
         timer = metrics.Timer(label="step_window", phase="step",

@@ -289,21 +289,30 @@ class ShallowWater:
         nt: int | None = None,
         warmup: int | None = None,
         chunk: int | None = None,
+        config: str | None = None,
     ):
         """(jitted (h, us, Mus, n) -> (h, us), chunk q) — the
         donation-aware scan driver, SWE edition (see
         HeatDiffusion.scan_advance_fn): the whole coupled state pytree is
         the scan carry and every state leaf is donated; the masks ride
         along undonated (they are read-only data). `n` must be a multiple
-        of q."""
-        from rocm_mpi_tpu.models.diffusion import effective_block_steps
+        of q. `config="auto"` gcd's an unset chunk from the tuning cache
+        (op "swe.scan" — see the diffusion edition's contract)."""
+        from rocm_mpi_tpu.models.diffusion import (
+            auto_scan_chunk,
+            effective_block_steps,
+        )
 
         cfg = self.config
         nt_v = cfg.nt if nt is None else nt
         wu_v = cfg.warmup if warmup is None else warmup
+        explicit = chunk is not None
+        if not explicit:
+            chunk = auto_scan_chunk("swe.scan", self.grid, cfg.jax_dtype,
+                                    config)
         q = effective_block_steps(
             nt_v, wu_v, (nt_v - wu_v) if chunk is None else chunk,
-            label="SWE scan driver chunk", warn=chunk is not None,
+            label="SWE scan driver chunk", warn=explicit,
         )
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -345,26 +354,30 @@ class ShallowWater:
     def run(
         self, variant: str = "perf",
         nt: int | None = None, warmup: int | None = None,
-        driver: str = "step",
+        driver: str = "step", config: str | None = None,
     ) -> SWERunResult:
         """`driver="scan"` routes to the donation-aware scan driver
         (scan_advance_fn); "step" keeps the per-step fori_loop. Same step
-        program either way — results are bitwise identical."""
+        program either way — results are bitwise identical.
+        `config="auto"` lets the scan chunk consult the tuning cache."""
         if driver not in ("step", "scan"):
             raise ValueError(f"driver must be 'step' or 'scan', got {driver!r}")
         if driver == "scan":
-            advance, _ = self.scan_advance_fn(variant, nt=nt, warmup=warmup)
+            advance, _ = self.scan_advance_fn(variant, nt=nt, warmup=warmup,
+                                              config=config)
         else:
             advance = self.advance_fn(variant)
         return self._run_timed(advance, nt, warmup)
 
     def run_vmem_resident(
         self, nt: int | None = None, warmup: int | None = None,
-        chunk: int | None = None,
+        chunk: int | None = None, config: str | None = None,
     ) -> SWERunResult:
         """Single-shard fast path: the whole coupled loop inside one
         Pallas kernel, all ndim+1 fields VMEM-resident
-        (ops.swe_kernels.swe_multi_step)."""
+        (ops.swe_kernels.swe_multi_step). `config="auto"` fills an unset
+        chunk from the tuning cache (op "swe.vmem_loop"), resolved here
+        outside any trace and gcd'd against the windows."""
         from rocm_mpi_tpu.models.diffusion import effective_block_steps
         from rocm_mpi_tpu.ops.pallas_kernels import DEFAULT_STEP_CHUNK
         from rocm_mpi_tpu.ops.swe_kernels import swe_multi_step
@@ -374,11 +387,28 @@ class ShallowWater:
             raise ValueError(
                 "the VMEM-resident path requires an unsharded grid"
             )
+        explicit = chunk is not None
+        if config == "auto" and chunk is None:
+            from rocm_mpi_tpu.ops.pallas_kernels import adoptable_vmem_chunk
+            from rocm_mpi_tpu.tuning import resolve as tuning_resolve
+
+            tuned = tuning_resolve.resolve(
+                "swe.vmem_loop", cfg.global_shape, cfg.jax_dtype
+            )
+            if tuned and adoptable_vmem_chunk(tuned.get("chunk")):
+                chunk = tuned["chunk"]
+        elif config not in (None, "default", "auto"):
+            raise ValueError(
+                f"config must be None, 'default' or 'auto', got {config!r}"
+            )
+        # warn=explicit: a caller-requested chunk degrades loudly (the
+        # wave/diffusion editions' policy); framework-plumbed and
+        # auto-resolved preferences degrade silently.
         eff_chunk = effective_block_steps(
             cfg.nt if nt is None else nt,
             cfg.warmup if warmup is None else warmup,
             DEFAULT_STEP_CHUNK if chunk is None else chunk,
-            warn=False,
+            warn=explicit, label="SWE VMEM chunk",
         )
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))

@@ -294,21 +294,31 @@ class AcousticWave:
         nt: int | None = None,
         warmup: int | None = None,
         chunk: int | None = None,
+        config: str | None = None,
     ):
         """(jitted (U, Uprev, C2, n) -> (U, Uprev), chunk q) — the
         donation-aware scan driver, wave edition (see
         HeatDiffusion.scan_advance_fn): the state pair is the scan carry
         (XLA's double buffer — the leapfrog's natural `U, U⁻ = U⁺, U`
-        swap) and both leaves are donated. `n` must be a multiple of q."""
-        from rocm_mpi_tpu.models.diffusion import effective_block_steps
+        swap) and both leaves are donated. `n` must be a multiple of q.
+        `config="auto"` gcd's an unset chunk from the tuning cache (op
+        "wave.scan" — see the diffusion edition's contract)."""
+        from rocm_mpi_tpu.models.diffusion import (
+            auto_scan_chunk,
+            effective_block_steps,
+        )
 
         cfg = self.config
         step, prep = self._step(variant)
         nt_v = cfg.nt if nt is None else nt
         wu_v = cfg.warmup if warmup is None else warmup
+        explicit = chunk is not None
+        if not explicit:
+            chunk = auto_scan_chunk("wave.scan", self.grid, cfg.jax_dtype,
+                                    config)
         q = effective_block_steps(
             nt_v, wu_v, (nt_v - wu_v) if chunk is None else chunk,
-            label="wave scan driver chunk", warn=chunk is not None,
+            label="wave scan driver chunk", warn=explicit,
         )
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -350,26 +360,33 @@ class AcousticWave:
     def run(
         self, variant: str = "perf",
         nt: int | None = None, warmup: int | None = None,
-        driver: str = "step",
+        driver: str = "step", config: str | None = None,
     ) -> WaveRunResult:
         """`driver="scan"` routes to the donation-aware scan driver
         (scan_advance_fn); "step" keeps the per-step fori_loop. Same step
-        program either way — results are bitwise identical."""
+        program either way — results are bitwise identical.
+        `config="auto"` lets the scan chunk consult the tuning cache."""
         if driver not in ("step", "scan"):
             raise ValueError(f"driver must be 'step' or 'scan', got {driver!r}")
         if driver == "scan":
-            advance, _ = self.scan_advance_fn(variant, nt=nt, warmup=warmup)
+            advance, _ = self.scan_advance_fn(variant, nt=nt, warmup=warmup,
+                                              config=config)
         else:
             advance = self.advance_fn(variant)
         return self._run_timed(advance, nt, warmup)
 
     def run_vmem_resident(
-        self, nt: int | None = None, warmup: int | None = None
+        self, nt: int | None = None, warmup: int | None = None,
+        chunk: int | None = None, config: str | None = None,
     ) -> WaveRunResult:
         """Single-shard fast path: the whole leapfrog loop inside one
         Pallas kernel, state pair VMEM-resident
         (ops.wave_kernels.wave_multi_step) — the wave edition of the
         diffusion flagship's schedule (HeatDiffusion.run_vmem_resident).
+        `chunk` overrides the per-launch step count (the autotuner's
+        measurement knob); `config="auto"` fills an unset chunk from the
+        tuning cache (op "wave.vmem_loop") — resolved here, outside any
+        trace, then gcd'd against the windows like every granularity.
         """
         from rocm_mpi_tpu.models.diffusion import effective_block_steps
         from rocm_mpi_tpu.ops.pallas_kernels import DEFAULT_STEP_CHUNK
@@ -378,11 +395,25 @@ class AcousticWave:
         cfg = self.config
         if self.grid.nprocs != 1:
             raise ValueError("the VMEM-resident path requires an unsharded grid")
+        explicit = chunk is not None
+        if config == "auto" and chunk is None:
+            from rocm_mpi_tpu.ops.pallas_kernels import adoptable_vmem_chunk
+            from rocm_mpi_tpu.tuning import resolve as tuning_resolve
+
+            tuned = tuning_resolve.resolve(
+                "wave.vmem_loop", cfg.global_shape, cfg.jax_dtype
+            )
+            if tuned and adoptable_vmem_chunk(tuned.get("chunk")):
+                chunk = tuned["chunk"]
+        elif config not in (None, "default", "auto"):
+            raise ValueError(
+                f"config must be None, 'default' or 'auto', got {config!r}"
+            )
         chunk = effective_block_steps(
             cfg.nt if nt is None else nt,
             cfg.warmup if warmup is None else warmup,
-            DEFAULT_STEP_CHUNK,
-            warn=False,
+            DEFAULT_STEP_CHUNK if chunk is None else chunk,
+            warn=explicit, label="wave VMEM chunk",
         )
         dt = cfg.jax_dtype(cfg.dt)
 

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import functools
 import math
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -431,23 +432,135 @@ EQC_BODY_FORM = "eqc"
 # (tests/test_pallas_kernels.py) holds either way.
 VMEM_PAD_POW2 = False
 
-# What the LAST fused_multi_step trace actually did about padding:
-# True = pad applied, False = pad requested but skipped (VMEM budget),
-# None = no pad requested / field already pow2. Trace-time bookkeeping
-# for measurement labeling (bench.py appends '(pad skipped)' to a rung's
-# label off this), queryable via last_pad_applied().
-_LAST_PAD_APPLIED: bool | None = None
+class KernelChoice(NamedTuple):
+    """What a kernel entry point decided at trace time — dispatch route,
+    effective chunk/body form, and the pad outcome — as an explicit
+    record instead of a post-hoc module-global query flag (the retired
+    `last_pad_applied` pattern: a global written at trace time is stale
+    the moment a cached program is reused; a record computed by the pure
+    planner is valid whenever it is recomputed). `plan_vmem_loop` is the
+    planner; bench.py labels its ladder rungs from this, and the
+    autotuner keys measured programs by it."""
+
+    op: str  # the tuning-op spelling ("diffusion.vmem_loop", …)
+    dispatch: str  # "vmem-loop" | "whole" | "striped" | …
+    chunk: int | None = None  # effective steps per kernel launch
+    body_form: str | None = None  # resolved eqc/conly (vmem loop)
+    pad_requested: bool = False
+    # pad outcome, the old last_pad_applied tri-state: True = applied,
+    # False = requested but skipped (VMEM budget), None = not requested
+    # or nothing to pad (already pow2).
+    pad_applied: bool | None = None
+    padded_shape: tuple | None = None  # set only when pad_applied
+
+
+# Deprecation shim state for last_pad_applied(): written by
+# fused_multi_step solely so the deprecated accessor keeps answering
+# during its sunset. New code uses plan_vmem_loop(...) — pure, and valid
+# even when the compiled program came from a cache (this global is not).
+_LAST_CHOICE: KernelChoice | None = None
 
 
 def last_pad_applied() -> bool | None:
-    """Did the most recent fused_multi_step trace apply the pow2 pad?
-    (True/False/None-not-requested; see _LAST_PAD_APPLIED.) Valid right
-    after the call that traced the program — bench reads it per rung."""
-    return _LAST_PAD_APPLIED
+    """DEPRECATED: did the most recent fused_multi_step *trace* apply
+    the pow2 pad? Stale whenever a cached compiled program is reused —
+    compute the decision instead: plan_vmem_loop(...).pad_applied (pure,
+    per-config, cache-proof)."""
+    import warnings
+
+    warnings.warn(
+        "last_pad_applied() is deprecated: the module-global flag is only "
+        "valid right after the call that traced the program; use "
+        "plan_vmem_loop(shape, dtype, n_steps, ...).pad_applied instead",
+        DeprecationWarning,
+        stacklevel=2,
+    )
+    return None if _LAST_CHOICE is None else _LAST_CHOICE.pad_applied
 
 
 def _next_pow2(n: int) -> int:
     return 1 << (int(n) - 1).bit_length()
+
+
+def adoptable_vmem_chunk(v) -> bool:
+    """May a tuning-cache chunk steer a VMEM multi-step kernel? Only a
+    power of two >= 4: the kernels switch to a different fp body below
+    chunk 4, and a pow2 preference guarantees gcd(n, v) lands in the
+    SAME body-form class as the default gcd(n, DEFAULT_STEP_CHUNK) for
+    every n — the algebra that keeps config="auto" bitwise-equal to the
+    defaults no matter what step counts the caller brings. (The search
+    space only emits 16/64/256; this guards hand-edited entries.)"""
+    return (
+        isinstance(v, int) and not isinstance(v, bool)
+        and v >= 4 and (v & (v - 1)) == 0
+    )
+
+
+def plan_vmem_loop(shape, dtype, n_steps, chunk=None, body_form=None,
+                   pad_pow2=None, config=None,
+                   warn_on_cap=False) -> KernelChoice:
+    """The VMEM-resident loop's trace-time decisions as a pure function
+    of its inputs — the planning half of fused_multi_step, split out so
+    callers (bench.py's ladder labels, the autotuner's program keys) can
+    know what a given config WILL do without running it, and so
+    `config="auto"` resolution has one seam.
+
+    `config`: None/"default" keeps the passed/None-default knobs;
+    "auto" consults the tuning cache (tuning/resolve.py, op
+    "diffusion.vmem_loop") for any knob the caller left None, falling
+    back to the module-constant hardware defaults on a miss. Resolved
+    values end up in the returned record — explicit data, never mutated
+    module state (GL02)."""
+    shape = tuple(int(d) for d in shape)
+    if config == "auto":
+        from rocm_mpi_tpu.tuning import resolve as tuning_resolve
+
+        tuned = tuning_resolve.resolve("diffusion.vmem_loop", shape, dtype)
+        if tuned:
+            if chunk is None and adoptable_vmem_chunk(tuned.get("chunk")):
+                # A tuned chunk is a PREFERENCE the divisibility
+                # contract still governs: gcd against a static n_steps
+                # (mirroring the default policy); with a traced n the
+                # caller's guarantee covers only the default chunk, so
+                # auto stays hands-off there.
+                if isinstance(n_steps, int):
+                    chunk = math.gcd(n_steps, tuned["chunk"]) or None
+            if body_form is None:
+                body_form = tuned.get("body_form")
+            if pad_pow2 is None:
+                pad_pow2 = tuned.get("pad_pow2")
+    elif config not in (None, "default"):
+        raise ValueError(
+            f"config must be None, 'default' or 'auto', got {config!r}"
+        )
+    if body_form is None:
+        body_form = EQC_BODY_FORM
+    if body_form not in ("eqc", "conly"):
+        raise ValueError(
+            f"body_form must be 'eqc' or 'conly', got {body_form!r}"
+        )
+    if pad_pow2 is None:
+        pad_pow2 = VMEM_PAD_POW2
+    nbytes = math.prod(shape) * _compute_itemsize(dtype)
+    pad_applied: bool | None = None
+    padded_shape = None
+    if pad_pow2:
+        padded = tuple(_next_pow2(d) for d in shape)
+        pad_bytes = math.prod(padded) * _compute_itemsize(dtype)
+        if padded == shape:
+            pad_applied = None  # already pow2: nothing requested to do
+        elif pad_bytes <= _VMEM_BLOCK_BUDGET_BYTES:
+            pad_applied = True
+            padded_shape = padded
+            nbytes = pad_bytes  # the unroll cap must see the padded size
+        else:
+            pad_applied = False
+    eff_chunk = resolve_step_chunk(n_steps, chunk, nbytes, warn_on_cap)
+    return KernelChoice(
+        op="diffusion.vmem_loop", dispatch="vmem-loop", chunk=eff_chunk,
+        body_form=body_form, pad_requested=bool(pad_pow2),
+        pad_applied=pad_applied, padded_shape=padded_shape,
+    )
 
 
 def _multi_step_kernel(T_ref, Cm_ref, out_ref, *, inv_d2, chunk,
@@ -599,7 +712,8 @@ def resolve_step_chunk(n_steps, chunk, nbytes, warn_on_cap=True):
 
 
 def fused_multi_step(T, Cp, lam, dt, spacing, n_steps, chunk=None, interpret=None,
-                     warn_on_cap=True, body_form=None, pad_pow2=None):
+                     warn_on_cap=True, body_form=None, pad_pow2=None,
+                     config=None):
     """Advance a *single-shard* field `n_steps` barely leaving VMEM.
 
     `body_form` ('eqc'/'conly') and `pad_pow2` are explicit TRACE-TIME
@@ -608,7 +722,9 @@ def fused_multi_step(T, Cp, lam, dt, spacing, n_steps, chunk=None, interpret=Non
     VMEM_PAD_POW2 — the measured hardware defaults. Explicit kwargs, not
     global mutation: a cached/reused jitted advance would silently ignore
     a mutated module global, but a changed kwarg changes the trace
-    (ADVICE r5 #1).
+    (ADVICE r5 #1). `config="auto"` fills any knob left None from the
+    persistent tuning cache instead (plan_vmem_loop → tuning/resolve.py;
+    a cache miss keeps the hand-picked defaults, bitwise-identically).
 
     TPU-only optimization (no reference analog — the GPU version must round-
     trip HBM every step): the kernel runs `chunk` steps per invocation with
@@ -647,38 +763,33 @@ def fused_multi_step(T, Cp, lam, dt, spacing, n_steps, chunk=None, interpret=Non
     # boundary (the reference's interior-only guard, perf.jl:7).
     Cm = _edge_masked_cm(T, Cp, lam, dt)
     orig_shape = T.shape
-    if pad_pow2 is None:
-        pad_pow2 = VMEM_PAD_POW2
-    global _LAST_PAD_APPLIED
-    _LAST_PAD_APPLIED = None  # no pad requested (or nothing to pad)
-    if pad_pow2:
-        padded = tuple(_next_pow2(d) for d in T.shape)
-        pad_bytes = math.prod(padded) * _compute_itemsize(T.dtype)
-        if padded == T.shape:
-            _LAST_PAD_APPLIED = None  # already pow2: nothing requested to do
-        elif pad_bytes <= _VMEM_BLOCK_BUDGET_BYTES:
-            widths = tuple((0, p - d) for p, d in zip(padded, T.shape))
-            T = jnp.pad(T, widths)  # pad values are frozen (Cm pads to 0)
-            Cm = jnp.pad(Cm, widths)
-            nbytes = pad_bytes  # the unroll cap must see the padded size
-            _LAST_PAD_APPLIED = True
-        else:
-            # Requested but skipped: without a loud record, a bench row at
-            # a larger geometry would carry a 'pad256' label for a program
-            # that actually ran unpadded (ADVICE r5 #4).
-            _LAST_PAD_APPLIED = False
-            import warnings
+    choice = plan_vmem_loop(
+        T.shape, T.dtype, n_steps, chunk=chunk, body_form=body_form,
+        pad_pow2=pad_pow2, config=config, warn_on_cap=warn_on_cap,
+    )
+    global _LAST_CHOICE
+    _LAST_CHOICE = choice  # deprecation shim only (last_pad_applied)
+    if choice.pad_applied:
+        widths = tuple(
+            (0, p - d) for p, d in zip(choice.padded_shape, T.shape)
+        )
+        T = jnp.pad(T, widths)  # pad values are frozen (Cm pads to 0)
+        Cm = jnp.pad(Cm, widths)
+    elif choice.pad_applied is False:
+        # Requested but skipped: without a loud record, a bench row at
+        # a larger geometry would carry a 'pad256' label for a program
+        # that actually ran unpadded (ADVICE r5 #4).
+        import warnings
 
-            warnings.warn(
-                f"pad_pow2 requested but SKIPPED: padded field "
-                f"{padded} would be {pad_bytes} bytes, over the VMEM "
-                f"budget ({_VMEM_BLOCK_BUDGET_BYTES}); the program runs "
-                "unpadded — do not label this measurement 'pad'",
-                stacklevel=2,
-            )
-    chunk = resolve_step_chunk(n_steps, chunk, nbytes, warn_on_cap)
-    kernel = functools.partial(_multi_step_kernel, inv_d2=inv_d2, chunk=chunk,
-                               body_form=body_form)
+        warnings.warn(
+            f"pad_pow2 requested but SKIPPED: the padded field would "
+            f"exceed the VMEM budget ({_VMEM_BLOCK_BUDGET_BYTES}); the "
+            "program runs unpadded — do not label this measurement 'pad'",
+            stacklevel=2,
+        )
+    kernel = functools.partial(_multi_step_kernel, inv_d2=inv_d2,
+                               chunk=choice.chunk,
+                               body_form=choice.body_form)
     run_chunk = pl.pallas_call(
         kernel,
         out_shape=_out_struct(T.shape, T),
@@ -693,7 +804,9 @@ def fused_multi_step(T, Cp, lam, dt, spacing, n_steps, chunk=None, interpret=Non
     # trip count floors, so a non-multiple silently rounds DOWN to the
     # nearest chunk — callers with dynamic n must guarantee divisibility
     # (run_vmem_resident does, via gcd).
-    out = lax.fori_loop(0, n_steps // chunk, lambda _, x: run_chunk(x, Cm), T)
+    out = lax.fori_loop(
+        0, n_steps // choice.chunk, lambda _, x: run_chunk(x, Cm), T
+    )
     if out.shape != orig_shape:
         out = out[tuple(slice(0, d) for d in orig_shape)]
     return out
@@ -1075,7 +1188,7 @@ def _masked_step_striped(T, Cm, inv_d2, interpret, tm, g):
     )(T, T, T, Cm)
 
 
-def masked_step(T, Cm, spacing, interpret=None, tm=None):
+def masked_step(T, Cm, spacing, interpret=None, tm=None, config=None):
     """Unsharded per-step update with the mask folded into `Cm`: one Pallas
     program per step.
 
@@ -1089,7 +1202,11 @@ def masked_step(T, Cm, spacing, interpret=None, tm=None):
     the padded-contract striped kernel for everything else.
 
     `tm` overrides the stripe height (tuning knob — the threads=(32,8)
-    analog); must be a multiple of 8.
+    analog); must be a multiple of 8. `config="auto"` consults the tuning
+    cache (op "diffusion.masked_step") for a tm the caller left unset; a
+    cached tm that no longer satisfies this shape's stripe constraints is
+    ignored silently (the automatic height picks instead) — an auto
+    resolve must never be louder than the default path.
     """
     if T.shape != Cm.shape:
         raise ValueError(f"shape mismatch: T {T.shape} vs Cm {Cm.shape}")
@@ -1104,6 +1221,27 @@ def masked_step(T, Cm, spacing, interpret=None, tm=None):
     g = 8
     n0 = T.shape[0]
     tm_explicit = tm is not None
+    if config == "auto" and tm is None:
+        from rocm_mpi_tpu.tuning import resolve as tuning_resolve
+
+        tuned = tuning_resolve.resolve(
+            "diffusion.masked_step", T.shape, T.dtype
+        )
+        if tuned and tuned.get("tm"):
+            cand = int(tuned["tm"])
+            slab_unit = (
+                math.prod(T.shape[1:]) * _compute_itemsize(T.dtype)
+            )
+            if (
+                cand % g == 0
+                and n0 % cand == 0
+                and (cand + 2 * g) * slab_unit <= _PS_SLAB_BUDGET_BYTES
+            ):
+                tm = cand
+    elif config not in (None, "default", "auto"):
+        raise ValueError(
+            f"config must be None, 'default' or 'auto', got {config!r}"
+        )
     if tm is None:
         row_bytes = T.dtype.itemsize
         for n in T.shape[1:]:

@@ -241,7 +241,7 @@ def swe_multi_step_masked(h, us, Mus, cH, cg, n_steps: int, interpret=None):
 
 def swe_multi_step(
     h, us, Mus, dt, spacing, H, g, n_steps, chunk=None, interpret=None,
-    warn_on_cap=True,
+    warn_on_cap=True, config=None,
 ):
     """Advance a *single-shard* SWE state `n_steps` barely leaving VMEM —
     the SWE edition of fused_multi_step / wave_multi_step (same chunk
@@ -250,13 +250,30 @@ def swe_multi_step(
     `chunk | n_steps` themselves, as run_vmem_resident does via gcd).
     `Mus` must already hold the wall faces (models.swe.face_masks) — on
     the global array the roll wraparound then reads exactly those zeroed
-    opposite wall faces, keeping the closed-basin physics exact."""
+    opposite wall faces, keeping the closed-basin physics exact.
+    `config="auto"` fills an unset `chunk` from the tuning cache (op
+    "swe.vmem_loop", static n_steps only — gcd'd, same policy as the
+    wave/diffusion editions); a miss keeps the defaults bitwise."""
     from rocm_mpi_tpu.ops.pallas_kernels import resolve_step_chunk
 
     if interpret is None:
         interpret = _interpret_default()
     if not _supports_compiled(h.dtype) and not interpret:
         raise TypeError(f"Mosaic does not support {h.dtype}")
+    if config == "auto" and chunk is None and isinstance(n_steps, int):
+        from rocm_mpi_tpu.tuning import resolve as tuning_resolve
+
+        from rocm_mpi_tpu.ops.pallas_kernels import adoptable_vmem_chunk
+
+        tuned = tuning_resolve.resolve("swe.vmem_loop", h.shape, h.dtype)
+        if tuned and adoptable_vmem_chunk(tuned.get("chunk")):
+            import math
+
+            chunk = math.gcd(n_steps, tuned["chunk"]) or None
+    elif config not in (None, "default", "auto"):
+        raise ValueError(
+            f"config must be None, 'default' or 'auto', got {config!r}"
+        )
     nbytes = (3 * h.ndim + 2) * _compute_nbytes(h)
     if nbytes > _VMEM_BLOCK_BUDGET_BYTES:
         raise ValueError(

@@ -267,7 +267,7 @@ def wave_multi_step_masked(U, Uprev, M, Cw, spacing, n_steps: int,
 
 def wave_multi_step(
     U, Uprev, C2, dt, spacing, n_steps, chunk=None, interpret=None,
-    warn_on_cap=True,
+    warn_on_cap=True, config=None,
 ):
     """Advance a *single-shard* leapfrog state `n_steps` barely leaving
     VMEM — the wave edition of ops.pallas_kernels.fused_multi_step (same
@@ -279,7 +279,9 @@ def wave_multi_step(
     (ADVICE r3). Callers with dynamic step counts must guarantee
     divisibility themselves, as run_vmem_resident does via gcd. The kernel
     holds 4 field-sized arrays (U, U⁻, M, Cw), so admission is gated on
-    half the diffusion kernel's VMEM budget.
+    half the diffusion kernel's VMEM budget. `config="auto"` fills an
+    unset `chunk` from the tuning cache (op "wave.vmem_loop"); a miss
+    keeps the default chunk policy, bitwise-identically.
     """
     from rocm_mpi_tpu.ops.pallas_kernels import resolve_step_chunk
 
@@ -287,6 +289,24 @@ def wave_multi_step(
         interpret = _interpret_default()
     if not _supports_compiled(U.dtype) and not interpret:
         raise TypeError(f"Mosaic does not support {U.dtype}")
+    if config == "auto" and chunk is None and isinstance(n_steps, int):
+        # Static step counts only: a tuned chunk is a PREFERENCE the
+        # divisibility contract still governs (gcd, mirroring the
+        # default policy); with a traced n the caller's own guarantee
+        # covers only the default chunk, so auto stays hands-off.
+        from rocm_mpi_tpu.tuning import resolve as tuning_resolve
+
+        from rocm_mpi_tpu.ops.pallas_kernels import adoptable_vmem_chunk
+
+        tuned = tuning_resolve.resolve("wave.vmem_loop", U.shape, U.dtype)
+        if tuned and adoptable_vmem_chunk(tuned.get("chunk")):
+            import math
+
+            chunk = math.gcd(n_steps, tuned["chunk"]) or None
+    elif config not in (None, "default", "auto"):
+        raise ValueError(
+            f"config must be None, 'default' or 'auto', got {config!r}"
+        )
     nbytes = _compute_nbytes(U)
     if nbytes > _VMEM_BLOCK_BUDGET_BYTES // 2:
         raise ValueError(

@@ -107,6 +107,45 @@ def padded_update_coefficient(Cp_padded, grid: GlobalGrid, width: int,
     return jnp.where(mask, jnp.zeros_like(Cp_padded), (dt * lam) / safe)
 
 
+def resolve_deep_k(grid: GlobalGrid, dtype, config: str | None) -> int | None:
+    """The tuned deep-halo sweep depth for this shard/topology, or None
+    (= use the model's default_deep_depth policy). The deep edition of
+    the `config="auto"` seam: consults the tuning cache
+    (tuning/resolve.py, op "diffusion.deep", keyed by the LOCAL shard
+    shape and mesh dims — the winner shifts with both) and re-validates
+    the cached depth against this grid's shard extents, because a cache
+    entry tuned on one mesh can outlive a reshard that shrank the shards
+    (`_validate_depth`'s own rule, applied silently: a stale depth falls
+    back to the default policy rather than crashing an auto run)."""
+    if config in (None, "default"):
+        return None
+    if config != "auto":
+        raise ValueError(
+            f"config must be None, 'default' or 'auto', got {config!r}"
+        )
+    import jax
+
+    if jax.process_count() > 1:
+        # Multi-controller: each process resolves from its own cache
+        # file, and ranks disagreeing on k build schedules with
+        # MISMATCHED collectives (one exchanges every 8 steps, another
+        # every 32 — a distributed hang, not an error). The default
+        # depth policy is deterministic on every rank; auto stays
+        # hands-off until a broadcast-consistent resolve exists.
+        return None
+    from rocm_mpi_tpu.tuning import resolve as tuning_resolve
+
+    tuned = tuning_resolve.resolve(
+        "diffusion.deep", grid.local_shape, dtype, topology=grid.dims
+    )
+    if not tuned or not tuned.get("k"):
+        return None
+    k = int(tuned["k"])
+    if k < 1 or any(k > ln for ln in grid.local_shape):
+        return None
+    return k
+
+
 def rebuild_for_mesh(sched: DeepSchedule, new_grid: GlobalGrid,
                      dims=None, devices=None) -> DeepSchedule:
     """Re-derive `sched` for a new decomposition of the same global

@@ -1,0 +1,38 @@
+"""Kernel autotuning: a persistent, traffic-gated tuning cache
+(docs/PERF.md "Autotuning"; ROADMAP item 2).
+
+Every kernel configuration the stack used to hand-pick — body_form,
+pad_pow2, the VMEM chunk, the masked-step stripe height tm, the
+deep-halo depth k, the scan chunk q — is tunable here:
+
+* `tuning.search` / `python -m rocm_mpi_tpu.tuning search` measures the
+  admission-filtered space per key and persists traffic-gated winners;
+* `tuning.resolve.resolve` is the ONE trace-time consumer every
+  `config="auto"` entry point funnels through (miss = hand-picked
+  defaults; resolved values travel as explicit trace-time kwargs);
+* `tuning.cache` owns the versioned, atomically-written, fingerprinted
+  on-disk document; `tuning.gate` rejects configs over the A_eff byte
+  budget no matter how fast they timed.
+"""
+
+from rocm_mpi_tpu.tuning.keys import (  # noqa: F401
+    CACHE_KIND,
+    CACHE_VERSION,
+    KNOWN_OPS,
+    TuningKey,
+    fingerprint,
+    key_str,
+    parse_key,
+    tuning_key,
+)
+
+__all__ = [
+    "CACHE_KIND",
+    "CACHE_VERSION",
+    "KNOWN_OPS",
+    "TuningKey",
+    "fingerprint",
+    "key_str",
+    "parse_key",
+    "tuning_key",
+]

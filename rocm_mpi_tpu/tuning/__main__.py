@@ -1,0 +1,206 @@
+"""The autotuner CLI (docs/PERF.md "Autotuning").
+
+    python -m rocm_mpi_tpu.tuning search   [--ops A,B] [--shape N[,M…]]
+                                           [--dtype f32] [--repeats R]
+                                           [--cache PATH] [--force]
+    python -m rocm_mpi_tpu.tuning show     [--cache PATH]
+    python -m rocm_mpi_tpu.tuning validate PATH [PATH…]
+
+* `search` — offline tuning for the default op set (the diffusion and
+  wave VMEM-resident loops) or --ops, at the per-shard --shape. Keys
+  whose fingerprint-valid entry already exists are pure cache hits: no
+  candidate runs, no compile — the end-of-run line reports
+  `compiles.steady_state=0` on a warm cache (steady state is marked
+  after the hit scan, so any compile a warm run still pays is a gated
+  recompile). Exit 0 on success (including all-hit), 1 when a key ends
+  all-rejected (every candidate over the traffic budget), 2 on usage.
+* `show` — the cache's entries as a table, stale fingerprints marked.
+* `validate` — strict schema + traffic-gate check of committed cache
+  files (scripts/lint.sh runs this): exit 1 on schema drift or any
+  entry whose config models over its A_eff budget, 2 on unreadable
+  paths. Unlike the runtime's tolerant read, a torn committed file
+  FAILS here.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from rocm_mpi_tpu.tuning import cache as _cache
+from rocm_mpi_tpu.tuning import gate as _gate
+from rocm_mpi_tpu.tuning.keys import parse_dims, parse_key
+
+
+def _log(*parts) -> None:
+    print(*parts, file=sys.stderr)
+
+
+DEFAULT_SEARCH_OPS = ("diffusion.vmem_loop", "wave.vmem_loop")
+
+
+def cmd_search(args) -> int:
+    from rocm_mpi_tpu.telemetry import compiles
+
+    from rocm_mpi_tpu.tuning import search as _search
+
+    ops = (
+        tuple(o for o in args.ops.split(",") if o)
+        if args.ops else DEFAULT_SEARCH_OPS
+    )
+    shape = parse_dims(args.shape)
+    path = args.cache or _cache.default_cache_path()
+    compiles.install()
+
+    # Hit scan first: a fully warm cache must do NO work — the line
+    # every compile after this mark crosses is the steady-state gauge
+    # the acceptance drill pins at 0.
+    results = []
+    pending = []
+    for op in ops:
+        r = _search.search_op(op, shape, args.dtype, cache_path=path,
+                              force=args.force, log=_log)
+        if r["status"] == "hit":
+            results.append(r)
+        else:
+            pending.append((op, r))
+    if not pending:
+        compiles.mark_steady()
+    statuses = [r["status"] for r in results] + [
+        r["status"] for _, r in pending
+    ]
+    hits = statuses.count("hit")
+    tuned = statuses.count("tuned")
+    bad = statuses.count("all-rejected")
+    _log(
+        f"tuning search: {hits} hit(s), {tuned} tuned, {bad} rejected-out, "
+        f"{statuses.count('empty')} empty — cache {path}; "
+        f"compiles.steady_state={compiles.steady_state()}"
+    )
+    from rocm_mpi_tpu.tuning import resolve as _resolve
+
+    _resolve.emit_gauges()
+    return 1 if bad else 0
+
+
+def cmd_show(args) -> int:
+    path = args.cache or _cache.default_cache_path()
+    doc = _cache.load(path)
+    entries = doc.get("entries", {})
+    if not entries:
+        print(f"tuning cache {path}: empty")
+        return 0
+    try:
+        from rocm_mpi_tpu.tuning.keys import fingerprint
+
+        live = fingerprint()
+    except Exception:  # noqa: BLE001 — show must work without a backend
+        live = None
+    print(f"tuning cache {path}: {len(entries)} entr"
+          f"{'y' if len(entries) == 1 else 'ies'}")
+    for raw_key, entry in sorted(entries.items()):
+        fp = entry.get("fingerprint", {})
+        stale = ""
+        if live is not None and (
+            fp.get("jax") != live["jax"]
+        ):
+            stale = "  [STALE: jax " + str(fp.get("jax")) + "]"
+        print(
+            f"  {raw_key}\n"
+            f"    config={json.dumps(entry.get('config'), sort_keys=True)} "
+            f"median_us={entry.get('median_us')} "
+            f"gate={entry.get('gate_ratio')}x{stale}"
+        )
+    return 0
+
+
+def cmd_validate(args) -> int:
+    if not args.paths:
+        _log("tuning validate: no paths given")
+        return 2
+    problems = []
+    for path in args.paths:
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except OSError as e:
+            _log(f"tuning validate: cannot read {path}: {e}")
+            return 2
+        except ValueError as e:
+            problems.append(f"{path}: not valid JSON ({e})")
+            continue
+        problems.extend(_cache.validate_doc(doc, path))
+        entries = doc.get("entries")
+        if not isinstance(entries, dict):
+            continue
+        for raw_key, entry in sorted(entries.items()):
+            try:
+                key = parse_key(raw_key)
+            except ValueError:
+                continue  # already reported by validate_doc
+            if not isinstance(entry, dict) or not isinstance(
+                entry.get("config"), dict
+            ):
+                continue
+            g = _gate.validate_entry(key, entry)
+            if not g.ok:
+                problems.append(f"{path}: entry {raw_key!r}: {g.reason}")
+        if not problems:
+            _log(f"tuning validate: {path} ok "
+                 f"({len(entries)} entr"
+                 f"{'y' if len(entries) == 1 else 'ies'})")
+    for p in problems:
+        _log(f"tuning validate: PROBLEM: {p}")
+    return 1 if problems else 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m rocm_mpi_tpu.tuning",
+        description=__doc__.splitlines()[0],
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ps = sub.add_parser("search", help="measure + gate + persist winners")
+    ps.add_argument("--ops", default=None,
+                    help="comma-separated tunable ops (default: "
+                    + ",".join(DEFAULT_SEARCH_OPS) + ")")
+    ps.add_argument("--shape", default="32x32",
+                    help="per-shard field shape, e.g. 252x252 "
+                    "(default %(default)s — CPU-feasible)")
+    ps.add_argument("--dtype", default="f32",
+                    choices=["f32", "f64", "bf16"])
+    ps.add_argument("--repeats", type=int, default=3,
+                    help="timing repeats per candidate (median wins)")
+    ps.add_argument("--cache", default=None, metavar="PATH")
+    ps.add_argument("--force", action="store_true",
+                    help="re-measure keys that already have valid entries")
+
+    pw = sub.add_parser("show", help="print the cache's entries")
+    pw.add_argument("--cache", default=None, metavar="PATH")
+
+    pv = sub.add_parser("validate",
+                        help="strict schema + traffic-gate check")
+    pv.add_argument("paths", nargs="*", metavar="PATH")
+
+    args = p.parse_args(argv)
+    if args.cmd == "search":
+        # argparse-level shape errors are usage errors (exit 2), and the
+        # repeats knob must be sane before any measurement starts.
+        try:
+            parse_dims(args.shape)
+        except ValueError as e:
+            _log(f"tuning search: {e}")
+            return 2
+        if args.repeats < 1:
+            _log("tuning search: --repeats must be >= 1")
+            return 2
+        return cmd_search(args)
+    if args.cmd == "show":
+        return cmd_show(args)
+    return cmd_validate(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,190 @@
+"""The persistent tuning cache: versioned JSON, atomically written.
+
+One document per cache file:
+
+    {"v": 1,
+     "kind": "rmt-tuning-cache",
+     "entries": {
+       "diffusion.vmem_loop|252x252|f32|1x1|tpu": {
+         "config":      {"body_form": "conly", "pad_pow2": true,
+                         "chunk": 256},
+         "median_us":   0.39,        # per-step, warmup excluded
+         "compile_s":   12.1,        # attributed separately, never timed
+         "gate_ratio":  1.03,        # modeled/ideal A_eff at admission
+         "fingerprint": {"jax": "0.4.37", "backend": "tpu"}
+       }, …}}
+
+Contracts (tests/test_tuning.py pins each):
+
+* **Atomic writes** — tmp + os.replace, so a killed search can never
+  leave a torn file that bricks every later trace-time lookup.
+* **Torn/alien files read as empty** — a cache is an accelerator, not a
+  dependency: any parse problem degrades to "miss everywhere" with one
+  warning, never an exception out of a trace.
+* **Stale fingerprints are ignored, never deleted** — an entry measured
+  under a different jax (or recorded for a different backend than its
+  key says) is a miss; the bytes stay on disk so a rollback to the old
+  pin finds its winners again.
+
+stdlib-only on purpose: the validate CLI and lint schema gate run
+without jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import warnings
+
+from rocm_mpi_tpu.tuning.keys import (
+    CACHE_KIND,
+    CACHE_VERSION,
+    TuningKey,
+    key_str,
+    parse_key,
+)
+
+ENV_CACHE_PATH = "RMT_TUNING_CACHE"
+
+# Cache-entry value fields and their types (schema closed on purpose:
+# the validate gate must reject drifted writers loudly).
+_ENTRY_FIELDS = {
+    "config": dict,
+    "median_us": (int, float),
+    "compile_s": (int, float),
+    "gate_ratio": (int, float),
+    "fingerprint": dict,
+}
+
+
+def default_cache_path() -> str:
+    """RMT_TUNING_CACHE, else <repo>/output/tuning/cache.json — next to
+    the other runtime artifacts the lint gate schema-checks."""
+    env = os.environ.get(ENV_CACHE_PATH)
+    if env:
+        return env
+    root = pathlib.Path(__file__).resolve().parents[2]
+    return str(root / "output" / "tuning" / "cache.json")
+
+
+def empty_doc() -> dict:
+    return {"v": CACHE_VERSION, "kind": CACHE_KIND, "entries": {}}
+
+
+def load(path=None) -> dict:
+    """Read a cache document, degrading every failure mode to an empty
+    cache: missing file (the normal cold start), torn/garbage JSON, or a
+    well-formed file of the wrong kind/version. Never raises."""
+    path = path or default_cache_path()
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except FileNotFoundError:
+        return empty_doc()
+    except (OSError, ValueError) as e:
+        warnings.warn(
+            f"tuning cache {path} unreadable ({e}); treating as empty — "
+            "every lookup is a miss until it is rewritten",
+            stacklevel=2,
+        )
+        return empty_doc()
+    if (
+        not isinstance(doc, dict)
+        or doc.get("kind") != CACHE_KIND
+        or doc.get("v") != CACHE_VERSION
+        or not isinstance(doc.get("entries"), dict)
+    ):
+        warnings.warn(
+            f"tuning cache {path} is not a v{CACHE_VERSION} {CACHE_KIND} "
+            "document; treating as empty",
+            stacklevel=2,
+        )
+        return empty_doc()
+    return doc
+
+
+def lookup(doc: dict, key: TuningKey, fingerprint: dict) -> dict | None:
+    """The entry's config for `key`, or None — on a missing key, a
+    malformed entry, or a stale fingerprint (jax/backend drift). Stale
+    entries are left in place by design."""
+    entry = doc.get("entries", {}).get(key_str(key))
+    if not isinstance(entry, dict):
+        return None
+    config = entry.get("config")
+    fp = entry.get("fingerprint")
+    if not isinstance(config, dict) or not isinstance(fp, dict):
+        return None
+    if fp.get("jax") != fingerprint.get("jax"):
+        return None
+    if fp.get("backend") != fingerprint.get("backend"):
+        return None
+    return dict(config)
+
+
+def store(path, key: TuningKey, entry: dict) -> None:
+    """Insert/replace one entry and rewrite the file atomically
+    (read-modify-write; sorted keys and stable formatting so identical
+    content is byte-identical — the determinism the acceptance drill
+    diffs)."""
+    path = str(path or default_cache_path())
+    doc = load(path)
+    doc["entries"][key_str(key)] = entry
+    write_doc(path, doc)
+
+
+def write_doc(path, doc: dict) -> None:
+    path = str(path)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
+def validate_doc(doc, path: str = "<doc>") -> list[str]:
+    """Schema problems of one cache document (empty list = valid). The
+    shared checker of the validate CLI verb and scripts/lint.sh — a
+    drifted writer must fail the gate, not silently miss forever."""
+    problems: list[str] = []
+    if not isinstance(doc, dict):
+        return [f"{path}: not a JSON object"]
+    if doc.get("kind") != CACHE_KIND:
+        problems.append(f"{path}: kind != {CACHE_KIND!r}")
+    if doc.get("v") != CACHE_VERSION:
+        problems.append(f"{path}: v != {CACHE_VERSION}")
+    entries = doc.get("entries")
+    if not isinstance(entries, dict):
+        return problems + [f"{path}: entries is not an object"]
+    for raw_key, entry in sorted(entries.items()):
+        where = f"{path}: entry {raw_key!r}"
+        try:
+            parse_key(raw_key)
+        except ValueError as e:
+            problems.append(f"{where}: {e}")
+            continue
+        if not isinstance(entry, dict):
+            problems.append(f"{where}: not an object")
+            continue
+        for field, types in _ENTRY_FIELDS.items():
+            if field not in entry:
+                problems.append(f"{where}: missing {field!r}")
+            elif not isinstance(entry[field], types):
+                problems.append(f"{where}: {field!r} has wrong type")
+        fp = entry.get("fingerprint")
+        if isinstance(fp, dict) and not (
+            isinstance(fp.get("jax"), str)
+            and isinstance(fp.get("backend"), str)
+        ):
+            problems.append(f"{where}: fingerprint needs jax+backend strings")
+        cfg = entry.get("config")
+        if isinstance(cfg, dict):
+            for ck, cv in cfg.items():
+                if not isinstance(ck, str) or not isinstance(
+                    cv, (str, int, float, bool, type(None))
+                ):
+                    problems.append(
+                        f"{where}: config field {ck!r} is not a scalar"
+                    )
+    return problems

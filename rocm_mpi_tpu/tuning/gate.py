@@ -1,0 +1,142 @@
+"""The tuning traffic gate: a "fast" config that blows the A_eff byte
+budget is rejected, no matter what it measured.
+
+Wall-clock on a loaded box can crown a winner whose speed is an
+artifact (cache luck, a straggling rival) while its compiled program
+moves more HBM bytes per step than the schedule needs — exactly the
+drift class the perf traffic gate (docs/PERF.md, perf/traffic.py)
+polices on the distributed drivers. The tuned knobs here change traffic
+*analytically* — padding inflates every pass by the padded/unpadded
+ratio, a short stripe re-reads its ghost rows more often per output
+row, a deep sweep trades exchange count for padded-block passes — so
+the gate models each config's bytes-per-step against the (2+1)
+A_eff traversal ideal in closed form and holds the ratio to a per-family
+budget. Same ideals as perf/traffic.py (ideal_deep_sweep_bytes is
+imported, not re-derived); no compilation, no accelerator, so the
+validate CLI can run it over a committed cache from the key alone.
+
+Budgets (measured/ideal ceilings per family):
+
+* vmem_loop 1.5 — pad_pow2 may inflate passes by (prod padded)/(prod
+  shape); 252²→256² is 1.03×, fine; a doctored 140²→256² (3.3×) fails.
+* masked_step 1.5 — ratio (2 + (tm+2g)/tm)/3: the slab re-read cost of
+  short stripes (tm=8 audits 1.67× and is rejected; tm>=16 passes).
+* deep 6.0 — per-sweep analytic vs k·(2+1)·N; deep sweeps legitimately
+  pay padded-block passes (the perf gate budgets deep at 4.4 on its CPU
+  lowering for the same reason).
+* scan 1.05 — the scan chunk is traffic-neutral by construction.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+from rocm_mpi_tpu.tuning import space as _space
+from rocm_mpi_tpu.tuning.keys import TuningKey, parse_dims
+
+BUDGETS = {
+    "vmem_loop": 1.5,
+    "masked_step": 1.5,
+    "deep": 6.0,
+    "scan": 1.05,
+}
+
+
+class GateResult(NamedTuple):
+    ok: bool
+    ratio: float
+    measured_bytes: int  # modeled bytes per step (per shard)
+    ideal_bytes: int  # (2+1)-traversal bound per step
+    budget: float
+    reason: str  # "" when ok
+
+
+def _prod(xs) -> int:
+    out = 1
+    for x in xs:
+        out *= int(x)
+    return out
+
+
+def validate_config(op: str, shape, dtype: str, config: dict,
+                    budget: float | None = None) -> GateResult:
+    """Model one config's per-step HBM traffic against the A_eff ideal
+    and gate the ratio. `shape` is the per-shard field shape; `dtype`
+    the storage dtype name from the tuning key."""
+    family = op.split(".", 1)[1] if "." in op else op
+    if budget is None:
+        budget = BUDGETS[family]
+    shape = tuple(int(n) for n in shape)
+    itemsize = _space.compute_itemsize(dtype)
+    n = _prod(shape) * itemsize
+    ideal = 3 * n  # the (2+1)-traversal bound per step
+
+    if family == "vmem_loop":
+        # Knob validity is part of the gate's contract: the runtime
+        # sanitizer (tuning/resolve.py) silently DROPS these, so the
+        # validate CLI must be the loud half — a committed entry whose
+        # knobs would never steer anything is a broken entry.
+        c = config.get("chunk")
+        if c is not None and not (
+            isinstance(c, int) and not isinstance(c, bool)
+            and c >= 4 and (c & (c - 1)) == 0
+        ):
+            return GateResult(False, float("inf"), 0, ideal, budget,
+                              f"chunk={c!r} is not a power of two >= 4 "
+                              "(below 4 the kernel switches body form)")
+        bf = config.get("body_form")
+        if bf is not None and bf not in ("eqc", "conly"):
+            return GateResult(False, float("inf"), 0, ideal, budget,
+                              f"body_form={bf!r} is not eqc/conly")
+        if not isinstance(config.get("pad_pow2", False), bool):
+            return GateResult(False, float("inf"), 0, ideal, budget,
+                              "pad_pow2 is not a bool")
+        # Per chunk launch: read state (+coefficients), write state —
+        # each pass inflated to the padded layout when pad_pow2 is on.
+        if config.get("pad_pow2"):
+            np_ = _prod(_space.next_pow2_shape(shape)) * itemsize
+        else:
+            np_ = n
+        measured = 3 * np_
+    elif family == "masked_step":
+        g = 8
+        tm = int(config.get("tm", 0) or 0)
+        if tm <= 0 or tm % g:
+            return GateResult(False, float("inf"), 0, ideal, budget,
+                              f"tm={config.get('tm')!r} is not a positive "
+                              f"multiple of {g}")
+        # Per step: slab read ((tm+2g)/tm of the field), core Cm read,
+        # core write.
+        measured = int(n * (tm + 2 * g) / tm) + 2 * n
+    elif family == "deep":
+        from rocm_mpi_tpu.perf.traffic import ideal_deep_sweep_bytes
+
+        k = int(config.get("k", 0) or 0)
+        if k < 1 or k > min(shape):
+            return GateResult(False, float("inf"), 0, ideal, budget,
+                              f"k={config.get('k')!r} outside [1, "
+                              f"{min(shape)}]")
+        measured = ideal_deep_sweep_bytes(shape, itemsize, k) // k
+        ideal = 3 * n
+    elif family == "scan":
+        measured = 3 * n
+    else:
+        return GateResult(False, float("inf"), 0, ideal, budget,
+                          f"no traffic model for op {op!r}")
+
+    ratio = measured / ideal
+    ok = ratio <= budget
+    reason = "" if ok else (
+        f"{op} config {config} models {ratio:.2f}x the A_eff ideal "
+        f"(budget {budget:.2f}) — fast-but-wasteful, rejected"
+    )
+    return GateResult(ok, ratio, int(measured), int(ideal), budget, reason)
+
+
+def validate_entry(key: TuningKey, entry: dict) -> GateResult:
+    """Gate one CACHE entry from its key alone (the validate CLI / lint
+    path: no side channel beyond the file)."""
+    return validate_config(
+        key.op, parse_dims(key.shape_class), key.dtype,
+        entry.get("config", {}),
+    )

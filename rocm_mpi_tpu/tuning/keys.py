@@ -1,0 +1,162 @@
+"""The tuning key — what a tuned kernel configuration is keyed by.
+
+A winning kernel config is only transferable between runs that lower the
+same program to the same hardware: the stencil-tuning literature the
+autotuner is grounded in (arXiv:2406.08923 across AMD/Nvidia,
+arXiv:2404.04441 across programming models) shows the winner shifts with
+shape, dtype, topology and backend — so all four are part of the key,
+alongside the op itself and (as a cache-entry fingerprint, not a key
+field) the jax version the measurement was taken under.
+
+    TuningKey(op, shape_class, dtype, topology, backend)
+
+* `op`         — the tunable entry point, "workload.family" spelled
+                 ("diffusion.vmem_loop", "wave.vmem_loop",
+                 "diffusion.masked_step", "diffusion.deep",
+                 "diffusion.scan", …).
+* `shape_class`— the per-shard field shape, "252x252" spelled. Exact
+                 shapes, not buckets: the admission rules (VMEM budgets,
+                 stripe divisibility) are shape-exact, so a config legal
+                 at one shape can crash at a neighboring one.
+* `dtype`      — the STORAGE dtype short name ("f32"/"bf16"/"f64"); the
+                 kernels budget at compute width internally, but storage
+                 width changes admission and traffic.
+* `topology`   — the mesh dims, "2x1" spelled ("1x1" = unsharded).
+* `backend`    — jax.default_backend() ("tpu"/"cpu"): a CPU-searched
+                 cache must never steer a chip run and vice versa.
+
+`key_str` is the canonical on-disk spelling (the cache's entry key):
+"op|shape|dtype|topology|backend" — parseable back by `parse_key`, so
+the validate CLI can re-derive admission and traffic facts from the key
+alone, with no side channel.
+
+stdlib-only: the read side (CLI validate, lint schema gate) must not
+need jax.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+CACHE_VERSION = 1
+CACHE_KIND = "rmt-tuning-cache"
+
+# The tunable ops the space/gate/search modules know. Order is the
+# canonical search order (determinism: the CLI iterates this, never a
+# set).
+KNOWN_OPS = (
+    "diffusion.vmem_loop",
+    "wave.vmem_loop",
+    "swe.vmem_loop",
+    "diffusion.masked_step",
+    "diffusion.deep",
+    "diffusion.scan",
+    "wave.scan",
+    "swe.scan",
+)
+
+_DTYPE_NAMES = {
+    "float32": "f32", "float64": "f64", "bfloat16": "bf16",
+    "f32": "f32", "f64": "f64", "bf16": "bf16",
+}
+
+
+class TuningKey(NamedTuple):
+    op: str
+    shape_class: str
+    dtype: str
+    topology: str
+    backend: str
+
+
+def dtype_name(dtype) -> str:
+    """Canonical short dtype spelling from a dtype name, a numpy/jax
+    dtype instance, or a scalar type class (config.jax_dtype is
+    `jnp.float32` the CLASS — np.dtype normalizes all of them)."""
+    if isinstance(dtype, str):
+        name = dtype
+    else:
+        import numpy as np
+
+        name = np.dtype(dtype).name
+    try:
+        return _DTYPE_NAMES[name]
+    except KeyError:
+        raise ValueError(f"unsupported tuning dtype {name!r}") from None
+
+
+def shape_class(shape) -> str:
+    return "x".join(str(int(n)) for n in shape)
+
+
+def topology_class(dims) -> str:
+    if isinstance(dims, str):
+        return dims
+    return "x".join(str(int(d)) for d in dims)
+
+
+def parse_dims(cls: str) -> tuple[int, ...]:
+    """Inverse of shape_class/topology_class ("252x252" -> (252, 252))."""
+    try:
+        dims = tuple(int(p) for p in cls.split("x"))
+    except ValueError:
+        raise ValueError(f"malformed shape/topology class {cls!r}") from None
+    if not dims or any(d < 1 for d in dims):
+        raise ValueError(f"malformed shape/topology class {cls!r}")
+    return dims
+
+
+def tuning_key(op: str, shape, dtype, topology=None,
+               backend: str | None = None) -> TuningKey:
+    """Build the key for one tunable call site. `topology=None` means
+    unsharded — (1,)*ndim, matching the shape's rank so 2D and 3D
+    single-shard keys cannot collide. `backend=None` reads the live jax
+    backend (the one place this module touches jax — read-side callers
+    always pass it)."""
+    if op not in KNOWN_OPS:
+        raise ValueError(f"unknown tunable op {op!r}; known: {KNOWN_OPS}")
+    shape = tuple(int(n) for n in shape)
+    if topology is None:
+        topology = (1,) * len(shape)
+    if backend is None:
+        import jax
+
+        backend = jax.default_backend()
+    return TuningKey(
+        op=op,
+        shape_class=shape_class(shape),
+        dtype=dtype_name(dtype),
+        topology=topology_class(topology),
+        backend=str(backend),
+    )
+
+
+def key_str(key: TuningKey) -> str:
+    return "|".join(key)
+
+
+def parse_key(s: str) -> TuningKey:
+    """Parse the on-disk spelling; raises ValueError on malformation
+    (the schema gate's contract — a drifted key must fail loudly)."""
+    parts = s.split("|")
+    if len(parts) != 5 or not all(parts):
+        raise ValueError(f"malformed tuning key {s!r} (want 5 '|' fields)")
+    key = TuningKey(*parts)
+    if key.op not in KNOWN_OPS:
+        raise ValueError(f"unknown tunable op in key {s!r}")
+    parse_dims(key.shape_class)
+    parse_dims(key.topology)
+    return key
+
+
+def fingerprint(backend: str | None = None) -> dict:
+    """The cache-entry fingerprint: which jax lowered the measured
+    programs. Backend rides along explicitly (redundant with the key,
+    but an entry must be self-describing for the stale check)."""
+    import jax
+
+    return {
+        "jax": jax.__version__,
+        "backend": str(backend if backend is not None
+                       else jax.default_backend()),
+    }

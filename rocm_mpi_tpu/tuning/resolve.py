@@ -1,0 +1,144 @@
+"""The one trace-time consumer of the tuning cache.
+
+Every `config="auto"` path in the stack funnels through `resolve()`:
+build the tuning key for the call site, look it up in the process's
+cache snapshot, and return the winning config dict — or None, meaning
+"use the hand-picked defaults" (the miss contract: auto is never worse
+than the defaults, only sometimes better). The resolved values are then
+passed onward as the explicit trace-time kwargs PR 1 established; this
+module never mutates kernel-module state (GL02's whole point), and its
+own bookkeeping is a read-once document snapshot plus hit/miss
+counters, both held in one module dict (no `global` writes — resolve()
+runs inside jit traces).
+
+The cache document is read ONCE per process (first resolve) and cached:
+a trace-time file read per program is tolerable, one per *call* is not,
+and a mid-run cache rewrite changing live programs silently would be
+exactly the stale-global hazard GL02 exists for. Tests and the search
+CLI use `refresh()` / `configure(path=…)` to swap snapshots explicitly.
+"""
+
+from __future__ import annotations
+
+import json
+
+from rocm_mpi_tpu.tuning import cache as _cache
+from rocm_mpi_tpu.tuning import keys as _keys
+
+# The one mutable cell: doc snapshot + explicit path override + counters.
+# Dict item writes need no `global` statement and resolve() may legally
+# run inside a traced body (it only reads the snapshot).
+_STATE: dict = {
+    "doc": None,  # loaded cache document (None = not loaded yet)
+    "path": None,  # explicit override (configure/tests); None = default
+    "hits": 0,
+    "misses": 0,
+}
+
+
+def configure(path) -> None:
+    """Point this process at an explicit cache file (tests, the search
+    CLI's --cache); drops the current snapshot."""
+    _STATE["path"] = str(path) if path is not None else None
+    _STATE["doc"] = None
+
+
+def refresh() -> None:
+    """Drop the snapshot; the next resolve() re-reads the file."""
+    _STATE["doc"] = None
+
+
+def cache_path() -> str:
+    return _STATE["path"] or _cache.default_cache_path()
+
+
+def _doc() -> dict:
+    doc = _STATE["doc"]
+    if doc is None:
+        doc = _cache.load(cache_path())
+        _STATE["doc"] = doc
+    return doc
+
+
+# Per-knob validity at the consumption seam: a cache entry is UNTRUSTED
+# input (hand-edited, written by a future version, doctored) and the
+# miss contract says auto is never worse than the defaults — so a field
+# that would crash a kernel (chunk=-8, body_form="bogus") is DROPPED
+# here, not propagated to a trace-time ValueError. These are the
+# crash-safety bounds only; op-family rules with numerics consequences
+# (e.g. vmem chunks must stay >= 4 to keep one kernel body form) live
+# with the consumers and the traffic gate.
+_FIELD_VALID = {
+    "chunk": lambda v: isinstance(v, int) and not isinstance(v, bool)
+    and v >= 1,
+    "body_form": lambda v: v in ("eqc", "conly"),
+    "pad_pow2": lambda v: isinstance(v, bool),
+    "tm": lambda v: isinstance(v, int) and not isinstance(v, bool)
+    and v >= 8 and v % 8 == 0,
+    "k": lambda v: isinstance(v, int) and not isinstance(v, bool)
+    and v >= 1,
+}
+
+
+def _sanitize(config: dict) -> dict:
+    """Drop unknown/invalid fields from a looked-up config (an all-
+    invalid entry degrades to {} — falsy, i.e. a miss to every consumer)."""
+    return {
+        k: v for k, v in config.items()
+        if k in _FIELD_VALID and _FIELD_VALID[k](v)
+    }
+
+
+def resolve(op: str, shape, dtype, topology=None,
+            backend: str | None = None) -> dict | None:
+    """The chokepoint: winning config for this call site, or None on any
+    miss (unknown key, stale jax/backend fingerprint, unreadable cache).
+    Looked-up configs are sanitized field-by-field (_FIELD_VALID) so a
+    malformed entry can never crash an auto run. Emits one
+    `tune.resolve` trace annotation per distinct outcome and counts
+    hits/misses for the run gauges (stats())."""
+    key = _keys.tuning_key(op, shape, dtype, topology, backend)
+    config = _cache.lookup(_doc(), key, _keys.fingerprint(key.backend))
+    if config is not None:
+        config = _sanitize(config)
+    hit = bool(config)
+    if not hit:
+        config = None
+    _STATE["hits" if hit else "misses"] += 1
+
+    from rocm_mpi_tpu import telemetry
+
+    if telemetry.enabled():
+        telemetry.annotate(
+            "tune.resolve",
+            key=_keys.key_str(key),
+            hit=hit,
+            config=json.dumps(config, sort_keys=True) if hit else "",
+        )
+    return config
+
+
+def stats() -> dict:
+    """Process-cumulative resolve outcomes: {"hits": n, "misses": n}."""
+    return {"hits": _STATE["hits"], "misses": _STATE["misses"]}
+
+
+def reset_stats() -> None:
+    _STATE["hits"] = 0
+    _STATE["misses"] = 0
+
+
+def emit_gauges() -> None:
+    """Bank the resolve outcomes as `tune.hits` / `tune.misses` run
+    gauges (no-op when telemetry is off or nothing was resolved) — the
+    hook bench.py --suite and weak_scaling --autotune call at run end so
+    `telemetry regress` can gate tuned-vs-default summaries."""
+    from rocm_mpi_tpu import telemetry
+
+    if not telemetry.enabled():
+        return
+    s = stats()
+    if not (s["hits"] or s["misses"]):
+        return
+    telemetry.gauge("tune.hits", s["hits"])
+    telemetry.gauge("tune.misses", s["misses"])

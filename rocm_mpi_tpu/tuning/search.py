@@ -1,0 +1,203 @@
+"""The autotuner search driver: measure the legal space, gate the
+winner, persist it.
+
+Per tuning key the driver enumerates the admission-filtered candidates
+(tuning/space.py), **gates each through the traffic model first**
+(tuning/gate.py — closed-form and free, where a doomed measurement
+costs real compiles inside a bounded chip window): a config that
+models over the A_eff byte budget can never win, it cannot even run.
+Surviving candidates are measured with the framework's own timing
+protocol — the model runners' warmup-excluded windows (compiles land in
+the untimed warmup advance; metrics.Timer feeds telemetry spans),
+median over `repeats` repeat runs — with compile wall attributed
+separately via the PR-5 compile tracker (telemetry/compiles.py). The
+fastest in-budget candidate persists into the atomic cache
+(tuning/cache.py) with the jax/backend fingerprint of the measuring
+process.
+
+Measurable ops: the three VMEM-resident loops and the diffusion
+deep-halo depth — the single-process-runnable subset. The other spaces
+(masked_step tm, scan q) are consumable (resolve) and validatable
+(gate) but need a chip/mesh harness to measure honestly; searching them
+rides the chip window, not this driver.
+"""
+
+from __future__ import annotations
+
+import statistics
+
+from rocm_mpi_tpu.tuning import cache as _cache
+from rocm_mpi_tpu.tuning import gate as _gate
+from rocm_mpi_tpu.tuning import space as _space
+from rocm_mpi_tpu.tuning.keys import TuningKey, fingerprint, tuning_key
+
+MEASURABLE_OPS = (
+    "diffusion.vmem_loop",
+    "wave.vmem_loop",
+    "swe.vmem_loop",
+    "diffusion.deep",
+)
+
+
+def _compile_wall_s() -> float:
+    from rocm_mpi_tpu.telemetry import compiles
+
+    return sum(
+        row["wall_s"] for row in compiles.snapshot()["programs"].values()
+    )
+
+
+def _make_runner(op: str, shape, dtype: str):
+    """run(config) -> per-step seconds for one candidate invocation
+    (warmup-excluded, the models' own protocol). Each runner sizes its
+    windows off the candidate (chunk/k granularity divides both), so a
+    256-chunk candidate is measured as a 256-chunk program, not a
+    silently degraded one."""
+    from rocm_mpi_tpu.config import DiffusionConfig
+    from rocm_mpi_tpu.models import (
+        AcousticWave,
+        HeatDiffusion,
+        ShallowWater,
+        SWEConfig,
+        WaveConfig,
+    )
+
+    ndim = len(shape)
+    common = dict(
+        global_shape=tuple(shape), lengths=(10.0,) * ndim,
+        dtype=dtype, dims=(1,) * ndim,
+    )
+
+    if op == "diffusion.vmem_loop":
+        programs: dict = {}
+
+        def run(config):
+            c = int(config["chunk"])
+            model = HeatDiffusion(
+                DiffusionConfig(nt=2 * c, warmup=c, **common)
+            )
+            r = model.run_vmem_resident(
+                chunk=c, body_form=config["body_form"],
+                pad_pow2=config["pad_pow2"], program_cache=programs,
+            )
+            return r.wtime_it
+
+    elif op == "wave.vmem_loop":
+
+        def run(config):
+            c = int(config["chunk"])
+            model = AcousticWave(WaveConfig(nt=2 * c, warmup=c, **common))
+            return model.run_vmem_resident(chunk=c).wtime_it
+
+    elif op == "swe.vmem_loop":
+
+        def run(config):
+            c = int(config["chunk"])
+            model = ShallowWater(SWEConfig(nt=2 * c, warmup=c, **common))
+            return model.run_vmem_resident(chunk=c).wtime_it
+
+    elif op == "diffusion.deep":
+
+        def run(config):
+            k = int(config["k"])
+            model = HeatDiffusion(
+                DiffusionConfig(nt=2 * k, warmup=k, **common)
+            )
+            return model.run_deep(block_steps=k).wtime_it
+
+    else:
+        raise ValueError(
+            f"op {op!r} has no single-process measurement runner "
+            f"(measurable: {MEASURABLE_OPS})"
+        )
+    return run
+
+
+def search_op(op: str, shape, dtype: str = "f32", repeats: int = 3,
+              cache_path=None, force: bool = False, log=None,
+              candidates=None) -> dict:
+    """Search one key; returns a status dict:
+
+        {"key": TuningKey, "status": "hit"|"empty"|"tuned"|"all-rejected",
+         "entry": {...} | None, "rejected": [(config, reason), ...]}
+
+    "hit" = a fingerprint-valid entry already exists (no measurement at
+    all — the warm-cache contract); --force re-measures.
+    """
+    from rocm_mpi_tpu import telemetry
+    from rocm_mpi_tpu.telemetry import compiles
+
+    log = log or (lambda *_: None)
+    key = tuning_key(op, shape, dtype)
+    path = cache_path or _cache.default_cache_path()
+    if not force:
+        existing = _cache.lookup(
+            _cache.load(path), key, fingerprint(key.backend)
+        )
+        if existing is not None:
+            log(f"tune: {op} {key.shape_class} {key.dtype} — cache hit, "
+                f"config {existing}")
+            return {"key": key, "status": "hit",
+                    "entry": {"config": existing}, "rejected": []}
+
+    if candidates is None:
+        candidates = _space.enumerate_space(op, shape, dtype,
+                                            backend=key.backend)
+    if not candidates:
+        log(f"tune: {op} {key.shape_class} — nothing tunable (empty "
+            "admitted space)")
+        return {"key": key, "status": "empty", "entry": None,
+                "rejected": []}
+
+    # Gate FIRST: the traffic model is closed-form and free, while a
+    # rejected candidate's measurement costs real compiles inside a
+    # bounded chip window — a config the gate will always refuse is
+    # never worth timing. Rejections are still logged/annotated loudly
+    # (the teeth: a doctored fast-but-wasteful config cannot win, it
+    # cannot even run).
+    rejected = []
+    admitted = []  # (index, config, GateResult)
+    for i, config in enumerate(candidates):
+        g = _gate.validate_config(op, shape, dtype, config)
+        if g.ok:
+            admitted.append((i, config, g))
+            continue
+        rejected.append((config, g.reason))
+        log(f"tune: {op} REJECTED {config}: {g.reason}")
+        if telemetry.enabled():
+            telemetry.annotate("tune.gate_reject", op=op,
+                               config=str(sorted(config.items())),
+                               ratio=round(g.ratio, 4))
+    if not admitted:
+        log(f"tune: {op} — every candidate over the traffic budget; "
+            "nothing cached")
+        return {"key": key, "status": "all-rejected", "entry": None,
+                "rejected": rejected}
+
+    compiles.install()
+    run = _make_runner(op, shape, dtype)
+    measured = []  # (median_s, index, config, compile_s, gate)
+    for i, config, g in admitted:
+        wall0 = _compile_wall_s()
+        with telemetry.span("tune.measure", op=op, candidate=i):
+            times = [run(config) for _ in range(max(1, repeats))]
+        compile_s = _compile_wall_s() - wall0
+        med = statistics.median(times)
+        measured.append((med, i, config, compile_s, g))
+        log(f"tune: {op} {config}: {med * 1e6:.3f} us/step "
+            f"(median of {max(1, repeats)}, compile {compile_s:.1f} s)")
+
+    med, _i, config, compile_s, g = min(measured,
+                                        key=lambda t: (t[0], t[1]))
+    entry = {
+        "config": config,
+        "median_us": round(med * 1e6, 4),
+        "compile_s": round(compile_s, 3),
+        "gate_ratio": round(g.ratio, 4),
+        "fingerprint": fingerprint(key.backend),
+    }
+    _cache.store(path, key, entry)
+    log(f"tune: {op} winner {config} "
+        f"({med * 1e6:.3f} us/step, gate {g.ratio:.2f}x) -> {path}")
+    return {"key": key, "status": "tuned", "entry": entry,
+            "rejected": rejected}

@@ -1,0 +1,136 @@
+"""Legal config-space enumeration, admission-filtered.
+
+One function, `enumerate_space(op, shape, dtype)`, returns the ordered
+candidate list the search driver measures. Admission reuses the SAME
+footprint rules the kernels enforce at trace time
+(ops/pallas_kernels.py): the VMEM-resident budget at the f32 compute
+width, the 256 KB unroll-friendly chunk cap, the striped slab compile
+envelope, the wave/SWE operand-count multipliers — so a candidate the
+search would measure can never be one the kernel would refuse to trace.
+Order is canonical (defaults first, then ascending knob values): the
+search's tie-break is "earlier candidate wins", which keeps a re-search
+deterministic when two configs measure within noise of each other.
+
+The knobs per op family (ISSUE 7 / ROADMAP item 2):
+
+* `*.vmem_loop`    — scan chunk `q` per kernel launch; diffusion adds
+                     `body_form` (eqc/conly) and `pad_pow2`. Chunks stay
+                     >= 4 on purpose: 1..3 switch the kernel to the
+                     direct (non-A/c) body, a DIFFERENT fp expression —
+                     the tuned space must stay bitwise-equal to the
+                     defaults (the config="auto" contract).
+* `diffusion.masked_step` — the stripe height `tm` (the threads=(32,8)
+                     analog) for HBM-class fields.
+* `diffusion.deep` — the sweep depth `k` (exchange every k steps).
+* `*.scan`         — the scan drivers' static chunk `q`.
+"""
+
+from __future__ import annotations
+
+_CHUNKS = (16, 64, 256)
+_SCAN_CHUNKS = (16, 64, 256)
+_DEEP_KS = (4, 8, 16, 32)
+
+
+def _kernel_budgets():
+    from rocm_mpi_tpu.ops.pallas_kernels import (
+        _PS_SLAB_BUDGET_BYTES,
+        _VMEM_BLOCK_BUDGET_BYTES,
+    )
+
+    return _VMEM_BLOCK_BUDGET_BYTES, _PS_SLAB_BUDGET_BYTES
+
+
+def compute_itemsize(dtype_name: str) -> int:
+    """Storage-only-bf16 compute width from the key's dtype spelling —
+    the stdlib twin of ops.pallas_kernels._compute_itemsize (one rule:
+    budget at >= f32 width)."""
+    storage = {"f32": 4, "f64": 8, "bf16": 2}
+    try:
+        return max(storage[dtype_name], 4)
+    except KeyError:
+        raise ValueError(f"unsupported tuning dtype {dtype_name!r}") from None
+
+
+def next_pow2_shape(shape) -> tuple[int, ...]:
+    return tuple(1 << (int(n) - 1).bit_length() for n in shape)
+
+
+def _prod(xs) -> int:
+    out = 1
+    for x in xs:
+        out *= int(x)
+    return out
+
+
+def enumerate_space(op: str, shape, dtype: str,
+                    backend: str | None = None) -> list[dict]:
+    """Ordered legal candidates for `op` at per-shard `shape` /
+    storage-dtype name. Empty list = nothing tunable at this point
+    (e.g. a masked_step shape the VMEM loop serves anyway).
+
+    `backend` tightens admission where the compile envelope is
+    backend-specific: on "cpu" the multi-step kernels run in the Pallas
+    interpreter, whose trace cost scales with the unroll — a chunk-256
+    candidate takes minutes to TRACE there, so CPU spaces cap the chunk
+    at 16 (the chip search measures the real chunk ladder; a CPU-keyed
+    entry never steers a chip run anyway)."""
+    vmem_budget, slab_budget = _kernel_budgets()
+    shape = tuple(int(n) for n in shape)
+    itemsize = compute_itemsize(dtype)
+    nbytes = _prod(shape) * itemsize
+    family = op.split(".", 1)[1] if "." in op else op
+
+    if family == "vmem_loop":
+        admitted_bytes = {
+            "diffusion.vmem_loop": vmem_budget,
+            # The wave kernel holds the state pair + M + Cw; SWE holds
+            # 2(ndim+1) state + ndim masks (the kernels' own admission).
+            "wave.vmem_loop": vmem_budget // 2,
+            "swe.vmem_loop": vmem_budget // (3 * len(shape) + 2),
+        }[op]
+        if nbytes > admitted_bytes:
+            return []
+        chunks = [c for c in _CHUNKS if nbytes <= 256 * 1024 or c <= 16]
+        if backend == "cpu":
+            chunks = [c for c in chunks if c <= 16]
+        if op != "diffusion.vmem_loop":
+            return [{"chunk": c} for c in chunks]
+        out = []
+        padded = next_pow2_shape(shape)
+        pad_ok = (
+            padded != shape
+            and _prod(padded) * itemsize <= vmem_budget
+        )
+        for form in ("eqc", "conly"):
+            for pad in (False, True) if pad_ok else (False,):
+                for c in chunks:
+                    out.append(
+                        {"body_form": form, "pad_pow2": pad, "chunk": c}
+                    )
+        return out
+
+    if family == "masked_step":
+        if nbytes <= vmem_budget:
+            return []  # the VMEM loop serves it; tm never dispatches
+        g = 8
+        n0 = shape[0]
+        row = _prod(shape[1:]) * itemsize
+        out = []
+        for tm in range(g, 129, g):
+            if n0 % tm or (n0 // tm) < 2:
+                continue
+            if (tm + 2 * g) * row > slab_budget:
+                continue
+            out.append({"tm": tm})
+        return out
+
+    if family == "deep":
+        return [
+            {"k": k} for k in _DEEP_KS if k <= min(shape)
+        ]
+
+    if family == "scan":
+        return [{"chunk": q} for q in _SCAN_CHUNKS]
+
+    raise ValueError(f"no config space for op {op!r}")

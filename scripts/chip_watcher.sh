@@ -133,8 +133,33 @@ archive_telemetry() {
     mkdir -p docs/telemetry_r5
     cp -p "$f" docs/telemetry_r5/ && found=$((found + 1))
   done
+  # The autotuner cache (output/tuning/cache.json, written by
+  # run_tuning_search below): the chip-fingerprinted winners are the
+  # round's most reusable artifact — the next session's bench/suite runs
+  # start from a tuned config instead of a guessed one, but only if the
+  # cache survives the flap. Archived under a distinct name so lint.sh's
+  # schema glob finds it (docs/telemetry_r*/tuning-cache*.json).
+  if [ -s output/tuning/cache.json ]; then
+    mkdir -p docs/telemetry_r5
+    cp -p output/tuning/cache.json docs/telemetry_r5/tuning-cache.json \
+      && found=$((found + 1))
+  fi
   [ "$found" -gt 0 ] && echo "[watcher] archived $found telemetry/bench file(s) into docs/telemetry_r5/"
   return 0
+}
+
+run_tuning_search() {
+  # Autotuner search at the benchmark geometry (docs/PERF.md
+  # "Autotuning"): winners are fingerprinted to THIS chip's jax/backend,
+  # so the burst is the only place they can be measured honestly. Warm
+  # caches are pure hits (search skips measured keys), so re-running
+  # every healthy window is cheap; a flap mid-search loses at most one
+  # key (atomic per-entry writes). Bounded so a wedged backend cannot
+  # eat the window the queue and tier groups still need.
+  echo "[watcher] tuning search (252² flagship geometry)"
+  timeout -k 15 900 python -m rocm_mpi_tpu.tuning search \
+    --shape 252x252 --cache output/tuning/cache.json \
+    || echo "[watcher] tuning search rc=$? (continuing; cache keeps prior winners)"
 }
 
 group_log() { echo "docs/tpu_tier_${1}_r5.txt"; }
@@ -223,6 +248,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     echo "[watcher] running measurement queue"
     bash scripts/run_chip_queue.sh
     queue_rc=$?
+    run_tuning_search
     run_tier_groups
     archive_telemetry
     if headline_done && [ "$queue_rc" -eq 0 ] && tier_done; then

@@ -64,6 +64,22 @@ env JAX_PLATFORMS=cpu python -m rocm_mpi_tpu.telemetry regress \
   ${bench_records[@]+"${bench_records[@]}"} \
   ${health_records[@]+"${health_records[@]}"} \
   docs/weak_scaling_*mechanics*.jsonl 1>&2 || exit $?
+# Autotuner caches (docs/PERF.md "Autotuning"): the runtime cache and
+# any chip_watcher-archived snapshots must parse as the committed schema
+# AND every entry must clear the tuning traffic gate — a drifted writer
+# (or a doctored over-budget "winner") fails here, not as a silent
+# trace-time miss (or worse, a silently adopted waste config). Same
+# nullglob discipline: caches exist only after a search ran.
+shopt -s nullglob
+tuning_caches=(
+  output/tuning/cache*.json
+  docs/telemetry_r*/tuning-cache*.json
+)
+shopt -u nullglob
+if [ "${#tuning_caches[@]}" -gt 0 ]; then
+  env JAX_PLATFORMS=cpu python -m rocm_mpi_tpu.tuning validate \
+    "${tuning_caches[@]}" 1>&2 || exit $?
+fi
 # Compiled HBM-traffic gate (docs/PERF.md): lowers + audits every
 # distributed step driver against perf/budgets.json on virtual CPU
 # devices — the static roofline check; no accelerator, no timing.

@@ -1,0 +1,26 @@
+"""GL02 true positive, tuning edition (ISSUE 7 satellite): a tuning-cache
+WRITE from inside a traced body. The cache is consumed at trace time
+(read-only resolve — legal); mutating its module state from a traced
+step is the stale-global hazard GL02 exists for — the write runs once at
+trace time and every cached program reuse silently skips it."""
+
+import jax
+import rocm_mpi_tpu.tuning.resolve as tuning_resolve
+
+_TUNED = None
+
+
+@jax.jit
+def step_with_cache_write(x):
+    # GL02 (cross-module mutation): poking the resolve chokepoint's
+    # snapshot from a traced body — the next reuse of this compiled
+    # program never re-runs the write.
+    tuning_resolve._STATE = {"doc": None}
+    return x * 2
+
+
+@jax.jit
+def step_with_global_write(x):
+    global _TUNED  # GL02: a "record the winner" global in a traced body
+    _TUNED = {"chunk": 16}
+    return x + 1

@@ -146,6 +146,20 @@ def test_gl02_flags_cross_module_and_traced_global():
     assert "trace time" in messages
 
 
+def test_gl02_flags_tuning_cache_write_in_traced_body():
+    """ISSUE 7's hazard fixture: the tuning cache is READ at trace time
+    (resolve — legal); a cache WRITE from a traced body is the
+    stale-global class GL02 polices, both as a cross-module mutation of
+    the resolve chokepoint and as a winner-recording `global`."""
+    findings = [
+        f for f in lint_fixture("gl02_tuning_pos.py") if f.rule == "GL02"
+    ]
+    assert len(findings) >= 2
+    messages = " | ".join(f.message for f in findings)
+    assert "tuning_resolve._STATE" in messages
+    assert "_TUNED" in messages
+
+
 # ---------------------------------------------------------------------------
 # Suppressions
 # ---------------------------------------------------------------------------

@@ -212,7 +212,7 @@ def test_child_kernel_form_ladder_picks_winner(monkeypatch, capsys):
             pass
 
         def run_vmem_resident(self, chunk=None, body_form=None,
-                              pad_pow2=None):
+                              pad_pow2=None, program_cache=None):
             # None defaults to the module constants, exactly as the real
             # fused_multi_step resolves them.
             form = pk.EQC_BODY_FORM if body_form is None else body_form
@@ -242,6 +242,48 @@ def test_child_kernel_form_ladder_picks_winner(monkeypatch, capsys):
     # the winner at the same stub rate, so 150.0 stands).
     last = json.loads(out.out.strip().splitlines()[-1])
     assert last["value"] == 150.0 and "error" not in last
+
+
+def test_ladder_program_cache_pins_reuse():
+    """The kernel-form ladder satellite: identical configs across rungs
+    must REUSE the compiled advance, not re-trace it per call — pinned by
+    the compiles.total accounting (telemetry/compiles.py). Two same-config
+    runs through one program_cache pay strictly fewer backend compiles
+    than the same pair without it (the delta IS the re-traced advance;
+    init_state's per-instance jits recompile either way, so the pin is a
+    strict inequality, not an exact count)."""
+    from rocm_mpi_tpu.config import DiffusionConfig
+    from rocm_mpi_tpu.models import HeatDiffusion
+    from rocm_mpi_tpu.telemetry import compiles
+
+    def model():
+        return HeatDiffusion(DiffusionConfig(
+            global_shape=(16, 16), lengths=(10.0, 10.0), nt=8, warmup=4,
+            dtype="f32", dims=(1, 1),
+        ))
+
+    mode = compiles.install()
+    assert mode is not None, "compile listener must install on this jax"
+    kw = dict(chunk=4, body_form="eqc", pad_pow2=False)
+
+    def total():
+        return compiles.snapshot()["totals"]["backend_compiles"]
+
+    programs: dict = {}
+    model().run_vmem_resident(program_cache=programs, **kw)  # warm trace
+    t0 = total()
+    model().run_vmem_resident(program_cache=programs, **kw)
+    cached_delta = total() - t0
+    assert len(programs) == 1  # one config -> one cached advance
+
+    t1 = total()
+    model().run_vmem_resident(**kw)  # no cache: the advance re-traces
+    uncached_delta = total() - t1
+    assert cached_delta < uncached_delta, (
+        f"cached rerun compiled {cached_delta} programs vs "
+        f"{uncached_delta} uncached — the ladder's program cache is not "
+        "reusing traces"
+    )
 
 
 def test_env_budget_malformed(monkeypatch, capsys):
