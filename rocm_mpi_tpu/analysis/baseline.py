@@ -1,0 +1,236 @@
+"""Findings baseline + incremental (--changed) support.
+
+**Baseline** (`--baseline` / `--baseline-write`): a committed ledger of
+accepted findings (default: `rocm_mpi_tpu/analysis/baseline.json`) so a
+new rule can land BEFORE the repo is clean under it — baselined
+findings are still reported (marked, like suppressions) but do not gate;
+any finding NOT in the baseline still fails. Keys are content-addressed
+(file + rule + message hash), deliberately line-number-free: an edit
+elsewhere in the file must not un-baseline an accepted finding, while
+any change to the finding itself (message text embeds the hazard) makes
+it a new, gating one. Counts matter: a baseline accepting one instance
+does not absorb a second identical one.
+
+**--changed**: the fast dev loop — per-file rules run only on git-dirty
+files plus their import-graph neighbors (callers AND callees one hop
+out: an interprocedural finding lands on the caller, so editing a
+callee must re-lint everyone who uses it); the whole-program pass still
+parses everything (sound summaries need the full module set) and its
+findings are filtered to the same neighborhood.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pathlib
+import subprocess
+
+BASELINE_SCHEMA = "rmt-lint-baseline"
+BASELINE_VERSION = 1
+
+DEFAULT_BASELINE = pathlib.Path(__file__).with_name("baseline.json")
+
+
+def _norm_file(path: str) -> str:
+    """Stable cross-invocation spelling of a finding's file: posix,
+    relative to cwd when possible (the gate always runs from the repo
+    root, so committed keys stay machine-independent)."""
+    p = pathlib.Path(path)
+    try:
+        p = p.resolve().relative_to(pathlib.Path.cwd().resolve())
+    except (ValueError, OSError):
+        pass
+    return p.as_posix()
+
+
+def finding_key(f) -> str:
+    digest = hashlib.blake2b(
+        f.message.encode("utf-8", "surrogatepass"), digest_size=8
+    ).hexdigest()
+    return f"{_norm_file(f.file)}|{f.rule}|{digest}"
+
+
+def empty_doc() -> dict:
+    return {
+        "schema": BASELINE_SCHEMA,
+        "v": BASELINE_VERSION,
+        "entries": {},
+    }
+
+
+def write_baseline(path, findings) -> None:
+    """Bank every live (non-suppressed) error finding, atomically
+    (tmp + os.replace — the baseline is a schema-versioned artifact;
+    GL09 discipline applies to its own tooling)."""
+    entries: dict[str, dict] = {}
+    for f in findings:
+        if f.suppressed or f.severity != "error":
+            continue
+        key = finding_key(f)
+        entry = entries.setdefault(key, {
+            "file": _norm_file(f.file),
+            "rule": f.rule,
+            "message": f.message,
+            "count": 0,
+        })
+        entry["count"] += 1
+    doc = empty_doc()
+    doc["entries"] = entries
+    path = pathlib.Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = str(path) + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
+def load_baseline(path) -> dict:
+    """The baseline document. Raises ValueError on anything malformed —
+    a gate input that cannot be trusted must fail loudly (exit 2), not
+    silently accept or reject findings."""
+    try:
+        doc = json.loads(pathlib.Path(path).read_text())
+    except OSError as e:
+        raise ValueError(f"baseline {path}: unreadable ({e})") from e
+    except ValueError as e:
+        raise ValueError(f"baseline {path}: bad JSON ({e})") from e
+    for p in validate_baseline_doc(doc, str(path)):
+        raise ValueError(p)
+    return doc
+
+
+def validate_baseline_doc(doc, path: str = "<doc>") -> list[str]:
+    """Schema problems (empty = valid); shared with `telemetry regress
+    --check-schema`."""
+    if not isinstance(doc, dict):
+        return [f"{path}: not a JSON object"]
+    problems = []
+    if doc.get("schema") != BASELINE_SCHEMA:
+        problems.append(f"{path}: schema != {BASELINE_SCHEMA!r}")
+    if doc.get("v") != BASELINE_VERSION:
+        problems.append(f"{path}: v != {BASELINE_VERSION}")
+    entries = doc.get("entries")
+    if not isinstance(entries, dict):
+        return problems + [f"{path}: entries is not an object"]
+    for key, entry in entries.items():
+        if not isinstance(entry, dict):
+            problems.append(f"{path}: entry {key!r} is not an object")
+            continue
+        if not isinstance(entry.get("count"), int) or entry["count"] < 1:
+            problems.append(f"{path}: entry {key!r} needs count >= 1")
+        for field in ("file", "rule", "message"):
+            if not isinstance(entry.get(field), str):
+                problems.append(f"{path}: entry {key!r} missing {field!r}")
+    return problems
+
+
+def apply_baseline(findings, doc) -> int:
+    """Mark up to `count` live error findings per baseline key as
+    baselined (reported, not gating). Returns how many were marked.
+    Non-baselined findings are untouched — they still gate."""
+    budget = {
+        key: entry.get("count", 0)
+        for key, entry in doc.get("entries", {}).items()
+    }
+    marked = 0
+    for f in findings:
+        if f.suppressed or f.severity != "error":
+            continue
+        key = finding_key(f)
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            f.baselined = True
+            marked += 1
+    return marked
+
+
+# ---------------------------------------------------------------------------
+# --changed: git-dirty files + import-graph neighborhood
+# ---------------------------------------------------------------------------
+
+
+def git_dirty_files(root=".") -> set[str] | None:
+    """Resolved posix paths of tracked-modified + untracked .py files,
+    or None when git is unavailable (callers fall back to a full run —
+    a broken fast path must widen coverage, never narrow it)."""
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            cwd=root, capture_output=True, text=True, timeout=30,
+        )
+        out = subprocess.run(
+            ["git", "status", "--porcelain", "--no-renames"],
+            cwd=root, capture_output=True, text=True, timeout=30,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if top.returncode != 0 or out.returncode != 0:
+        return None
+    # Porcelain paths are relative to the repo TOPLEVEL, not the cwd —
+    # anchoring them at `root` would mis-resolve every dirty path when
+    # the analyzer runs from a subdirectory, and the restrict set would
+    # silently lint nothing.
+    base = pathlib.Path(top.stdout.strip() or root)
+    dirty: set[str] = set()
+    for line in out.stdout.splitlines():
+        if len(line) < 4:
+            continue
+        name = line[3:].strip().strip('"')
+        if not name.endswith(".py"):
+            continue
+        p = base / name
+        dirty.add(pathlib.Path(os.path.normpath(p.absolute())).as_posix())
+    return dirty
+
+
+def expand_neighbors(entries, dirty: set[str]) -> set[str]:
+    """dirty + one import-graph hop in BOTH directions over the parsed
+    module set (`entries` = [(display_path, source, digest)] as built by
+    core.lint_paths). Callers of a dirty module can gain or lose
+    interprocedural findings; callees define the summaries the dirty
+    module's own verdict depends on."""
+    import ast as _ast
+
+    from rocm_mpi_tpu.analysis import astutil, engine
+
+    resolved = {}
+    mod_names = {}
+    imports_of: dict[str, set[str]] = {}
+    for display, source, _ in entries:
+        rp = pathlib.Path(
+            os.path.normpath(os.path.abspath(display))
+        ).as_posix()
+        resolved[display] = rp
+        name = engine.module_name_for_path(display)
+        mod_names[display] = name
+        try:
+            tree = _ast.parse(source)
+        except (SyntaxError, ValueError, RecursionError):
+            imports_of[display] = set()
+            continue
+        table = astutil.collect_imports(tree)
+        deps = set(table.module_aliases.values())
+        deps |= {
+            origin.rpartition(".")[0]
+            for origin in table.from_imports.values()
+        }
+        imports_of[display] = {d for d in deps if d}
+    name_to_display = {v: k for k, v in mod_names.items()}
+    keep = set(dirty)
+    dirty_names = {
+        mod_names[d] for d in mod_names if resolved[d] in dirty
+    }
+    for display, deps in imports_of.items():
+        # importer of a dirty module
+        if deps & dirty_names:
+            keep.add(resolved[display])
+        # modules a dirty file imports
+        if resolved[display] in dirty:
+            for dep in deps:
+                target = name_to_display.get(dep)
+                if target is not None:
+                    keep.add(resolved[target])
+    return keep

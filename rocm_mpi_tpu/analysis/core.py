@@ -1,9 +1,12 @@
-"""graftlint core: findings, suppressions, the rule registry, file walking.
+"""graftlint core: findings, suppressions, the rule registry, file walking,
+and the wiring of the whole-program interprocedural pass.
 
 The analyzer is a pre-test gate (scripts/lint.sh, tests/test_self_lint.py)
-so the whole pipeline is stdlib-only and cached: one `ast.parse` per
-(path, mtime, size), rules share the parsed tree, and a repo-wide run
-stays well under the 5 s budget the tier-1 wiring assumes.
+so the whole pipeline is stdlib-only and cached: per-file findings are
+keyed by a blake2 content hash (never mtime/size — a same-second
+same-size edit must not serve a stale tree), whole-program findings by
+the exact (path, hash) module set, and a repeat repo-wide run is a
+near-no-op.
 
 Suppressions (all take a comma-separated rule list or `all`):
 
@@ -20,6 +23,7 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import hashlib
 import io
 import os
 import re
@@ -39,6 +43,10 @@ class Finding:
     message: str
     hint: str = ""
     suppressed: bool = False
+    # Accepted by a committed baseline (analysis/baseline.py): shown in
+    # reports, does not gate — how a new rule lands before the repo is
+    # clean under it.
+    baselined: bool = False
 
     def location(self) -> str:
         return f"{self.file}:{self.line}:{self.col}"
@@ -82,12 +90,16 @@ class Rule:
 
 
 def all_rules() -> list[Rule]:
-    """The registered rule families, GL-id order."""
+    """The registered PER-FILE rule families, GL-id order. GL08 is not
+    here: collective divergence is a whole-program property, computed by
+    the interprocedural pass (engine.analyze_modules) that lint_source
+    runs over its one module and lint_paths runs over the full set."""
     from rocm_mpi_tpu.analysis.rules_collective import AxisConsistencyRule
     from rocm_mpi_tpu.analysis.rules_compat import CompatDriftRule
     from rocm_mpi_tpu.analysis.rules_donation import DonationSafetyRule
     from rocm_mpi_tpu.analysis.rules_pallas import PallasHygieneRule
     from rocm_mpi_tpu.analysis.rules_purity import TraceTimePurityRule
+    from rocm_mpi_tpu.analysis.rules_sidecar import SidecarAtomicityRule
     from rocm_mpi_tpu.analysis.rules_signals import SignalHygieneRule
     from rocm_mpi_tpu.analysis.rules_timing import RawTimingRule
 
@@ -99,7 +111,16 @@ def all_rules() -> list[Rule]:
         AxisConsistencyRule(),
         RawTimingRule(),
         SignalHygieneRule(),
+        SidecarAtomicityRule(),
     ]
+
+
+def catalog_rules() -> list[Rule]:
+    """Every rule family for reports and --list-rules: the per-file
+    rules plus the interprocedural-only ones, GL-id order."""
+    from rocm_mpi_tpu.analysis.rules_divergence import DivergenceRule
+
+    return sorted(all_rules() + [DivergenceRule()], key=lambda r: r.id)
 
 
 # ---------------------------------------------------------------------------
@@ -167,15 +188,24 @@ def _selected(rules: list[Rule], select) -> list[Rule]:
 
 
 def lint_source(source: str, path: str = "<string>", select=None,
-                rules: list[Rule] | None = None) -> list[Finding]:
-    """Lint one source string. Unparseable source yields a single GL00
-    warning instead of raising — the gate must never crash on an input."""
+                rules: list[Rule] | None = None,
+                interprocedural: bool = True,
+                digest: str | None = None) -> list[Finding]:
+    """Lint one source string: the per-file rules plus (by default) the
+    interprocedural pass over this file as a one-module program (so
+    GL08 and the interprocedural GL01 extension fire on self-contained
+    inputs — fixtures, ad-hoc checks). lint_paths passes
+    interprocedural=False per file and runs ONE whole-program pass over
+    the full module set instead — same union, computed once.
+    Unparseable source yields a single GL00 warning instead of raising
+    — the gate must never crash on an input."""
+    explicit_rules = rules is not None
     rules = _selected(rules if rules is not None else all_rules(), select)
     # Normalized absolute form so the chokepoint allowlists (GL03) match
     # regardless of cwd, `..` segments, or how the gate spelled the path.
     posix = Path(os.path.normpath(os.path.abspath(path))).as_posix()
     try:
-        tree = ast.parse(source, filename=path)
+        tree = _parse_cached(source, path, digest)
     except (SyntaxError, ValueError, RecursionError) as e:
         return [
             Finding(
@@ -197,40 +227,85 @@ def lint_source(source: str, path: str = "<string>", select=None,
         for f in rule.check(ctx):
             f.suppressed = suppressions.covers(f)
             findings.append(f)
+    if not explicit_rules and interprocedural:
+        from rocm_mpi_tpu.analysis import engine
+
+        mod = engine.ModuleInfo(
+            path=path, name=engine.module_name_for_path(path),
+            source=source, tree=tree, suppressions=suppressions,
+        )
+        findings.extend(engine.analyze_modules([mod], select=select))
+        findings = _dedupe(findings)
     findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
     return findings
 
 
-# (path, mtime_ns, size) -> findings; makes the repo-wide tier-1 run a
-# near-no-op when invoked twice in one process (tests + gate).
-_CACHE: dict[tuple[str, int, int], list[Finding]] = {}
+# (path, display, content hash) -> findings; makes the repo-wide tier-1
+# run a near-no-op when invoked twice in one process (tests + gate).
+# Content-hashed on purpose: the old (mtime, size) key missed
+# same-second same-size edits and could serve a stale tree to the gate;
+# a blake2 of the source (which we must read anyway) cannot.
+_CACHE: dict[tuple[str, str | None, str], list[Finding]] = {}
 
 
-def lint_file(path: Path, select=None, rules=None,
-              display_path: str | None = None) -> list[Finding]:
-    try:
-        stat = path.stat()
-        key = (str(path), display_path, stat.st_mtime_ns, stat.st_size)
-    except OSError:
-        key = None
-    if key is not None and select is None and rules is None and key in _CACHE:
-        # deep-ish copies: a caller mutating a Finding (reporters toggling
-        # flags) must not poison later cache hits
-        return [dataclasses.replace(f) for f in _CACHE[key]]
+def source_digest(source: str) -> str:
+    return hashlib.blake2b(
+        source.encode("utf-8", "surrogatepass"), digest_size=16
+    ).hexdigest()
+
+
+# (display path, digest) -> parsed tree. The per-file pass and the
+# whole-program pass see the same module set, so one parse serves both
+# (rules treat trees as read-only); without it every gate file was
+# parsed twice per run.
+_PARSE_CACHE: dict[tuple[str, str], ast.Module] = {}
+
+
+def _parse_cached(source: str, path: str, digest: str | None) -> ast.Module:
+    key = (path, digest or source_digest(source))
+    tree = _PARSE_CACHE.get(key)
+    if tree is None:
+        tree = ast.parse(source, filename=path)
+        _PARSE_CACHE[key] = tree
+    return tree
+
+
+def _read_source(path: Path):
+    """(source, digest, error) — error is the OSError, if any."""
     try:
         source = path.read_text(encoding="utf-8", errors="replace")
     except OSError as e:
-        return [
-            Finding(
-                file=display_path or str(path), line=1, col=1,
-                rule=PARSE_RULE, severity="warning",
-                message=f"could not read file ({e}); skipped",
-            )
-        ]
-    findings = lint_source(
-        source, display_path or str(path), select=select, rules=rules
+        return None, None, e
+    return source, source_digest(source), None
+
+
+def _unreadable_finding(path, error) -> Finding:
+    return Finding(
+        file=str(path), line=1, col=1,
+        rule=PARSE_RULE, severity="warning",
+        message=f"could not read file ({error}); skipped",
     )
-    if key is not None and select is None and rules is None:
+
+
+def lint_file(path: Path, select=None, rules=None,
+              display_path: str | None = None,
+              preread=None) -> list[Finding]:
+    source, digest, err = (
+        preread if preread is not None else _read_source(path)
+    )
+    if err is not None:
+        return [_unreadable_finding(display_path or str(path), err)]
+    key = (str(path), display_path, digest)
+    if select is None and rules is None and key in _CACHE:
+        # deep-ish copies: a caller mutating a Finding (reporters toggling
+        # flags) must not poison later cache hits
+        return [dataclasses.replace(f) for f in _CACHE[key]]
+    findings = lint_source(
+        source, display_path or str(path), select=select, rules=rules,
+        interprocedural=False,  # lint_paths runs ONE whole-program pass
+        digest=digest,
+    )
+    if select is None and rules is None:
         _CACHE[key] = [dataclasses.replace(f) for f in findings]
     return findings
 
@@ -258,25 +333,115 @@ def iter_python_files(paths) -> list[Path]:
     return out
 
 
-def lint_paths(paths, select=None) -> tuple[list[Finding], int]:
-    """Lint files/dirs. Returns (findings, files_scanned). Nonexistent
-    paths raise FileNotFoundError (a mistyped gate path must fail loudly,
-    not silently lint nothing)."""
+# Whole-program findings keyed by the exact module set (display paths +
+# content hashes) and rule selection — the second tier-1 walk must stay
+# a near-no-op even though the program pass is global by nature.
+_PROGRAM_CACHE: dict[tuple, list[Finding]] = {}
+
+
+def _program_findings(entries, select) -> list[Finding]:
+    """Interprocedural pass (engine.analyze_modules) over the parsed
+    module set. `entries` = [(display_path, source, digest)]; files the
+    per-file pass could not parse contribute nothing (it already warned
+    GL00 for them)."""
+    from rocm_mpi_tpu.analysis import engine
+
+    sel_key = (
+        tuple(sorted(s.strip().upper() for s in select)) if select else None
+    )
+    key = (tuple(sorted((d, h) for d, _, h in entries)), sel_key)
+    if key in _PROGRAM_CACHE:
+        return [dataclasses.replace(f) for f in _PROGRAM_CACHE[key]]
+    modules = []
+    for display, source, digest in entries:
+        try:
+            tree = _parse_cached(source, display, digest)
+        except (SyntaxError, ValueError, RecursionError):
+            continue
+        modules.append(engine.ModuleInfo(
+            path=display,
+            name=engine.module_name_for_path(display),
+            source=source,
+            tree=tree,
+        ))
+    findings = engine.analyze_modules(modules, select=select)
+    _PROGRAM_CACHE[key] = [dataclasses.replace(f) for f in findings]
+    return findings
+
+
+def _dedupe(findings: list[Finding]) -> list[Finding]:
+    """Drop exact duplicate sites (the per-file GL08/GL01 shims overlap
+    with the whole-program pass on purpose — union semantics)."""
+    unique: dict[tuple, Finding] = {}
+    for f in findings:
+        unique.setdefault((f.file, f.line, f.col, f.rule, f.message), f)
+    return list(unique.values())
+
+
+def read_entries(paths) -> list[tuple]:
+    """[(display_path, source, digest)] for every .py under `paths` —
+    the module-set view the incremental (--changed) neighborhood
+    expansion works from."""
+    entries = []
+    for f in iter_python_files(paths):
+        source, digest, err = _read_source(f)
+        if err is None:
+            entries.append((str(f), source, digest))
+    return entries
+
+
+def lint_paths(paths, select=None, restrict=None,
+               interprocedural: bool = True) -> tuple[list[Finding], int]:
+    """Lint files/dirs: the per-file rules plus (by default) the
+    whole-program interprocedural pass over every module in the set.
+    Returns (findings, files_scanned). Nonexistent paths raise
+    FileNotFoundError (a mistyped gate path must fail loudly, not
+    silently lint nothing).
+
+    `restrict` (the --changed fast mode): a set of resolved posix paths
+    — per-file findings are only computed and reported for those files,
+    but the program pass still parses EVERYTHING (summaries of
+    unchanged callees are what make the interprocedural verdict on the
+    changed files sound)."""
     for raw in paths:
         if not Path(raw).exists():
             raise FileNotFoundError(f"lint path does not exist: {raw}")
     files = iter_python_files(paths)
     findings: list[Finding] = []
+    entries = []
+    scanned = 0
     for f in files:
-        findings.extend(lint_file(f, select=select))
-    return findings, len(files)
+        resolved = Path(os.path.normpath(os.path.abspath(f))).as_posix()
+        selected = restrict is None or resolved in restrict
+        preread = None
+        if interprocedural or selected:
+            preread = _read_source(f)  # ONE read serves both passes
+            _, _, err = preread
+            if interprocedural and err is None:
+                entries.append((str(f), preread[0], preread[1]))
+        if selected:
+            scanned += 1
+            findings.extend(lint_file(f, select=select, preread=preread))
+    if interprocedural:
+        prog = _program_findings(entries, select)
+        if restrict is not None:
+            prog = [
+                p for p in prog
+                if Path(os.path.normpath(os.path.abspath(p.file))).as_posix()
+                in restrict
+            ]
+        findings.extend(prog)
+    findings = _dedupe(findings)
+    findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
+    return findings, scanned
 
 
 def gate_exit_code(findings) -> int:
-    """0 when no non-suppressed error-severity finding remains, else 1.
-    Parse warnings (GL00) never fail the gate — a broken file is reported
-    but must not wedge CI on code the analyzer cannot see anyway."""
+    """0 when no non-suppressed, non-baselined error-severity finding
+    remains, else 1. Parse warnings (GL00) never fail the gate — a
+    broken file is reported but must not wedge CI on code the analyzer
+    cannot see anyway."""
     for f in findings:
-        if not f.suppressed and f.severity == "error":
+        if not f.suppressed and not f.baselined and f.severity == "error":
             return 1
     return 0

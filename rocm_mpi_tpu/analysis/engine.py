@@ -1,0 +1,788 @@
+"""The interprocedural engine: package-wide call graph + per-function
+summaries, propagated to a fixpoint.
+
+graftlint's first seven rule families are per-file by design — and three
+PRs in a row hand-fixed hazards a per-file pass is structurally blind
+to: PR 6's elastic restore re-derived per-mesh machinery to keep
+collective sequences matched across ranks, and PR 7 had to disable
+autotune resolution under multi-controller jax because per-rank cache
+files "could diverge ranks into mismatched collectives". Those are
+whole-program properties. This module computes the whole-program facts:
+
+* a **call graph** over every module in the analyzed set, resolved with
+  the same deliberately-scoped heuristics as astutil (bare names and
+  ``self.``/``cls.`` methods within a module, ``alias.func`` /
+  ``from mod import func`` across modules — anything else is unresolved
+  and contributes *no* facts, so a miss can never become a false
+  positive);
+* a **summary** per function: the collectives it issues in program
+  order (its own plus its resolvable callees', to a fixpoint), whether
+  its return value is rank-dependent (``process_index``/``axis_index``)
+  or per-rank-file-content-dependent (it reads a file), and which of
+  its parameters it donates into a jitted ``donate_argnums`` callable;
+* two passes on top:
+  - **GL08 collective-divergence** — a collective (or a call whose
+    summary contains collectives, e.g. a halo exchange) reachable under
+    rank-dependent or file-content-dependent control flow whose branch
+    arms' collective sequences differ. Lock-step SPMD ranks that issue
+    different collective sequences deadlock (one exchanges, its
+    neighbor is gone) — the PR-6/PR-7 hazard class.
+  - **interprocedural GL01** — the per-file donation rule re-run with
+    program-wide knowledge: donating callables imported from other
+    modules, and functions that donate a *parameter* (so the caller's
+    binding is poisoned by the call).
+
+Uniformity escapes the taint (matching the shipped fixes):
+
+* ``jax.process_count()`` is uniform across ranks — branching on it is
+  never divergence, and a ``process_count() > 1`` early return (the
+  PR-7 fix shape) marks the continuation single-controller, where
+  per-rank file content cannot diverge anything;
+* ``broadcast_one_to_all`` / ``process_allgather`` RESULTS are uniform
+  by construction (they are the blessed way to make a file-derived
+  decision rank-consistent) — while the calls themselves still count as
+  collectives in sequence summaries.
+
+stdlib-only, no jax import — same contract as the rest of the analyzer.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from dataclasses import dataclass
+
+from rocm_mpi_tpu.analysis import astutil
+from rocm_mpi_tpu.analysis.core import (
+    ModuleContext,
+    Suppressions,
+    parse_suppressions,
+)
+
+# Collective sequence entries are op tail-names; comparison of capped
+# sequences treats "equal up to the cap" as equal (the safe direction:
+# a missed finding, never a sprayed one).
+MAX_SEQ = 24
+
+# Device/host collectives whose per-rank issue order must match.
+COLLECTIVE_TAILS = frozenset({
+    "psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle", "all_gather",
+    "all_to_all", "psum_scatter",
+    # host-level (multihost_utils): collective across controllers
+    "process_allgather", "broadcast_one_to_all", "sync_global_devices",
+})
+
+# Rank-varying value sources (per-device / per-process identity).
+RANK_SOURCE_TAILS = frozenset({"process_index", "axis_index", "host_id"})
+
+# File-content value sources: in multi-controller topologies every
+# process reads ITS OWN filesystem, so content-derived values are
+# rank-varying unless proven single-controller or broadcast.
+FILE_SOURCE_TAILS = frozenset({
+    "read", "read_text", "read_bytes", "readline", "readlines",
+    "load", "loads",
+})
+
+# Calls whose RESULT is uniform across ranks even when inputs are not:
+# the host-level collectives synchronize by construction (they are the
+# blessed way to make a per-rank value rank-consistent).
+UNIFORM_RESULT_TAILS = frozenset({
+    "broadcast_one_to_all", "process_allgather",
+})
+
+_RANK, _FILE = "rank", "file"  # taint lattice: rank > file > None
+
+
+def _max_taint(*ts):
+    if _RANK in ts:
+        return _RANK
+    if _FILE in ts:
+        return _FILE
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Program model
+# ---------------------------------------------------------------------------
+
+
+def module_name_for_path(path: str) -> str:
+    """Dotted module name guess: anchored at the last path component
+    named like a package root we know about, else the bare stem."""
+    parts = [p for p in path.replace("\\", "/").split("/") if p]
+    if parts and parts[-1].endswith(".py"):
+        parts[-1] = parts[-1][:-3]
+    for anchor in ("rocm_mpi_tpu", "apps"):
+        if anchor in parts:
+            tail = parts[parts.index(anchor):]
+            if tail[-1] == "__init__":
+                tail = tail[:-1]
+            return ".".join(tail)
+    return parts[-1] if parts else "<module>"
+
+
+@dataclass
+class ModuleInfo:
+    path: str  # display path (findings report this)
+    name: str  # dotted module name
+    source: str
+    tree: ast.Module
+    imports: astutil.ImportTable = None  # type: ignore[assignment]
+    functions: dict = None  # bare name -> FunctionDef (last wins)
+    suppressions: Suppressions = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.imports is None:
+            self.imports = astutil.collect_imports(self.tree)
+        if self.functions is None:
+            self.functions = astutil.index_functions(self.tree)
+        if self.suppressions is None:
+            self.suppressions = parse_suppressions(self.source)
+
+
+@dataclass(frozen=True)
+class FunctionSummary:
+    """What a caller needs to know about one function."""
+
+    collectives: tuple = ()  # ordered op tails, capped at MAX_SEQ
+    returns_rank: bool = False
+    returns_file: bool = False
+    donates_params: frozenset = frozenset()  # positions donated inside
+
+
+_EMPTY = FunctionSummary()
+
+
+class Program:
+    """All modules of one analysis run + their fixpoint summaries."""
+
+    def __init__(self, modules: list[ModuleInfo]):
+        self.modules: dict[str, ModuleInfo] = {m.name: m for m in modules}
+        # (module name, function bare name) -> summary
+        self.summaries: dict[tuple[str, str], FunctionSummary] = {}
+        # (module name, callable bare name) -> (argnums, argnames) for
+        # jit-donating defs/assignments, per module
+        self.donating: dict[tuple[str, str], tuple] = {}
+        # id(fn) -> flattened source-order node list; the fixpoint
+        # re-reads every function per round and the tree never changes —
+        # walking it once per function is the difference between a 5 s
+        # and a 30 s repo pass
+        self._fn_nodes: dict[int, list] = {}
+        # set while a summarize is running: callee keys it consulted
+        # (the fixpoint's reverse edges — later rounds only recompute
+        # dependents of summaries that actually changed)
+        self._consulted: set | None = None
+        self._collect_donating()
+        self._fixpoint()
+
+    def nodes_of(self, fn: ast.AST) -> list:
+        nodes = self._fn_nodes.get(id(fn))
+        if nodes is None:
+            nodes = list(_source_order(fn))
+            self._fn_nodes[id(fn)] = nodes
+        return nodes
+
+    # -- donating callables (jit(donate_argnums=...) defs/assigns) ------
+
+    def _collect_donating(self) -> None:
+        from rocm_mpi_tpu.analysis.rules_donation import (
+            _collect_donating_callables,
+        )
+
+        for mod in self.modules.values():
+            for name, spec in _collect_donating_callables(mod.tree).items():
+                self.donating[(mod.name, name)] = spec
+
+    # -- call resolution -------------------------------------------------
+
+    def resolve_call(self, mod: ModuleInfo, callee: str):
+        """(module, FunctionDef) for a callee name as written at a call
+        site in `mod`, or None. Scope-matched to the repo's idioms:
+        bare names and self./cls. methods in-module; `alias.func` and
+        `from m import func` across modules."""
+        if not callee:
+            return None
+        head, _, rest = callee.partition(".")
+        if not rest:
+            fn = mod.functions.get(callee)
+            if fn is not None:
+                return mod, fn
+            origin = mod.imports.from_imports.get(callee, "")
+            return self._resolve_qualified(origin)
+        if head in ("self", "cls") and "." not in rest:
+            fn = mod.functions.get(rest)
+            return (mod, fn) if fn is not None else None
+        alias = mod.imports.module_aliases.get(head)
+        if alias and "." not in rest:
+            target = self.modules.get(alias)
+            if target is not None:
+                fn = target.functions.get(rest)
+                if fn is not None:
+                    return target, fn
+        return self._resolve_qualified(callee)
+
+    def _resolve_qualified(self, dotted: str):
+        if not dotted or "." not in dotted:
+            return None
+        modname, _, fname = dotted.rpartition(".")
+        target = self.modules.get(modname)
+        if target is None:
+            return None
+        fn = target.functions.get(fname)
+        return (target, fn) if fn is not None else None
+
+    def summary_for_call(self, mod: ModuleInfo, callee: str) -> FunctionSummary:
+        resolved = self.resolve_call(mod, callee)
+        if resolved is None:
+            return _EMPTY
+        tmod, fn = resolved
+        key = (tmod.name, fn.name)
+        if self._consulted is not None:
+            self._consulted.add(key)
+        return self.summaries.get(key, _EMPTY)
+
+    def donation_spec(self, mod: ModuleInfo, callee: str):
+        """(argnums, argnames) when `callee` at a call site in `mod` is
+        a donating jitted callable or a function whose summary donates
+        parameters; else None."""
+        if not callee:
+            return None
+        head, _, rest = callee.partition(".")
+        if not rest:
+            spec = self.donating.get((mod.name, callee))
+            if spec is not None:
+                return spec
+            origin = mod.imports.from_imports.get(callee, "")
+            if origin:
+                modname, _, fname = origin.rpartition(".")
+                spec = self.donating.get((modname, fname))
+                if spec is not None:
+                    return spec
+        else:
+            alias = mod.imports.module_aliases.get(head)
+            if alias and "." not in rest:
+                spec = self.donating.get((alias, rest))
+                if spec is not None:
+                    return spec
+        summary = self.summary_for_call(mod, callee)
+        if summary.donates_params:
+            return (tuple(sorted(summary.donates_params)), ())
+        return None
+
+    # -- fixpoint --------------------------------------------------------
+
+    def _fixpoint(self, max_rounds: int = 8) -> None:
+        order = [
+            (mod, fn)
+            for mod in self.modules.values()
+            for fn in _module_functions(mod)
+        ]
+        dependents: dict[tuple, set] = {}  # callee key -> dependent keys
+        recompute = None  # None = everything (round 1)
+        for _ in range(max_rounds):
+            changed: set = set()
+            for mod, fn in order:
+                key = (mod.name, fn.name)
+                if recompute is not None and key not in recompute:
+                    continue
+                self._consulted = set()
+                new = _summarize(self, mod, fn)
+                for callee_key in self._consulted:
+                    dependents.setdefault(callee_key, set()).add(key)
+                self._consulted = None
+                if self.summaries.get(key) != new:
+                    self.summaries[key] = new
+                    changed.add(key)
+            if not changed:
+                return
+            recompute = set()
+            for ck in changed:
+                recompute |= dependents.get(ck, set())
+            if not recompute:
+                return
+
+
+def _module_functions(mod: ModuleInfo):
+    """Every def in the module, nested and methods included, in source
+    order (index_functions dedups by bare name — last wins, matching
+    resolve semantics)."""
+    seen = set()
+    for fn in mod.functions.values():
+        if id(fn) not in seen:
+            seen.add(id(fn))
+            yield fn
+
+
+# ---------------------------------------------------------------------------
+# Summaries
+# ---------------------------------------------------------------------------
+
+
+def _collective_tail(callee: str) -> str | None:
+    tail = astutil.tail_name(callee)
+    return tail if tail in COLLECTIVE_TAILS else None
+
+
+def _source_order(node: ast.AST):
+    """DFS pre-order = source order (ast.walk is breadth-first, which
+    would scramble collective sequences and assign-before-return taint)."""
+    for child in ast.iter_child_nodes(node):
+        yield child
+        yield from _source_order(child)
+
+
+def _summarize(program: Program, mod: ModuleInfo,
+               fn: ast.FunctionDef) -> FunctionSummary:
+    """One function's summary against the current summary table."""
+    collectives: list[str] = []
+    param_names = [a.arg for a in (
+        fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs
+    )]
+    param_index = {n: i for i, n in enumerate(param_names)}
+    donates: set[int] = set()
+    taint: dict[str, str] = {}
+    returns_rank = False
+    returns_file = False
+
+    def expr_taint(node) -> str | None:
+        return _expr_taint(program, mod, node, taint)
+
+    nodes = program.nodes_of(fn)
+
+    # Pass 1: name taints only (so a return further up the body still
+    # sees assignments syntactically after deeper nesting; two rounds
+    # catch one level of assign-chained taint).
+    for _ in range(2):
+        for node in nodes:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                t = expr_taint(node.value)
+                if t is not None:
+                    taint[node.targets[0].id] = t
+
+    # Pass 2: collectives in source order, donation effects, returns.
+    # Nested defs are included (a nested def is almost always the
+    # shard_map/pallas local invoked right there — its collectives
+    # belong to this function's execution).
+    for node in nodes:
+        if isinstance(node, ast.Call):
+            callee = astutil.call_name(node)
+            tail = _collective_tail(callee)
+            if tail is not None:
+                collectives.append(tail)
+            else:
+                collectives.extend(
+                    program.summary_for_call(mod, callee).collectives
+                )
+            spec = program.donation_spec(mod, callee)
+            if spec is not None:
+                nums, names = spec
+                for i in nums:
+                    if i < len(node.args) and isinstance(
+                        node.args[i], ast.Name
+                    ) and node.args[i].id in param_index:
+                        donates.add(param_index[node.args[i].id])
+                for kw in node.keywords:
+                    if kw.arg in names and isinstance(kw.value, ast.Name) \
+                            and kw.value.id in param_index:
+                        donates.add(param_index[kw.value.id])
+        elif isinstance(node, ast.Return) and node.value is not None:
+            t = expr_taint(node.value)
+            if t == _RANK:
+                returns_rank = True
+            elif t == _FILE:
+                returns_file = True
+
+    return FunctionSummary(
+        collectives=tuple(collectives[:MAX_SEQ]),
+        returns_rank=returns_rank,
+        returns_file=returns_file,
+        donates_params=frozenset(donates),
+    )
+
+
+def _expr_taint(program: Program, mod: ModuleInfo, node,
+                taint: dict[str, str]) -> str | None:
+    """rank/file/None for an expression under the given name taints."""
+    if node is None or isinstance(node, (
+        ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+    )):
+        return None
+    if isinstance(node, ast.Name):
+        return taint.get(node.id)
+    if isinstance(node, ast.Call):
+        callee = astutil.call_name(node)
+        tail = astutil.tail_name(callee)
+        if tail in UNIFORM_RESULT_TAILS:
+            return None  # uniform by construction, args notwithstanding
+        arg_taints = [
+            _expr_taint(program, mod, a, taint) for a in node.args
+        ] + [
+            _expr_taint(program, mod, kw.value, taint)
+            for kw in node.keywords
+        ]
+        # method call on a tainted receiver propagates the receiver
+        # (`doc.get("chunk")` stays file-tainted)
+        if isinstance(node.func, ast.Attribute):
+            arg_taints.append(
+                _expr_taint(program, mod, node.func.value, taint)
+            )
+        if tail in RANK_SOURCE_TAILS:
+            return _RANK
+        if tail in FILE_SOURCE_TAILS:
+            return _max_taint(_FILE, *arg_taints)
+        summary = program.summary_for_call(mod, callee)
+        if summary.returns_rank:
+            return _RANK
+        if summary.returns_file:
+            return _max_taint(_FILE, *arg_taints)
+        return _max_taint(*arg_taints)
+    parts = [
+        _expr_taint(program, mod, child, taint)
+        for child in ast.iter_child_nodes(node)
+        if isinstance(child, (ast.expr, ast.comprehension))
+    ]
+    return _max_taint(*parts)
+
+
+# ---------------------------------------------------------------------------
+# process_count() uniformity tests (the PR-7 fix shape)
+# ---------------------------------------------------------------------------
+
+
+def _is_process_count_call(node) -> bool:
+    return isinstance(node, ast.Call) and \
+        astutil.tail_name(astutil.call_name(node)) == "process_count"
+
+
+def _process_count_test(test) -> str | None:
+    """'multi' for a `process_count() > 1`-shaped test, 'single' for
+    `process_count() == 1`, else None."""
+    if not isinstance(test, ast.Compare) or len(test.ops) != 1:
+        return None
+    left, op, right = test.left, test.ops[0], test.comparators[0]
+    if _is_process_count_call(right):
+        left, right = right, left
+        flip = {ast.Gt: ast.Lt, ast.Lt: ast.Gt, ast.GtE: ast.LtE,
+                ast.LtE: ast.GtE}
+        op_t = flip.get(type(op), type(op))
+    else:
+        op_t = type(op)
+    if not _is_process_count_call(left):
+        return None
+    one = astutil.int_const(right)
+    if one != 1:
+        return None
+    if op_t in (ast.Gt, ast.NotEq):
+        return "multi"
+    if op_t in (ast.Eq, ast.LtE):
+        return "single"
+    return None
+
+
+def _always_exits(body: list) -> bool:
+    return bool(body) and isinstance(
+        body[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break)
+    )
+
+
+# ---------------------------------------------------------------------------
+# GL08 — collective divergence
+# ---------------------------------------------------------------------------
+
+
+def _arm_collectives(program: Program, mod: ModuleInfo, body: list):
+    """[(call node, op tail)] for every collective reachable in `body`
+    (transitively through resolvable calls), in program order."""
+    out = []
+    for stmt in body:
+        for node in _source_order(stmt):
+            # nested defs inside the arm are included on purpose: they
+            # are the shard_map/pallas locals invoked right there
+            if not isinstance(node, ast.Call):
+                continue
+            callee = astutil.call_name(node)
+            tail = _collective_tail(callee)
+            if tail is not None:
+                out.append((node, tail))
+                continue
+            seq = program.summary_for_call(mod, callee).collectives
+            if seq:
+                out.append((node, "+".join(seq[:4])))
+    return out
+
+
+class _DivergenceChecker:
+    """Flow walk of one function (or the module body) for GL08."""
+
+    def __init__(self, rule, ctx: ModuleContext, program: Program,
+                 mod: ModuleInfo):
+        self.rule = rule
+        self.ctx = ctx
+        self.program = program
+        self.mod = mod
+        self.taint: dict[str, str] = {}
+        self.findings: list = []
+        self._reported: set = set()
+
+    def run(self, body: list, uniform: bool = False) -> None:
+        self._block(body, uniform)
+
+    # -- helpers ---------------------------------------------------------
+
+    def _expr_taint(self, node) -> str | None:
+        return _expr_taint(self.program, self.mod, node, self.taint)
+
+    def _seq(self, body: list) -> tuple:
+        return tuple(
+            t for _, t in _arm_collectives(self.program, self.mod, body)
+        )[:MAX_SEQ]
+
+    def _report_arm(self, body: list, test, why: str) -> None:
+        for call, tail in _arm_collectives(self.program, self.mod, body):
+            key = (call.lineno, call.col_offset)
+            if key in self._reported:
+                continue
+            self._reported.add(key)
+            self.findings.append(self.ctx.finding(
+                call, self.rule,
+                f"collective '{tail}' is issued under {why} control flow "
+                f"(the branch on line {test.lineno}) — ranks taking "
+                "different paths issue mismatched collective sequences "
+                "and deadlock in lock-step SPMD",
+                "issue the same collective sequence on every rank: hoist "
+                "the collective out of the branch, make the decision "
+                "uniform (broadcast_one_to_all), or guard the whole path "
+                "single-controller (process_count() == 1)",
+            ))
+
+    # -- statement walk --------------------------------------------------
+
+    def _block(self, body: list, uniform: bool) -> None:
+        for i, stmt in enumerate(body):
+            if isinstance(stmt, ast.If):
+                uniform = self._if(stmt, body[i + 1:], uniform)
+            else:
+                self._stmt(stmt, uniform)
+
+    def _if(self, node: ast.If, rest: list, uniform: bool) -> bool:
+        """Handle one If (needing the enclosing block's remainder: an
+        early-exit arm's real 'else' is everything after the If).
+        Returns the uniformity that holds for the remainder."""
+        pc = _process_count_test(node.test)
+        if pc is not None:
+            # uniform test (process_count is the same everywhere):
+            # never divergence; arms inherit their controller count,
+            # and a `if process_count() > 1: return` early exit (the
+            # PR-7 fix shape) proves the continuation single-controller
+            self._block(node.body, pc == "single")
+            self._block(node.orelse, pc == "multi")
+            if pc == "multi" and _always_exits(node.body) \
+                    and not node.orelse:
+                return True
+            return uniform
+        t = self._test_taint(node.test, uniform)
+        if t is not None:
+            body_seq = self._seq(node.body)
+            if _always_exits(node.body) and not node.orelse:
+                # `if <tainted>: return/continue` — ranks that exit run
+                # the exited arm; the others run the block remainder.
+                else_arm = rest
+            else:
+                else_arm = node.orelse
+            if body_seq != self._seq(else_arm):
+                self._report_arm(node.body, node.test, self._why(t))
+                self._report_arm(else_arm, node.test, self._why(t))
+        self._block(node.body, uniform)
+        self._block(node.orelse, uniform)
+        return uniform
+
+    @staticmethod
+    def _why(kind: str) -> str:
+        return ("rank-dependent (process_index/axis_index)"
+                if kind == _RANK
+                else "per-rank-file-content-dependent")
+
+    def _test_taint(self, test, uniform: bool) -> str | None:
+        t = self._expr_taint(test)
+        if t == _FILE and uniform:
+            return None  # single-controller: one filesystem, no skew
+        return t
+
+    def _stmt(self, node, uniform: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # separate scope; checked as its own function
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            t = self._expr_taint(node.value)
+            if t is None:
+                self.taint.pop(node.targets[0].id, None)
+            else:
+                self.taint[node.targets[0].id] = t
+            return
+        if isinstance(node, ast.While):
+            t = self._test_taint(node.test, uniform)
+            if t is not None and self._seq(node.body):
+                # divergent trip counts: ranks fall out of the loop on
+                # different iterations, each carrying collectives
+                self._report_arm(node.body, node.test, self._why(t))
+            self._block(node.body, uniform)
+            self._block(node.orelse, uniform)
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            t = self._expr_taint(node.iter)
+            if t == _FILE and uniform:
+                t = None
+            if t is not None and self._seq(node.body):
+                self._report_arm(node.body, node.iter, self._why(t))
+            self._block(node.body, uniform)
+            self._block(node.orelse, uniform)
+            return
+        if isinstance(node, ast.Try):
+            self._block(node.body, uniform)
+            for handler in node.handlers:
+                self._block(handler.body, uniform)
+            self._block(node.orelse, uniform)
+            self._block(node.finalbody, uniform)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if isinstance(item.optional_vars, ast.Name):
+                    t = self._expr_taint(item.context_expr)
+                    if t is not None:
+                        self.taint[item.optional_vars.id] = t
+            self._block(node.body, uniform)
+            return
+
+
+def check_divergence(rule, ctx: ModuleContext, program: Program,
+                     mod: ModuleInfo) -> list:
+    """GL08 findings for one module of `program`."""
+    findings = []
+    # EVERY def gets its own flow walk — not just mod.functions, whose
+    # last-wins-by-bare-name dedup (a call-RESOLUTION heuristic) would
+    # silently skip shadowed defs and same-named methods (a module with
+    # five `step` methods would have four of them unchecked).
+    scopes: list = [ctx.tree.body]
+    scopes += [
+        fn.body for fn in ast.walk(ctx.tree)
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    for body in scopes:
+        checker = _DivergenceChecker(rule, ctx, program, mod)
+        checker.run(body)
+        findings.extend(checker.findings)
+    # one finding per site even when a nested scope re-walks the code
+    unique = {}
+    for f in findings:
+        unique.setdefault((f.line, f.col, f.message), f)
+    return list(unique.values())
+
+
+# ---------------------------------------------------------------------------
+# Interprocedural GL01 (donate in caller, read in callee / poisoned by
+# a donating helper)
+# ---------------------------------------------------------------------------
+
+
+def check_donation_interprocedural(rule, ctx: ModuleContext,
+                                   program: Program,
+                                   mod: ModuleInfo) -> list:
+    """Re-run the GL01 flow checker with the program-wide donating map:
+    jit-donating callables imported from other modules, plus functions
+    whose summaries donate a parameter. Only findings the per-file pass
+    could NOT see are returned (callers dedupe by site anyway)."""
+    import ast as _ast
+
+    from rocm_mpi_tpu.analysis.rules_donation import (
+        _collect_donating_callables,
+        _FunctionChecker,
+        _State,
+    )
+
+    local = _collect_donating_callables(mod.tree)
+    extended = dict(local)
+    # names bound by `from m import f` where m.f donates — either a
+    # jit(donate_argnums=…) callable or a plain function whose summary
+    # says it donates a parameter
+    for name, origin in mod.imports.from_imports.items():
+        if name in extended:
+            continue
+        modname, _, fname = origin.rpartition(".")
+        spec = program.donating.get((modname, fname))
+        if spec is None:
+            summary = program.summaries.get((modname, fname), _EMPTY)
+            if summary.donates_params:
+                spec = (tuple(sorted(summary.donates_params)), ())
+        if spec is not None:
+            extended[name] = spec
+    # local functions whose summary donates a parameter
+    for fname, fn in mod.functions.items():
+        if fname in extended:
+            continue
+        summary = program.summaries.get((mod.name, fn.name), _EMPTY)
+        if summary.donates_params:
+            extended[fname] = (tuple(sorted(summary.donates_params)), ())
+    if extended == local:
+        return []
+
+    scopes: list = [mod.tree]
+    scopes += [
+        n for n in _ast.walk(mod.tree)
+        if isinstance(n, (_ast.FunctionDef, _ast.AsyncFunctionDef))
+    ]
+    baseline_sites = set()
+    findings = []
+    for scope in scopes:
+        base = _FunctionChecker(rule, ctx, local)
+        base.stmts(scope.body, _State())
+        for f in base.findings:
+            baseline_sites.add((f.line, f.col, f.message))
+        full = _FunctionChecker(rule, ctx, extended)
+        full.stmts(scope.body, _State())
+        for f in full.findings:
+            if (f.line, f.col, f.message) not in baseline_sites:
+                findings.append(f)
+    unique = {}
+    for f in findings:
+        unique.setdefault((f.line, f.col, f.message), f)
+    return list(unique.values())
+
+
+# ---------------------------------------------------------------------------
+# Entry point
+# ---------------------------------------------------------------------------
+
+
+def analyze_modules(modules: list[ModuleInfo], select=None) -> list:
+    """Whole-program findings (GL08 + interprocedural GL01) over the
+    given modules. Suppressions apply per module; findings come back
+    sorted like the per-file pass."""
+    from rocm_mpi_tpu.analysis.rules_divergence import DivergenceRule
+    from rocm_mpi_tpu.analysis.rules_donation import DonationSafetyRule
+
+    wanted = None
+    if select:
+        wanted = {s.strip().upper() for s in select}
+    program = Program(modules)
+    findings = []
+    gl08 = DivergenceRule()
+    gl01 = DonationSafetyRule()
+    for mod in program.modules.values():
+        ctx = ModuleContext(
+            path=mod.path, posix_path=mod.path, source=mod.source,
+            tree=mod.tree,
+        )
+        batch = []
+        if wanted is None or gl08.id in wanted:
+            batch.extend(check_divergence(gl08, ctx, program, mod))
+        if wanted is None or gl01.id in wanted:
+            batch.extend(
+                check_donation_interprocedural(gl01, ctx, program, mod)
+            )
+        for f in batch:
+            f.suppressed = mod.suppressions.covers(f)
+            findings.append(f)
+    findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
+    return findings

@@ -165,6 +165,8 @@ def load_json(path) -> dict | None:
 
 
 def _classify_json(doc: dict) -> str | None:
+    from rocm_mpi_tpu.analysis.baseline import BASELINE_SCHEMA
+    from rocm_mpi_tpu.analysis.report import FINDINGS_SCHEMA
     from rocm_mpi_tpu.telemetry.aggregate import SUMMARY_SCHEMA
     from rocm_mpi_tpu.telemetry.flight import (
         BUNDLE_SCHEMA,
@@ -177,6 +179,8 @@ def _classify_json(doc: dict) -> str | None:
         HEARTBEAT_SCHEMA: "health heartbeat sidecar",
         POSTMORTEM_SCHEMA: "health post-mortem",
         BUNDLE_SCHEMA: "health post-mortem bundle",
+        FINDINGS_SCHEMA: "graftlint findings artifact",
+        BASELINE_SCHEMA: "graftlint baseline",
     }
     if doc.get("schema") in named:
         return named[doc["schema"]]
@@ -203,6 +207,14 @@ def _validate_classified(doc: dict, kind: str) -> list[str]:
         from rocm_mpi_tpu.utils.checkpoint import validate_manifest_meta
 
         return [f"manifest {p}" for p in validate_manifest_meta(doc)]
+    if kind == "graftlint findings artifact":
+        from rocm_mpi_tpu.analysis.report import validate_findings_doc
+
+        return validate_findings_doc(doc)
+    if kind == "graftlint baseline":
+        from rocm_mpi_tpu.analysis.baseline import validate_baseline_doc
+
+        return validate_baseline_doc(doc)
     return []
 
 

@@ -144,6 +144,18 @@ archive_telemetry() {
     cp -p output/tuning/cache.json docs/telemetry_r5/tuning-cache.json \
       && found=$((found + 1))
   fi
+  # The graftlint findings artifact (output/lint/findings.json, written
+  # by the pre-flight lint.sh): the machine-readable record of WHICH
+  # analyzer verdict this burst was measured under — a later "the
+  # numbers look off" triage can check whether the tree was clean, what
+  # was baselined, and what was suppressed. Archived under a distinct
+  # name so lint.sh's schema glob finds it
+  # (docs/telemetry_r*/lint-findings*.json).
+  if [ -s output/lint/findings.json ]; then
+    mkdir -p docs/telemetry_r5
+    cp -p output/lint/findings.json docs/telemetry_r5/lint-findings.json \
+      && found=$((found + 1))
+  fi
   [ "$found" -gt 0 ] && echo "[watcher] archived $found telemetry/bench file(s) into docs/telemetry_r5/"
   return 0
 }

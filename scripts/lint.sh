@@ -2,10 +2,16 @@
 # graftlint gate: the repo's own shard-safety analyzer over the gate scope
 # (rule catalog: docs/ANALYSIS.md; engine: rocm_mpi_tpu/analysis/).
 #
-# Fast (<5 s, stdlib-only AST walk) — run it BEFORE the test suite: it
-# catches the donation-race / trace-purity / compat-drift / raw-timing
-# bug classes that unit tests only see under the exact interleaving that
-# bites.
+# Run it BEFORE the test suite: the whole-program interprocedural pass
+# (GL08 collective divergence, cross-module GL01 donation, GL09 sidecar
+# atomicity, plus the per-file families) catches the bug classes unit
+# tests only see under the exact interleaving — or the exact multi-host
+# topology — that bites. Compared against the committed baseline
+# (analysis/baseline.json: accepted findings never gate, NEW findings
+# always do), and the machine-readable artifact is banked at
+# output/lint/findings.json (schema-checked below; chip_watcher
+# archives it per burst). `scripts/lint.sh --changed` is the fast dev
+# loop (git-dirty files + import-graph neighbors only).
 #
 # Also validates the committed measurement baselines still parse as known
 # formats (telemetry regress --check-schema, docs/TELEMETRY.md): a
@@ -14,12 +20,17 @@
 #
 # Exit codes: 0 clean, 1 non-suppressed findings or schema problems,
 # 2 usage/internal error. Extra args pass through to the analyzer
-# (e.g. scripts/lint.sh --json, --select GL03).
+# (e.g. scripts/lint.sh --json, --select GL03, --changed).
+# Whole AST stage (interprocedural engine included) is bounded well
+# under 60 s; the two compiled stages at the end (lowered audit +
+# traffic gate) lower small CPU programs and stay inside the same
+# budget.
 set -u
 cd "$(dirname "$0")/.."
 # The gate never needs a device and must not hang on a flaky chip tunnel.
 env JAX_PLATFORMS=cpu python -m rocm_mpi_tpu.analysis \
-  rocm_mpi_tpu apps bench.py "$@" || exit $?
+  rocm_mpi_tpu apps bench.py \
+  --baseline --output output/lint/findings.json "$@" || exit $?
 # Schema stage's ok-line goes to stderr so `scripts/lint.sh --json | jq`
 # (the documented analyzer usage) still receives pure JSON on stdout;
 # problems already print to stderr.
@@ -58,9 +69,20 @@ health_records+=(
   output/*/manifest-*.json
   docs/telemetry_r*/manifest-*.json
 )
+# The graftlint artifacts: the findings document stage 1 just banked
+# (plus any chip_watcher-archived copies) and the committed baseline.
+# A drifted reporter or a hand-mangled baseline must fail HERE, not
+# silently mis-gate the next analysis run. (findings*.json stays in the
+# nullglob group: a --baseline-write invocation exits before writing
+# one, and that must not read as "missing".)
+health_records+=(
+  output/lint/findings*.json
+  docs/telemetry_r*/lint-findings*.json
+)
 shopt -u nullglob
 env JAX_PLATFORMS=cpu python -m rocm_mpi_tpu.telemetry regress \
   --check-schema BASELINE.json MULTICHIP_r0*.json \
+  rocm_mpi_tpu/analysis/baseline.json \
   ${bench_records[@]+"${bench_records[@]}"} \
   ${health_records[@]+"${health_records[@]}"} \
   docs/weak_scaling_*mechanics*.jsonl 1>&2 || exit $?
@@ -80,6 +102,15 @@ if [ "${#tuning_caches[@]}" -gt 0 ]; then
   env JAX_PLATFORMS=cpu python -m rocm_mpi_tpu.tuning validate \
     "${tuning_caches[@]}" 1>&2 || exit $?
 fi
+# Lowered-program audit (docs/ANALYSIS.md "The lowered-program audit"):
+# compiles all three workloads' steady-state drivers on virtual CPU
+# devices and proves (a) the collective sequence is identical across
+# rank-roles (no collective under a lowered conditional, channel-pinned
+# order, sane permute pair structure) and (b) every GL01-declared
+# donation actually aliased — the ground truth the AST engine's GL08/
+# GL01 verdicts approximate.
+env JAX_PLATFORMS=cpu python -m rocm_mpi_tpu.analysis.lowered 1>&2 \
+  || exit $?
 # Compiled HBM-traffic gate (docs/PERF.md): lowers + audits every
 # distributed step driver against perf/budgets.json on virtual CPU
 # devices — the static roofline check; no accelerator, no timing.

@@ -1,0 +1,83 @@
+"""GL08 true negatives: the SHIPPED fixes for the same hazards, plus
+the legitimate rank-guarded host-only patterns.
+
+Never imported — parsed only (tests/test_analysis_rules.py).
+"""
+
+import json
+
+import jax
+
+
+def cache_path():
+    return "output/tuning/cache.json"
+
+
+def load_tuned_chunk():
+    with open(cache_path()) as fh:
+        doc = json.load(fh)
+    return doc.get("chunk")
+
+
+def exchange(T):
+    return jax.lax.ppermute(T, "x", [(0, 1)])
+
+
+def scan_whole(T, n):
+    for _ in range(n):
+        T = exchange(T)
+    return T
+
+
+def scan_chunked(T, n, q):
+    for _ in range(n):
+        T = exchange(exchange(T))
+    return T
+
+
+def advance_auto_fixed(T, n):
+    # The PR-7 fix shape: multi-controller processes never consult their
+    # per-rank cache — the early return proves the continuation
+    # single-controller, where file content cannot skew ranks.
+    if jax.process_count() > 1:
+        return scan_whole(T, n)
+    chunk = load_tuned_chunk()
+    if chunk:  # single-controller: legal
+        return scan_chunked(T, n, chunk)
+    return scan_whole(T, n)
+
+
+def advance_auto_broadcast(T, n, chunk_local):
+    # The other blessed fix: launder the per-rank decision through a
+    # collective — broadcast results are uniform by construction.
+    from rocm_mpi_tpu.utils.compat import multihost_utils
+
+    chunk = multihost_utils.broadcast_one_to_all(chunk_local)
+    if chunk:  # uniform: legal
+        return scan_chunked(T, n, chunk)
+    return scan_whole(T, n)
+
+
+def write_manifest_rank0(state, directory):
+    # Rank-guarded HOST-ONLY work (the write_manifest shape): no
+    # collective under the branch, nothing to diverge.
+    if jax.process_index() != 0:
+        return None
+    doc = {"leaves": len(state)}
+    return directory, doc
+
+
+def symmetric_early_exit(T):
+    # Both paths issue the SAME collective sequence: rank-dependent
+    # control flow without divergence.
+    if jax.process_index() == 0:
+        return exchange(T)
+    return exchange(T)
+
+
+def uniform_variant_branch(T, n, variant):
+    # A branch on plain config: every rank takes the same arm, however
+    # different the arms' collectives are.
+    if variant == "deep":
+        return scan_chunked(T, n, 8)
+    return scan_whole(T, n)

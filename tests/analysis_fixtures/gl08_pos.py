@@ -1,0 +1,58 @@
+"""GL08 true positives: the PR-7 multi-controller cache-divergence
+hazard and the PR-6 elastic rebuild-vs-reuse hazard, reconstructed.
+
+Never imported — parsed only (tests/test_analysis_rules.py).
+"""
+
+import json
+
+import jax
+
+
+def cache_path():
+    return "output/tuning/cache.json"
+
+
+def load_tuned_chunk():
+    # Under multi-controller jax every process reads ITS OWN filesystem:
+    # the returned value is per-rank.
+    with open(cache_path()) as fh:
+        doc = json.load(fh)
+    return doc.get("chunk")
+
+
+def exchange(T):
+    return jax.lax.ppermute(T, "x", [(0, 1)])
+
+
+def scan_whole(T, n):
+    for _ in range(n):
+        T = exchange(T)
+    return T
+
+
+def scan_chunked(T, n, q):
+    # A different chunking builds a different per-invocation collective
+    # count — divergently traced programs across ranks.
+    for _ in range(n):
+        T = exchange(exchange(T))
+    return T
+
+
+def advance_auto(T, n):
+    # PR-7 reconstruction (the shape models/diffusion.auto_scan_chunk
+    # guards against): the resolved per-rank cache content picks the
+    # program structure, and the two arms' collective sequences differ.
+    chunk = load_tuned_chunk()
+    if chunk:  # GL08: per-rank-file-content-dependent, arms differ
+        return scan_chunked(T, n, chunk)
+    return scan_whole(T, n)
+
+
+def restore_elastic(state, new_dims):
+    # PR-6 reconstruction: rank 0 re-gathers the slabs for the new mesh
+    # while every other rank reuses its local shard — the rebuild arm's
+    # collective never completes because the peers never enter it.
+    if jax.process_index() == 0:  # GL08: rank-dependent, arms differ
+        state = jax.lax.psum(state, "x")
+    return state

@@ -1,0 +1,56 @@
+"""GL09 true negatives: the committed disciplines (tmp+rename and
+append-only), plus writes that are not schema-versioned artifacts.
+
+Never imported — parsed only (tests/test_analysis_rules.py).
+"""
+
+import json
+import os
+
+
+def write_doc_atomic(path, doc):
+    # The reference shape (tuning/cache.write_doc): tmp + os.replace.
+    record = {"kind": "rmt-tuning-cache", "v": 1, "entries": doc}
+    tmp = str(path) + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(record, fh)
+    os.replace(tmp, path)
+
+
+def write_manifest_atomic(path, manifest_doc):
+    # The pathlib shape (utils/checkpoint.write_manifest).
+    target = path / "manifest-000100.json"
+    tmp = target.with_suffix(".json.tmp")
+    tmp.write_text(json.dumps(manifest_doc))
+    tmp.replace(target)
+
+
+def write_heartbeat_pathlib_atomic(directory, rank, payload):
+    # The Path.open("w") form of the discipline: write the tmp-named
+    # sibling, then rename over the final path.
+    target = directory / f"heartbeat-rank{rank}.json"
+    tmp = directory / f"heartbeat-rank{rank}.json.tmp"
+    with tmp.open("w") as fh:
+        json.dump(payload, fh)
+    tmp.replace(target)
+
+
+def append_elastic_event(root, rec):
+    # Append-only JSONL: a torn final line is droppable; every complete
+    # line stays valid (telemetry/health.py's elastic.jsonl).
+    record = {"schema": "rmt-elastic-event", "v": 1, **rec}
+    with open(root / "elastic.jsonl", "a") as fh:
+        fh.write(json.dumps(record) + "\n")
+
+
+def write_scratch_notes(path, rows):
+    # Not a schema-versioned artifact (no schema/kind/version marker, no
+    # artifact-family name): out of GL09's scope by design.
+    with open(path, "w") as fh:
+        json.dump({"rows": rows}, fh)
+
+
+def read_cache(path):
+    # Reads are never writes.
+    with open(path) as fh:
+        return json.load(fh)

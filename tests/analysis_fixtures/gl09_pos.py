@@ -1,0 +1,46 @@
+"""GL09 true positives: schema-versioned artifacts written in place.
+
+Never imported — parsed only (tests/test_analysis_rules.py).
+"""
+
+import json
+
+
+def write_status_torn(path, step):
+    # In-place dump of a schema-carrying document: a reader observing
+    # mid-write sees torn JSON.
+    doc = {"schema": "rmt-status", "v": 1, "step": step}
+    with open(path, "w") as fh:  # GL09
+        json.dump(doc, fh)
+
+
+def write_heartbeat_torn(directory, rank, payload):
+    # Path names a committed artifact family — evidence enough even
+    # though the payload dict is opaque here.
+    path = f"{directory}/heartbeat-rank{rank}.json"
+    with open(path, "w") as fh:  # GL09
+        fh.write(json.dumps(payload))
+
+
+def write_manifest_torn(path, manifest_doc):
+    # write_text straight onto the final path: same torn window.
+    target = path / "manifest-000100.json"
+    target.write_text(json.dumps(manifest_doc))  # GL09
+
+
+def write_heartbeat_pathlib_torn(directory, rank, payload):
+    # The method form (`Path.open("w")`) is the same torn window as
+    # builtin open — the receiver is the path, the mode is args[0].
+    target = directory / f"heartbeat-rank{rank}.json"
+    with target.open("w") as fh:  # GL09
+        json.dump(payload, fh)
+
+
+def write_tmp_never_published(path, doc):
+    # Half the discipline is none of it: the tmp file is written but
+    # never renamed over the final path — the artifact never publishes
+    # (and a stale old version keeps vouching for the wrong state).
+    record = {"kind": "rmt-tuning-cache", "v": 1, "entries": doc}
+    tmp = str(path) + ".tmp"
+    with open(tmp, "w") as fh:  # GL09: no rename anywhere in scope
+        json.dump(record, fh)

@@ -40,9 +40,13 @@ def live_rules(findings) -> set[str]:
 # ---------------------------------------------------------------------------
 
 
-@pytest.mark.parametrize(
-    "rule_id", ["GL01", "GL02", "GL03", "GL04", "GL05", "GL06", "GL07"]
-)
+ALL_RULE_IDS = [
+    "GL01", "GL02", "GL03", "GL04", "GL05", "GL06", "GL07", "GL08",
+    "GL09",
+]
+
+
+@pytest.mark.parametrize("rule_id", ALL_RULE_IDS)
 def test_rule_true_positive(rule_id):
     findings = lint_fixture(f"{rule_id.lower()}_pos.py")
     assert rule_id in live_rules(findings), (
@@ -53,9 +57,7 @@ def test_rule_true_positive(rule_id):
     assert live_rules(findings) == {rule_id}
 
 
-@pytest.mark.parametrize(
-    "rule_id", ["GL01", "GL02", "GL03", "GL04", "GL05", "GL06", "GL07"]
-)
+@pytest.mark.parametrize("rule_id", ALL_RULE_IDS)
 def test_rule_true_negative(rule_id):
     findings = lint_fixture(f"{rule_id.lower()}_neg.py")
     assert rule_id not in live_rules(findings), (
@@ -158,6 +160,291 @@ def test_gl02_flags_tuning_cache_write_in_traced_body():
     messages = " | ".join(f.message for f in findings)
     assert "tuning_resolve._STATE" in messages
     assert "_TUNED" in messages
+
+
+# ---------------------------------------------------------------------------
+# GL08 / GL09 — the interprocedural rule families (ISSUE 8)
+# ---------------------------------------------------------------------------
+
+
+def test_gl08_flags_pr7_multicontroller_cache_reconstruction():
+    """The PR-7 hazard shape: per-rank cache content selects between
+    branch arms whose collective sequences differ."""
+    findings = [f for f in lint_fixture("gl08_pos.py") if f.rule == "GL08"]
+    assert any(
+        "per-rank-file-content-dependent" in f.message for f in findings
+    ), [(f.line, f.message) for f in findings]
+
+
+def test_gl08_flags_pr6_rank_rebuild_reconstruction():
+    """The PR-6 hazard shape: a rank-guarded rebuild arm issuing a
+    collective the reuse arm never does."""
+    findings = [f for f in lint_fixture("gl08_pos.py") if f.rule == "GL08"]
+    assert any(
+        "rank-dependent" in f.message and "psum" in f.message
+        for f in findings
+    ), [(f.line, f.message) for f in findings]
+
+
+def test_gl08_fixed_forms_pass():
+    """The SHIPPED fixes must be clean: the process_count() > 1 early
+    return (PR 7) and the broadcast_one_to_all laundering — plus
+    rank-guarded host-only work and same-sequence-on-both-paths."""
+    findings = lint_fixture("gl08_neg.py")
+    assert "GL08" not in live_rules(findings), [
+        (f.line, f.message) for f in findings if f.rule == "GL08"
+    ]
+
+
+def test_gl08_interprocedural_across_modules(tmp_path):
+    """The divergence is only visible with BOTH modules in the program:
+    the collective lives in a helper module, the rank branch in the
+    caller. Per-file lint of the caller alone must stay silent (the
+    callee is unresolvable); the whole-program pass must fire."""
+    (tmp_path / "helpers.py").write_text(
+        "import jax\n"
+        "def exchange(T):\n"
+        "    return jax.lax.ppermute(T, 'x', [(0, 1)])\n"
+    )
+    caller = tmp_path / "caller.py"
+    caller.write_text(
+        "import jax\n"
+        "from helpers import exchange\n"
+        "def f(T):\n"
+        "    if jax.process_index() == 0:\n"
+        "        return exchange(T)\n"
+        "    return T\n"
+    )
+    from rocm_mpi_tpu.analysis.core import lint_file
+
+    assert "GL08" not in live_rules(lint_file(caller))
+    findings, _ = lint_paths([str(tmp_path)])
+    gl08 = [f for f in findings if f.rule == "GL08"]
+    assert gl08 and "caller.py" in gl08[0].file, [
+        (f.file, f.line) for f in findings
+    ]
+
+
+def test_gl01_interprocedural_donating_helper(tmp_path):
+    """Donate in a HELPER, read in the caller: the helper donates its
+    parameter into a jitted donate_argnums callable, so the caller's
+    binding is poisoned by the helper call — only the whole-program
+    summaries can see it."""
+    (tmp_path / "lib.py").write_text(
+        "import functools\n"
+        "import jax\n"
+        "@functools.partial(jax.jit, donate_argnums=0)\n"
+        "def advance(state, n):\n"
+        "    return state + n\n"
+        "def advance_twice(state):\n"
+        "    out = advance(state, 1)\n"
+        "    return advance(out, 1)\n"
+    )
+    caller = tmp_path / "driver.py"
+    caller.write_text(
+        "from lib import advance_twice\n"
+        "def run(state):\n"
+        "    out = advance_twice(state)\n"
+        "    return out + state.sum()\n"  # read after helper donated it
+    )
+    from rocm_mpi_tpu.analysis.core import lint_file
+
+    assert "GL01" not in live_rules(lint_file(caller))
+    findings, _ = lint_paths([str(tmp_path)])
+    gl01 = [
+        f for f in findings
+        if f.rule == "GL01" and "driver.py" in f.file and not f.suppressed
+    ]
+    assert gl01, [(f.file, f.line, f.rule) for f in findings]
+
+
+def test_gl09_flags_every_torn_writer_shape():
+    """dump-to-final, write-through-artifact-path, write_text-in-place,
+    Path.open('w')-in-place, and tmp-without-rename each fire."""
+    findings = [f for f in lint_fixture("gl09_pos.py") if f.rule == "GL09"]
+    assert len(findings) == 5, [(f.line, f.message) for f in findings]
+
+
+def test_gl09_accepts_both_disciplines():
+    """tmp+os.replace, pathlib tmp+.replace, and append-only JSONL are
+    the committed disciplines; scratch JSON without a schema marker is
+    out of scope."""
+    findings = lint_fixture("gl09_neg.py")
+    assert "GL09" not in live_rules(findings), [
+        (f.line, f.message) for f in findings if f.rule == "GL09"
+    ]
+
+
+def test_gl08_fires_inside_shadowed_defs():
+    """index_functions' last-wins-by-bare-name dedup is a
+    call-RESOLUTION heuristic only: every def body — shadowed defs and
+    same-named methods included — gets its own GL08 flow walk (the gate
+    scope has modules with five same-named `step` methods)."""
+    src = (
+        "import jax\n"
+        "class A:\n"
+        "    def step(self, T):\n"
+        "        if jax.process_index() == 0:\n"
+        "            return jax.lax.psum(T, 'x')\n"
+        "        return T\n"
+        "class B:\n"
+        "    def step(self, T):\n"
+        "        return T\n"
+    )
+    findings = [f for f in lint_source(src, "shadow.py")
+                if f.rule == "GL08"]
+    assert findings and findings[0].line == 5, [
+        (f.line, f.message) for f in findings
+    ]
+
+
+def test_gl08_suppression_works():
+    src = (
+        "import jax\n"
+        "def exchange(T):\n"
+        "    return jax.lax.ppermute(T, 'x', [(0, 1)])\n"
+        "def f(T):\n"
+        "    if jax.process_index() == 0:\n"
+        "        # graftlint: disable-next=GL08\n"
+        "        return exchange(T)\n"
+        "    return T\n"
+    )
+    findings = lint_source(src, "sup.py")
+    gl08 = [f for f in findings if f.rule == "GL08"]
+    assert gl08 and all(f.suppressed for f in gl08)
+    assert gate_exit_code(findings) == 0
+
+
+# ---------------------------------------------------------------------------
+# Baseline (--baseline / --baseline-write) + the content-hash cache
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_roundtrip_gates_only_new_findings(tmp_path):
+    from rocm_mpi_tpu.analysis import baseline
+
+    findings = lint_fixture("gl09_pos.py")
+    assert gate_exit_code(findings) == 1
+    path = tmp_path / "baseline.json"
+    baseline.write_baseline(path, findings)
+    doc = baseline.load_baseline(path)
+    assert doc["schema"] == baseline.BASELINE_SCHEMA
+
+    again = lint_fixture("gl09_pos.py")
+    marked = baseline.apply_baseline(again, doc)
+    assert marked == len([f for f in again if f.severity == "error"])
+    assert gate_exit_code(again) == 0  # accepted findings do not gate
+
+    # a NEW finding (not in the ledger) still fails
+    extra = lint_fixture("gl03_pos.py")
+    assert baseline.apply_baseline(extra, doc) == 0
+    assert gate_exit_code(extra) == 1
+
+
+def test_baseline_counts_do_not_absorb_duplicates(tmp_path):
+    """A baseline accepting one instance of a finding must not absorb a
+    second identical one."""
+    from rocm_mpi_tpu.analysis import baseline
+
+    src = (
+        "import json\n"
+        "def w(path, doc):\n"
+        "    record = {'schema': 's', 'v': 1}\n"
+        "    with open(path, 'w') as fh:\n"
+        "        json.dump(record, fh)\n"
+    )
+    one = lint_source(src, "w.py")
+    path = tmp_path / "b.json"
+    baseline.write_baseline(path, one)
+    doc = baseline.load_baseline(path)
+
+    doubled = (
+        src + "\n"
+        "def w2(path, doc):\n"
+        "    record = {'schema': 's', 'v': 1}\n"
+        "    with open(path, 'w') as fh:\n"
+        "        json.dump(record, fh)\n"
+    )
+    two = lint_source(doubled, "w.py")
+    assert len([f for f in two if f.rule == "GL09"]) == 2
+    assert baseline.apply_baseline(two, doc) == 1
+    assert gate_exit_code(two) == 1  # the second instance still gates
+
+
+def test_malformed_baseline_fails_loudly(tmp_path):
+    from rocm_mpi_tpu.analysis import baseline
+
+    bad = tmp_path / "bad.json"
+    bad.write_text('{"schema": "wrong"}')
+    with pytest.raises(ValueError):
+        baseline.load_baseline(bad)
+    with pytest.raises(ValueError):
+        baseline.load_baseline(tmp_path / "missing.json")
+
+
+def test_cache_catches_same_size_same_second_edit(tmp_path):
+    """The (mtime, size) key this cache used to have misses an edit that
+    keeps byte length within the same second; the content hash cannot."""
+    import os
+
+    from rocm_mpi_tpu.analysis.core import lint_file
+
+    p = tmp_path / "edit.py"
+    p.write_text("from jax.experimental import pallas\n")  # GL03
+    st = p.stat()
+    first = lint_file(p)
+    assert "GL03" in live_rules(first)
+    # same byte count, same mtime — only the content differs
+    clean = "x = 1111111111111111111111111111111\n"
+    assert len(clean) == len("from jax.experimental import pallas\n")
+    p.write_text(clean)
+    os.utime(p, ns=(st.st_atime_ns, st.st_mtime_ns))
+    second = lint_file(p)
+    assert live_rules(second) == set(), [
+        (f.rule, f.message) for f in second
+    ]
+
+
+# ---------------------------------------------------------------------------
+# --changed: neighborhood expansion + git fallback
+# ---------------------------------------------------------------------------
+
+
+def test_changed_expands_to_import_neighbors(tmp_path):
+    from rocm_mpi_tpu.analysis import baseline
+    from rocm_mpi_tpu.analysis.core import read_entries
+
+    (tmp_path / "leaf.py").write_text("X = 1\n")
+    (tmp_path / "mid.py").write_text("from leaf import X\nY = X\n")
+    (tmp_path / "top.py").write_text("from mid import Y\nZ = Y\n")
+    (tmp_path / "other.py").write_text("W = 4\n")
+    entries = read_entries([str(tmp_path)])
+    dirty = {(tmp_path / "mid.py").resolve().as_posix()}
+    keep = baseline.expand_neighbors(entries, dirty)
+    names = {p.rsplit("/", 1)[-1] for p in keep}
+    # dirty + its importer (top) + its import (leaf); not the stranger
+    assert names == {"mid.py", "top.py", "leaf.py"}, names
+
+
+def test_changed_restrict_filters_reported_scope(tmp_path):
+    (tmp_path / "dirty.py").write_text(
+        "from jax.experimental import pallas\n"  # GL03
+    )
+    (tmp_path / "clean_but_unselected.py").write_text(
+        "from jax.experimental import pallas\n"  # GL03 too
+    )
+    restrict = {(tmp_path / "dirty.py").resolve().as_posix()}
+    findings, scanned = lint_paths([str(tmp_path)], restrict=restrict)
+    assert scanned == 1
+    assert {f.file.rsplit("/", 1)[-1] for f in findings} == {"dirty.py"}
+
+
+def test_git_dirty_files_degrades_to_none(tmp_path):
+    """Outside a git work tree the fast path must answer None (callers
+    then run the full scope), never raise or return a wrong subset."""
+    from rocm_mpi_tpu.analysis import baseline
+
+    assert baseline.git_dirty_files(tmp_path) is None
 
 
 # ---------------------------------------------------------------------------
@@ -275,30 +562,70 @@ def test_missing_path_fails_loudly():
 
 
 # ---------------------------------------------------------------------------
-# JSON reporter schema (version 1 — pinned)
+# JSON reporter schema (version 2 — pinned; regress --check-schema reads it)
 # ---------------------------------------------------------------------------
 
 
 def test_json_reporter_schema():
+    from rocm_mpi_tpu.analysis import catalog_rules, validate_findings_doc
+    from rocm_mpi_tpu.analysis.report import (
+        FINDINGS_SCHEMA,
+        FINDINGS_VERSION,
+    )
+
     findings = lint_fixture("gl03_pos.py") + lint_fixture("suppressions.py")
     doc = json.loads(to_json(findings, files_scanned=2))
-    assert doc["version"] == 1
+    assert doc["schema"] == FINDINGS_SCHEMA
+    assert doc["version"] == FINDINGS_VERSION == 2
     assert doc["files_scanned"] == 2
     assert isinstance(doc["suppressed"], int) and doc["suppressed"] == 2
-    # counts: every registered rule id present, plus GL00
-    rule_ids = {r.id for r in all_rules()} | {PARSE_RULE}
+    assert doc["baselined"] == 0
+    # counts: every cataloged rule id present (GL08/GL09 included), GL00 too
+    rule_ids = {r.id for r in catalog_rules()} | {PARSE_RULE}
+    assert {"GL08", "GL09"} <= rule_ids
     assert set(doc["counts"]) == rule_ids
     assert doc["counts"]["GL03"] == len(
         [f for f in findings if not f.suppressed]
     )
     required = {
         "file", "line", "col", "rule", "severity", "message", "hint",
-        "suppressed",
+        "suppressed", "baselined",
     }
     for entry in doc["findings"]:
         assert set(entry) == required
         assert entry["severity"] in ("error", "warning")
         assert isinstance(entry["line"], int) and entry["line"] >= 1
+    # the document validates against its own schema checker (the one
+    # regress --check-schema runs)
+    assert validate_findings_doc(doc) == []
+    assert validate_findings_doc({"schema": "nope"}) != []
+
+
+def test_write_findings_is_atomic_and_schema_checked(tmp_path):
+    """The banked artifact parses, validates, and is classified by the
+    telemetry regress schema gate (the lint.sh wiring)."""
+    from rocm_mpi_tpu.analysis import write_findings
+    from rocm_mpi_tpu.telemetry.regress import check_schema
+
+    findings = lint_fixture("gl09_pos.py")
+    out = tmp_path / "lint" / "findings.json"
+    write_findings(out, findings, files_scanned=1)
+    assert out.is_file() and not out.with_name("findings.json.tmp").exists()
+    doc = json.loads(out.read_text())
+    assert doc["schema"] == "rmt-lint-findings"
+    assert check_schema([str(out)]) == []
+    # a drifted document must FAIL the schema gate
+    doc["findings"][0]["line"] = "not-an-int"
+    out.write_text(json.dumps(doc))
+    assert check_schema([str(out)]) != []
+
+
+def test_committed_baseline_passes_schema_gate():
+    from rocm_mpi_tpu.analysis.baseline import DEFAULT_BASELINE
+    from rocm_mpi_tpu.telemetry.regress import check_schema
+
+    assert DEFAULT_BASELINE.is_file(), "committed baseline missing"
+    assert check_schema([str(DEFAULT_BASELINE)]) == []
 
 
 # ---------------------------------------------------------------------------
@@ -326,5 +653,44 @@ def test_cli_select_and_json(capsys):
 def test_cli_list_rules(capsys):
     assert cli_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
-    for rule_id in ("GL01", "GL02", "GL03", "GL04", "GL05"):
+    for rule_id in ("GL01", "GL02", "GL03", "GL04", "GL05", "GL06",
+                    "GL07", "GL08", "GL09"):
         assert rule_id in out
+
+
+def test_cli_baseline_write_then_compare(tmp_path, capsys):
+    """The landing flow for a new rule: bank the dirty state, gate only
+    what is NOT in the ledger."""
+    import shutil
+
+    fixture = tmp_path / "dirty.py"
+    shutil.copy(FIXTURES / "gl09_pos.py", fixture)
+    ledger = tmp_path / "baseline.json"
+
+    assert cli_main([str(fixture)]) == 1
+    assert cli_main([str(fixture), "--baseline-write", str(ledger)]) == 0
+    assert cli_main([str(fixture), "--baseline", str(ledger)]) == 0
+    out = capsys.readouterr().out
+    assert "baselined" in out
+    # unreadable ledger = usage error, never a silent pass
+    assert cli_main([str(fixture), "--baseline",
+                     str(tmp_path / "nope.json")]) == 2
+    # --changed would restrict the scan to the dirty neighborhood; a
+    # baseline banked from it silently drops every accepted finding
+    # outside that set — the combination is a usage error
+    assert cli_main([str(fixture), "--changed",
+                     "--baseline-write", str(ledger)]) == 2
+    capsys.readouterr()
+
+
+def test_cli_output_artifact(tmp_path, capsys):
+    from rocm_mpi_tpu.analysis import validate_findings_doc
+
+    out_path = tmp_path / "out" / "findings.json"
+    rc = cli_main([str(FIXTURES / "gl03_pos.py"), "--output",
+                   str(out_path)])
+    assert rc == 1
+    doc = json.loads(out_path.read_text())
+    assert validate_findings_doc(doc) == []
+    assert doc["counts"]["GL03"] >= 1
+    capsys.readouterr()

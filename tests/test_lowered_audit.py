@@ -1,0 +1,136 @@
+"""Lowered-program audit tests (analysis/lowered.py): the HLO parser on
+canned text (fast, jax-free), the role/donation verdicts on doctored
+modules the audit MUST reject, and the real three-workload drill the
+lint.sh stage runs.
+"""
+
+from __future__ import annotations
+
+from rocm_mpi_tpu.analysis import lowered
+
+# A miniature scheduled-HLO module in the shapes the audit parses:
+# collectives inside a while body (the fori/scan drivers), channel ids,
+# pair lists, and a donation alias table.
+CANNED = """\
+HloModule jit_adv, is_scheduled=true, input_output_alias={ {0}: (0, {}, may-alias), {1}: (1, {}, may-alias) }, entry_computation_layout={(f64[16,16]{1,0})->f64[16,16]{1,0}}, num_partitions=2
+
+%body (p: (s64[], f64[16,16])) -> (s64[], f64[16,16]) {
+  %p = (s64[], f64[16,16]{1,0}) parameter(0)
+  %cp1 = f64[1,16]{1,0} collective-permute(f64[1,16]{1,0} %slice.1), channel_id=1, source_target_pairs={{0,1}}
+  %cp2 = f64[1,16]{1,0} collective-permute(f64[1,16]{1,0} %slice.2), channel_id=2, source_target_pairs={{1,0}}
+  ROOT %t = (s64[], f64[16,16]{1,0}) tuple(%c, %u)
+}
+
+%cond (p: (s64[], f64[16,16])) -> pred[] {
+  %p = (s64[], f64[16,16]{1,0}) parameter(0)
+  ROOT %lt = pred[] compare(%a, %b), direction=LT
+}
+
+ENTRY %main_spmd (param: f64[16,16], param.1: f64[16,16]) -> f64[16,16] {
+  %param = f64[16,16]{1,0} parameter(0)
+  %w = (s64[], f64[16,16]{1,0}) while((s64[], f64[16,16]{1,0}) %tup), condition=%cond, body=%body
+  ROOT %gte = f64[16,16]{1,0} get-tuple-element(%w), index=1
+}
+"""
+
+
+class TestHloParsing:
+    def test_collective_sequence_enters_while_bodies(self):
+        seq = lowered.collective_sequence(CANNED)
+        assert [op.kind for op in seq] == [
+            "collective-permute", "collective-permute",
+        ]
+        assert [op.channel for op in seq] == [1, 2]
+        assert all(op.loop_depth == 1 for op in seq)
+        assert all(not op.in_conditional for op in seq)
+        assert seq[0].pairs == ((0, 1),)
+
+    def test_aliased_params(self):
+        assert lowered.aliased_params(CANNED) == {0, 1}
+        assert lowered.aliased_params(
+            "HloModule m, entry_computation_layout={()->()}"
+        ) == set()
+
+    def test_roles_identical_on_clean_module(self):
+        audit = lowered.audit_roles(CANNED)
+        assert audit.ok, audit.problems
+        assert audit.num_partitions == 2
+        assert audit.role_sequences[0] == audit.role_sequences[1]
+
+    def test_conditional_collective_is_rejected(self):
+        """A collective under a conditional branch computation is a
+        lowered rank-divergent collective — the exact hazard GL08
+        approximates from source; the ground-truth audit must refuse."""
+        doctored = CANNED.replace(
+            "condition=%cond, body=%body",
+            "condition=%cond, body=%body",
+        ) + """
+%branch_a (p: f64[16,16]) -> f64[16,16] {
+  %p = f64[16,16]{1,0} parameter(0)
+  ROOT %ar = f64[16,16]{1,0} all-reduce(%p), channel_id=7, to_apply=%sum
+}
+"""
+        doctored = doctored.replace(
+            "ENTRY %main_spmd (param: f64[16,16], param.1: f64[16,16]) "
+            "-> f64[16,16] {",
+            "ENTRY %main_spmd (param: f64[16,16], param.1: f64[16,16]) "
+            "-> f64[16,16] {\n"
+            "  %c = f64[16,16]{1,0} conditional(%pred, %param, %param), "
+            "true_computation=%branch_a, false_computation=%branch_a",
+        )
+        audit = lowered.audit_roles(doctored)
+        assert not audit.ok
+        assert any("conditional" in p for p in audit.problems)
+
+    def test_missing_channel_is_rejected(self):
+        doctored = CANNED.replace(", channel_id=1", "")
+        audit = lowered.audit_roles(doctored)
+        assert any("channel_id" in p for p in audit.problems)
+
+    def test_degenerate_permute_pairs_are_rejected(self):
+        doctored = CANNED.replace(
+            "source_target_pairs={{0,1}}",
+            "source_target_pairs={{0,1},{0,0}}",  # 0 sends twice
+        )
+        audit = lowered.audit_roles(doctored)
+        assert any("partial permutation" in p for p in audit.problems)
+
+    def test_donation_audit_names_unaliased_params(self):
+        doctored = CANNED.replace(
+            "input_output_alias={ {0}: (0, {}, may-alias), "
+            "{1}: (1, {}, may-alias) }, ",
+            "",
+        )
+        problems = lowered.audit_donation(doctored, ([1.0], [2.0]), (0, 1))
+        assert problems and "not aliased" in problems[0]
+        # and the intact module passes the same check
+        assert lowered.audit_donation(CANNED, ([1.0], [2.0]), (0, 1)) == []
+
+
+class TestExpectedDonatedParams:
+    def test_pytree_offsets(self):
+        h, u, v, m1, m2 = (object(),) * 5
+        args = (h, (u, v), (m1, m2), 3)
+        # donate h + (u, v): flattened params 0, 1, 2 of 6
+        assert lowered.expected_donated_params(args, (0, 1)) == {0, 1, 2}
+        assert lowered.expected_donated_params(args, ()) == set()
+
+
+class TestDriverAudit:
+    def test_all_three_workloads_clean(self):
+        """The lint.sh acceptance: every workload's steady-state driver
+        lowers to identical per-role collective sequences with every
+        declared donation aliased."""
+        rows = lowered.audit_drivers(local=16)
+        assert [r.workload for r in rows] == [
+            "diffusion/shard", "wave/perf", "swe/perf",
+        ]
+        for r in rows:
+            assert r.ok, (r.workload, r.problems)
+            assert r.num_partitions == 2
+            assert r.n_collectives > 0
+            assert r.donated_params >= 1
+        # SWE donates the full coupled state (h, u, v)
+        assert rows[2].donated_params == 3
+        table = lowered.render_table(rows)
+        assert "ok" in table and "DIVERGENT" not in table

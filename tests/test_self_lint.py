@@ -86,6 +86,57 @@ def test_gl01_catches_deleted_checkpoint_donation_guard():
     assert any("async save" in f.message for f in after)
 
 
+def test_gl08_catches_deleted_uniformity_guard():
+    """The interprocedural acceptance oracle: gather_to_host0's
+    `process_count() == 1` early return is a UNIFORM branch (legal);
+    rewriting it into a rank-dependent exit in front of the
+    process_allgather re-creates the PR-6/PR-7 divergence class — one
+    rank skips a host collective its peers enter — and GL08 must catch
+    it."""
+    path = REPO / "rocm_mpi_tpu" / "parallel" / "gather.py"
+    src = path.read_text()
+    assert "if jax.process_count() == 1:" in src, (
+        "the gather uniformity guard moved — update this oracle"
+    )
+    mutated = src.replace(
+        "if jax.process_count() == 1:",
+        "if jax.process_index() != 0:",
+    )
+    before = [f for f in lint_source(src, str(path))
+              if f.rule == "GL08" and not f.suppressed]
+    after = [f for f in lint_source(mutated, str(path))
+             if f.rule == "GL08" and not f.suppressed]
+    assert before == [], "pristine gather.py must be GL08-clean"
+    assert after, (
+        "a rank-dependent early exit in front of process_allgather must "
+        "re-create the collective-divergence hazard and GL08 must catch "
+        "it"
+    )
+    assert any("rank-dependent" in f.message for f in after)
+
+
+def test_interprocedural_pass_is_active_in_the_gate():
+    """The zero-findings pin must cover the whole-program engine, not
+    just the per-file rules: the gate scope linted WITHOUT the
+    interprocedural pass must be missing the one accepted (suppressed)
+    GL08 verdict the full pass produces — proof lint_paths actually ran
+    the engine."""
+    from rocm_mpi_tpu.analysis.core import lint_paths as _lint_paths
+
+    full, _ = _lint_paths(GATE_SCOPE)
+    per_file_only, _ = _lint_paths(GATE_SCOPE, interprocedural=False)
+    gl08_full = [f for f in full if f.rule == "GL08"]
+    gl08_flat = [f for f in per_file_only if f.rule == "GL08"]
+    assert gl08_full and all(f.suppressed for f in gl08_full), (
+        "the weak_scaling rung sit-out should be the one accepted GL08 "
+        "verdict (suppressed with a why-comment)"
+    )
+    assert gl08_flat == [], (
+        "per-file mode has no engine, so the cross-module verdict must "
+        "vanish — if it fired here the interprocedural pin is vacuous"
+    )
+
+
 def test_gl02_catches_restored_bench_global_mutation():
     path = REPO / "bench.py"
     src = path.read_text()
