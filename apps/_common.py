@@ -397,6 +397,14 @@ def setup_jax(args):
     enable_persistent_cache()
     setup_telemetry(args, jax)
     setup_health(args, jax)
+    # Preemption awareness (resilience.preempt, docs/RESILIENCE.md §7):
+    # arm the SIGTERM grace-deadline handler when the launcher contract
+    # says so (RMT_PREEMPT_GRACE_S, forwarded by spawn_ranks
+    # preempt_grace_s) — cheap no-op otherwise. Installation lives in
+    # resilience/ (a GL07 signal-hygiene owner); this is only the call.
+    from rocm_mpi_tpu.resilience import preempt
+
+    preempt.install_from_env()
     return jax
 
 

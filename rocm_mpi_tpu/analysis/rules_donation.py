@@ -24,6 +24,18 @@ Both are flow-sensitive over a small abstract state (poisoned names +
 in-flight saves); branches merge by union, loop bodies run twice so the
 back edge is observed (the `while step < nt:` save/advance overlap is
 exactly a back-edge bug).
+
+Save-overlap is additionally *interprocedural within the module* (the
+GL08/GL09 playbook): a local helper that calls `.save(...)` on a
+manager parameter and returns without `wait_until_finished()`/`close()`
+on every path gets a summary — "leaves the save of parameter j in
+flight on manager parameter i" — which its call sites replay, so
+`state = advance(state, n)` in the caller is still flagged when the
+save it races lives two helpers down (`run_segmented` →
+`_guarded_save` → `_save_once` in utils/checkpoint.py). Summaries
+reach a fixpoint over the module's top-level defs; a helper whose every
+path waits exports nothing, which is exactly why deleting the wait
+re-creates the finding at the caller's rebind.
 """
 
 from __future__ import annotations
@@ -116,11 +128,32 @@ class _State:
             self.inflight.setdefault(mgr, {}).update(names)
 
 
+def _param_names(fn: ast.FunctionDef) -> list[str]:
+    """Positional parameter names, in call-argument order."""
+    return [a.arg for a in fn.args.posonlyargs + fn.args.args]
+
+
+def _arg_at(call: ast.Call, params: list[str], idx: int):
+    """The Name node bound to positional parameter `idx` at this call
+    site (positionally or by keyword), or None."""
+    if idx < len(call.args):
+        arg = call.args[idx]
+        return arg if isinstance(arg, ast.Name) else None
+    if idx < len(params):
+        for kw in call.keywords:
+            if kw.arg == params[idx] and isinstance(kw.value, ast.Name):
+                return kw.value
+    return None
+
+
 class _FunctionChecker:
-    def __init__(self, rule, ctx: ModuleContext, donating: dict):
+    def __init__(self, rule, ctx: ModuleContext, donating: dict,
+                 summaries: dict | None = None, silent: bool = False):
         self.rule = rule
         self.ctx = ctx
         self.donating = donating
+        self.summaries = summaries or {}
+        self.silent = silent
         self.managers: set[str] = set()
         self.findings: list = []
         self._reported: set[tuple] = set()
@@ -191,6 +224,27 @@ class _FunctionChecker:
                     )
                 elif call.func.attr in ("wait_until_finished", "close"):
                     state.inflight.pop(recv, None)
+        # Interprocedural save effect: a local helper summarized as
+        # leaving saves in flight on a manager parameter replays that
+        # effect here when the call binds a recognized manager to it
+        # (module docstring — run_segmented → _guarded_save →
+        # _save_once is the real chain this covers).
+        if isinstance(call.func, ast.Name) and \
+                call.func.id in self.summaries:
+            params, effects = self.summaries[call.func.id]
+            for mgr_idx, captured in effects.items():
+                mgr_arg = _arg_at(call, params, mgr_idx)
+                if mgr_arg is None or mgr_arg.id not in self.managers:
+                    continue
+                names = set()
+                for i in captured:
+                    arg = _arg_at(call, params, i)
+                    if arg is not None:
+                        names.add(arg.id)
+                if names:
+                    state.inflight.setdefault(mgr_arg.id, {}).update(
+                        {n: call for n in names}
+                    )
 
     # ---- statement traversal ------------------------------------------
 
@@ -302,11 +356,57 @@ class _FunctionChecker:
                 )
 
     def _report(self, node, message, hint) -> None:
+        if self.silent:  # summary computation: effects only, no findings
+            return
         key = (node.lineno, node.col_offset, message)
         if key in self._reported:
             return
         self._reported.add(key)
         self.findings.append(self.ctx.finding(node, self.rule, message, hint))
+
+
+def _save_summaries(ctx: ModuleContext, donating: dict) -> dict:
+    """Fixpoint over the module's top-level defs: func name ->
+    (param_names, {mgr_param_idx: frozenset(captured_param_idxs)}) for
+    every function that can RETURN with a save still in flight on one of
+    its own parameters. Each function is analyzed with every parameter
+    assumed manager-capable — the assumption only matters at call sites
+    that actually bind a recognized manager there — and with the current
+    summaries applied, so the effect propagates through wrapper chains
+    (`_retrying_save` calling `_save_once`). A function whose every path
+    waits/closes exports nothing."""
+    funcs = {
+        n.name: n for n in ctx.tree.body if isinstance(n, ast.FunctionDef)
+    }
+    summaries: dict = {}
+    for _ in range(len(funcs) + 1):
+        changed = False
+        for name, fn in funcs.items():
+            params = _param_names(fn)
+            probe = _FunctionChecker(None, ctx, donating,
+                                     summaries=summaries, silent=True)
+            probe.managers = set(params)
+            state = _State()
+            probe.stmts(fn.body, state)
+            effects: dict = {}
+            for mgr, names_map in state.inflight.items():
+                if mgr not in params:
+                    continue
+                captured = frozenset(
+                    params.index(n) for n in names_map if n in params
+                )
+                if captured:
+                    effects[params.index(mgr)] = captured
+            if effects:
+                entry = (params, effects)
+                if summaries.get(name) != entry:
+                    summaries[name] = entry
+                    changed = True
+            elif summaries.pop(name, None) is not None:
+                changed = True
+        if not changed:
+            break
+    return summaries
 
 
 class DonationSafetyRule(Rule):
@@ -322,6 +422,7 @@ class DonationSafetyRule(Rule):
 
     def check(self, ctx: ModuleContext):
         donating = _collect_donating_callables(ctx.tree)
+        summaries = _save_summaries(ctx, donating)
         scopes: list = [ctx.tree]
         scopes += [
             n for n in ast.walk(ctx.tree)
@@ -329,7 +430,8 @@ class DonationSafetyRule(Rule):
         ]
         findings = []
         for scope in scopes:
-            checker = _FunctionChecker(self, ctx, donating)
+            checker = _FunctionChecker(self, ctx, donating,
+                                       summaries=summaries)
             body = scope.body
             checker.stmts(body, _State())
             findings.extend(checker.findings)

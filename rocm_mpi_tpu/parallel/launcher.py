@@ -113,6 +113,9 @@ def spawn_ranks(
     stall_grace_s: float = 6.0,
     postmortem_grace_s: float = 1.5,
     vanish_grace_s: float | None = None,
+    preempt_grace_s: float | None = None,
+    forward_preempt: bool = False,
+    on_spawn=None,
 ):
     """Spawn `nprocs` ranks of `[sys.executable] + argv` under the RMT_*
     launcher contract; return RankResults of (proc, (stdout, stderr)) in
@@ -141,7 +144,21 @@ def spawn_ranks(
     normal completion skew. This is how a preempted/evicted rank (fault
     kind `die`) is caught without a nonzero rc to scan for; the elastic
     supervisor (resilience.elastic) turns the verdict into a mesh
-    shrink."""
+    shrink.
+
+    `preempt_grace_s` forwards a SIGTERM grace deadline to every rank
+    (RMT_PREEMPT_GRACE_S — resilience.preempt.install_from_env arms the
+    handler; docs/RESILIENCE.md §7): a preempted rank lands one final
+    save at its next segment boundary — if the measured save wall fits
+    the grace — and exits RC_PREEMPTED, which the elastic supervisor
+    classifies as resumable, never a failure. `forward_preempt` makes
+    the LAUNCHER itself preemption-aware: a SIGTERM delivered to this
+    process is relayed to every live rank (handler installation routed
+    through resilience.preempt.install_forwarder — the GL07 owner seam;
+    this module only ever SENDS signals). `on_spawn(procs)` is called
+    once with the Popen list right after all ranks spawn — the elastic
+    rejoin probe uses it to deliver grow-time preemptions; exceptions
+    in the callback are noted, never fatal."""
     port = _free_port()
     base = os.environ.copy()
     # Ranks size their own device count (--cpu-devices); an inherited
@@ -167,6 +184,8 @@ def spawn_ranks(
         )
         if inject_fault:
             env["RMT_INJECT_FAULT"] = inject_fault
+        if preempt_grace_s is not None:
+            env["RMT_PREEMPT_GRACE_S"] = str(preempt_grace_s)
         if telemetry_dir:
             os.makedirs(telemetry_dir, exist_ok=True)
             env["RMT_TELEMETRY"] = "1"
@@ -213,6 +232,18 @@ def spawn_ranks(
     outs: list = [None] * nprocs
     report = LaunchReport()
     done = threading.Event()
+    if on_spawn is not None:
+        try:
+            on_spawn(list(procs))
+        except Exception as exc:  # noqa: BLE001 — a probe must not kill a launch
+            report.note(f"on_spawn callback failed: {exc!r}")
+    restore_forwarder = None
+    if forward_preempt:
+        # The SIGTERM relay: handler INSTALLATION lives in resilience/
+        # (a GL07 signal-hygiene owner); the launcher only sends.
+        from rocm_mpi_tpu.resilience import preempt as _preempt
+
+        restore_forwarder = _preempt.install_forwarder(procs)
 
     def drain(i: int, p) -> None:
         # Any failure records SOMETHING into outs[i]: callers unpack
@@ -391,6 +422,8 @@ def spawn_ranks(
             t.join()
     finally:
         done.set()
+        if restore_forwarder is not None:
+            restore_forwarder()
         for p in procs:
             if p.poll() is None:
                 p.kill()

@@ -7,16 +7,27 @@ The layer spans three levels, matching where failure actually strikes:
   segmented checkpointed advance (crash → restore latest VALID step);
 * `utils.checkpoint` — per-save integrity manifests +
   `latest_valid_step` fallback (torn/corrupt checkpoints are skipped,
-  never restored);
+  never restored), plus the storage-fault plane: per-save retry/backoff,
+  ENOSPC keep-list pruning, the slow-write watchdog, and degraded
+  skip-save-and-continue mode (docs/RESILIENCE.md §7);
 * `faults` — deterministic fault injection (crash/kill/die/truncate/
-  delay/stall at exact steps), wired through `run_segmented`, the
+  delay/stall at exact steps, plus the storage kinds io-error/io-slow/
+  enospc at save attempts), wired through `run_segmented`, the
   launcher, and the apps' `--inject-fault` flag, so every recovery path
   above is exercised by tests (tests/test_resilience.py), not just by
   outages;
+* `preempt` — scheduler-eviction awareness: the SIGTERM grace-deadline
+  handler, the emergency-save budget call, and the RC_PREEMPTED exit
+  every supervisor upstack classifies as resumable (docs/RESILIENCE.md
+  §7);
 * `elastic.run_elastic` — launcher-level TOPOLOGY supervision: when a
   rank dies for good (watchdog kill, vanish, nonzero rc), shrink to the
   largest valid sub-mesh and resume from the latest valid step instead
-  of aborting (docs/RESILIENCE.md "Elastic recovery");
+  of aborting; when recovered devices rejoin the budget, preempt-and-
+  grow back onto the largest valid larger mesh (docs/RESILIENCE.md
+  "Elastic recovery" and §7);
+* `policy.ElasticPolicy` — the pluggable shrink/grow/give-up decision
+  table with grow hysteresis, injectable by the future serving layer;
 * `reshard` — the topology-portability substrate: checkpoint manifest
   metadata (mesh dims + per-leaf partition specs), restore-template
   planning for the current device set, and the host gather/scatter slab
@@ -34,6 +45,11 @@ from rocm_mpi_tpu.resilience.faults import (  # noqa: F401
     fault_point,
     install,
     install_from_env,
+)
+from rocm_mpi_tpu.resilience.policy import ElasticPolicy  # noqa: F401
+from rocm_mpi_tpu.resilience.preempt import (  # noqa: F401
+    RC_PREEMPTED,
+    Preempted,
 )
 from rocm_mpi_tpu.resilience.supervisor import (  # noqa: F401
     default_retryable,

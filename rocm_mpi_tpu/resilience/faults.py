@@ -40,8 +40,33 @@ rank):
                             stalled rank stops bumping its flight
                             recorder while its peers advance and then
                             wedge behind it
+    io-error@step=K         raise OSError(EIO) at the step-K save
+                            attempt — the flaky-storage analog the
+                            checkpoint retry/backoff and degraded mode
+                            defend (docs/RESILIENCE.md §7). Fires at the
+                            "save" site by default (see below)
+    io-slow=S@step=K        sleep S seconds inside the step-K save
+                            attempt (default 2.0 s when the duration is
+                            omitted) — trips the slow-write watchdog
+                            (StoragePolicy.slow_save_timeout_s) without
+                            failing the save
+    enospc@step=K           raise OSError(ENOSPC) at the step-K save
+                            attempt — exercises the keep-list pruning
+                            path before the save gives up
 
-Any clause may be rank-scoped with `rank=R`:
+Storage kinds re-fire per ATTEMPT: the save retry loop re-runs the
+"save" fault point, so a clause with `times=N` (see below) can defeat N
+attempts — `io-error@step=8,times=3` exhausts a 2-retry save and drives
+the run into degraded mode, while the default times=1 makes the FIRST
+retry succeed (the transient-flap drill). An outage spanning several
+saves is several clauses: `io-error@step=8,times=3;io-error@step=12,
+times=3`. NOTE the SPMD hazard: a save is collective — storage clauses
+in multi-rank drills should stay UNSCOPED (every rank injects the same
+decision at the same step) so no rank enters a save barrier its peers
+skipped; rank= scoping of storage kinds is for single-rank drills.
+
+Any clause may be re-armed with `times=N` (fire up to N times instead
+of the default once) and rank-scoped with `rank=R`:
 
     kill@step=4,rank=1      only process R injects (other ranks run clean)
 
@@ -84,10 +109,23 @@ Instrumented fault points:
                  watchdog drill relies on (docs/TELEMETRY.md)
     "step"     — parallel/halo.HostStagedStepper.run, before each
                  host-staged step (step = 1-based step index)
+    "save"     — utils/checkpoint, inside every save ATTEMPT (retries
+                 re-fire it) before orbax writes anything, so an
+                 injected failure never leaves a partial step dir
+                 (step = the step being saved). OPT-IN like
+                 segment-pre — it shares step numbering with the
+                 adjacent segment sites, and an unscoped legacy clause
+                 must keep firing where it always fired; the storage
+                 kinds (io-error / io-slow / enospc) default to
+                 `at=save` when no site is given
+    "restore"  — utils/checkpoint.restore_state, before each restore
+                 attempt (step = the step being restored). OPT-IN for
+                 the same reason
 """
 
 from __future__ import annotations
 
+import errno
 import os
 import time
 
@@ -98,7 +136,12 @@ ENV_VAR = "RMT_INJECT_FAULT"
 # Sites that only fire for clauses explicitly scoped there (at=SITE):
 # they share step numbering with an adjacent legacy site, and an
 # unscoped clause must keep firing at the legacy one.
-OPTIN_SITES = frozenset({"segment-pre"})
+OPTIN_SITES = frozenset({"segment-pre", "save", "restore"})
+
+# Storage-fault kinds: they only make sense at an IO attempt, so a
+# clause with no at= clause is pinned to the "save" site at parse time.
+IO_KINDS = frozenset({"io-error", "io-slow", "enospc"})
+IO_SLOW_DEFAULT_S = 2.0
 
 
 class InjectedCrash(RuntimeError):
@@ -107,16 +150,17 @@ class InjectedCrash(RuntimeError):
 
 class FaultClause:
     __slots__ = ("kind", "step", "segment", "rank", "delay_s", "site",
-                 "fires")
+                 "times", "fires")
 
     def __init__(self, kind, step=None, segment=None, rank=None,
-                 delay_s=0.0, site=None):
+                 delay_s=0.0, site=None, times=None):
         self.kind = kind
         self.step = step
         self.segment = segment
         self.rank = rank
         self.delay_s = delay_s
         self.site = site
+        self.times = times  # None = the plan's MAX_FIRES default
         self.fires = 0
 
     def __repr__(self):
@@ -129,6 +173,8 @@ class FaultClause:
             parts.append(f"rank={self.rank}")
         if self.site is not None:
             parts.append(f"at={self.site}")
+        if self.times is not None:
+            parts.append(f"times={self.times}")
         if self.delay_s:
             parts.append(f"delay={self.delay_s}")
         return f"FaultClause({', '.join(parts)})"
@@ -142,8 +188,13 @@ def _parse_clause(raw: str) -> FaultClause:
     if kind.startswith("delay="):
         delay_s = float(kind[len("delay="):])
         kind = "delay"
+    elif kind.startswith("io-slow="):
+        delay_s = float(kind[len("io-slow="):])
+        kind = "io-slow"
+    elif kind == "io-slow":
+        delay_s = IO_SLOW_DEFAULT_S
     if kind not in ("crash", "kill", "die", "truncate-latest", "delay",
-                    "stall"):
+                    "stall") and kind not in IO_KINDS:
         raise ValueError(f"unknown fault kind {kind!r} in {raw!r}")
     clause = FaultClause(kind, delay_s=delay_s)
     triggers = [t for t in [trigger.strip()] + mods if t]
@@ -158,9 +209,18 @@ def _parse_clause(raw: str) -> FaultClause:
             clause.rank = int(val)
         elif key == "at":
             clause.site = val.strip()
+        elif key == "times":
+            clause.times = int(val)
+            if clause.times < 1:
+                raise ValueError(f"times must be >= 1 in {raw!r}")
         else:
             raise ValueError(f"unknown fault trigger {t!r} in {raw!r}")
-    if kind in ("crash", "kill", "die", "delay", "stall") \
+    if kind in IO_KINDS and clause.site is None:
+        # Storage faults strike IO attempts; without an explicit at=
+        # they pin to the save site (the one every drill wants).
+        clause.site = "save"
+    if (kind in ("crash", "kill", "die", "delay", "stall")
+            or kind in IO_KINDS) \
             and clause.step is None and clause.segment is None:
         raise ValueError(
             f"{kind} fault needs a step=K or segment=N trigger: {raw!r}"
@@ -273,7 +333,7 @@ def fault_point(name: str, step=None, directory=None) -> None:
         plan._segments_seen += 1
     rank = _rank()
     for clause in plan.clauses:
-        if clause.fires >= plan.MAX_FIRES:
+        if clause.fires >= (clause.times or plan.MAX_FIRES):
             continue
         if clause.rank is not None and clause.rank != rank:
             continue
@@ -296,6 +356,22 @@ def fault_point(name: str, step=None, directory=None) -> None:
         clause.fires += 1
         if clause.kind == "delay":
             time.sleep(clause.delay_s)
+        elif clause.kind == "io-error":
+            raise OSError(
+                errno.EIO,
+                f"injected io-error at fault point {name!r} "
+                f"(step={step}, rank={rank})",
+            )
+        elif clause.kind == "io-slow":
+            # Inside the save attempt's measured wall: the slow-write
+            # watchdog (StoragePolicy.slow_save_timeout_s) sees it.
+            time.sleep(clause.delay_s)
+        elif clause.kind == "enospc":
+            raise OSError(
+                errno.ENOSPC,
+                f"injected enospc at fault point {name!r} "
+                f"(step={step}, rank={rank})",
+            )
         elif clause.kind == "stall":
             # The wedged rank: a pure-Python monotonic busy-wait that
             # never exits. Deliberately NOT a sleep — the interpreter

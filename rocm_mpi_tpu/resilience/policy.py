@@ -1,0 +1,95 @@
+"""Elastic topology policy: the shrink/grow/give-up decisions, pluggable
+(docs/RESILIENCE.md §7).
+
+PR 6 hard-coded the elastic supervisor's one decision — shrink to the
+largest valid sub-mesh of the survivors, give up at `min_ranks`. Growth
+makes the decision space real: when recovered devices rejoin the budget
+mid-run, SHOULD the run pay a checkpoint-and-relaunch to use them? That
+is a policy question (a run 2 segments from completion should not; a
+serving layer may want to steal the devices for another tenant
+instead), so the decisions live in this object and `run_elastic` only
+executes them. The future serving layer (ROADMAP item 1) injects its
+own subclass; the default encodes the single-tenant answer: always
+shrink to survive, grow whenever the budget allows and hysteresis
+agrees, give up below `min_ranks`.
+
+Hysteresis: topology changes are expensive (a checkpoint, a relaunch, a
+recompile), so `min_grow_interval_steps` refuses a grow until the run
+has advanced that many steps past the LAST topology change — a flapping
+device that joins and dies every few seconds must not convert the run
+into a relaunch loop. Growth happens only at segment boundaries by
+construction: the grow path preempts the running ranks (SIGTERM,
+resilience.preempt), and the preemption check lives at the segmented
+loop's boundaries — there is no other place a rank can exit with a
+durable, resumable step.
+
+Shrink takes precedence over grow: a launch that FAILED (dead rank,
+watchdog verdict, vanish) re-plans for the survivors even when the
+nominal budget says more devices exist — the budget's claim is exactly
+what the dead rank just disproved. Growth is only considered from a
+healthy state: a completed-preempted launch, or the live rejoin probe.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class ElasticPolicy:
+    """Decision table for `resilience.elastic.run_elastic`.
+
+    `min_ranks` — below this, a failure raises ElasticExhausted.
+    `grow` — master switch for elastic growth (the rejoin probe and the
+        post-preemption re-plan both consult it).
+    `min_grow_interval_steps` — hysteresis: steps that must pass after a
+        topology change before a grow is considered. 0 = any new
+        segment boundary. When the current step is unknowable (no
+        checkpoint_dir), a nonzero interval refuses the grow —
+        hysteresis that cannot be evaluated must fail closed.
+    `grow_poll_s` — rejoin-probe cadence while a launch is live.
+    `max_preempt_resumes` — bound on preempted-relaunch cycles (an
+        external SIGTERM storm must not loop forever).
+    """
+
+    min_ranks: int = 1
+    grow: bool = True
+    min_grow_interval_steps: int = 0
+    grow_poll_s: float = 1.0
+    max_preempt_resumes: int = 8
+
+    def give_up(self, nprocs: int) -> bool:
+        """A launch failed at `nprocs`: is there anywhere left to go?"""
+        return nprocs <= self.min_ranks
+
+    def shrink_target(self, nprocs: int, dead_count: int,
+                      plan_ranks) -> int:
+        """Rank count after a failure that killed `dead_count` ranks:
+        the largest valid mesh over the SURVIVORS (never n-1 — a launch
+        that lost two pods must not re-plan for a budget including one
+        of them), floored at min_ranks. `plan_ranks(budget) -> int`
+        maps a device budget to the largest valid mesh's rank count
+        (identity when no global shape constrains it)."""
+        budget = nprocs - max(dead_count, 1)
+        return max(plan_ranks(max(budget, 1)), self.min_ranks)
+
+    def wants_grow(self, nprocs: int, budget: int, *,
+                   step: int | None = None,
+                   last_change_step: int | None = None) -> bool:
+        """Should the run grow onto `budget` devices? True only when
+        growth is on, the budget actually exceeds the running rank
+        count, and the hysteresis interval has provably passed."""
+        if not self.grow or budget <= nprocs:
+            return False
+        if self.min_grow_interval_steps <= 0:
+            return True
+        if step is None:
+            return False  # interval unknowable: fail closed
+        since = last_change_step if last_change_step is not None else 0
+        return step - since >= self.min_grow_interval_steps
+
+    def grow_target(self, nprocs: int, budget: int, plan_ranks) -> int:
+        """Rank count a grow relaunches on: the largest valid mesh
+        within `budget`. May equal `nprocs` (budget grew but no bigger
+        mesh tiles the grid) — the caller treats that as no grow."""
+        return max(plan_ranks(max(budget, 1)), nprocs)

@@ -26,9 +26,11 @@
         (docs/TELEMETRY.md "Health plane"): step counter, step rate,
         current phase, phase age, delta vs the cross-rank median. When
         the elastic supervisor left an elastic.jsonl sidecar in DIR
-        (docs/RESILIENCE.md "Elastic recovery"), the header shows the
-        CURRENT mesh shape and a SHRUNK badge for runs that resumed on
-        fewer ranks. Curses-free — redraws in place on a TTY, appends
+        (docs/RESILIENCE.md "Elastic recovery" and §7), the header shows
+        the CURRENT mesh shape plus SHRUNK / GROWN badges for runs that
+        changed topology, and a STORAGE DEGRADED indicator when the
+        ckpt_* heartbeat counters say a rank is skipping saves through a
+        storage outage. Curses-free — redraws in place on a TTY, appends
         snapshots otherwise. Exit 0 after N iterations (default: run
         until ^C), 2 when DIR has no heartbeat sidecars to watch.
 
@@ -180,14 +182,23 @@ def _cmd_monitor(args) -> int:
                   f"({len(beats)} rank(s), poll {args.interval:g}s)")
             # Elastic runs (resilience.elastic) leave an elastic.jsonl
             # next to the sidecars: surface the current mesh and the
-            # SHRUNK badge — an operator must see at a glance that this
-            # run is no longer on the mesh it started with.
+            # SHRUNK / GROWN badges — an operator must see at a glance
+            # that this run is no longer on the mesh it started with.
             elastic_events, _ = health.load_elastic_events(args.dir)
             elastic_line = health.format_elastic_status(
                 health.elastic_status(elastic_events)
             )
             if elastic_line:
                 print(elastic_line)
+            # Degraded checkpoint storage (docs/RESILIENCE.md §7): the
+            # segmented loop keeps computing through an outage, so the
+            # ONLY place an operator sees the widening loss window is
+            # here — the ckpt_* heartbeat counters each boundary bumps.
+            storage_line = health.format_storage_status(
+                health.storage_status(beats)
+            )
+            if storage_line:
+                print(storage_line)
             print(health.format_monitor(rows, skipped))
             sys.stdout.flush()
             i += 1

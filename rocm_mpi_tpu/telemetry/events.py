@@ -219,14 +219,17 @@ def gauge(name: str, value, **attrs) -> dict | None:
 
 
 def record_event(name: str, *, attempt=None, step=None, wait_s=None,
-                 error=None) -> dict:
+                 error=None, **attrs) -> dict:
     """One structured run event (retry, restore, give-up…) — the PR-1
     resilience schema, now versioned and monotonic-stamped.
 
     Always buffered in-process (the `metrics.events()` contract); written
     to the rank stream when telemetry is enabled; best-effort teed to
     RMT_EVENT_LOG in the legacy line shape for existing tooling
-    (docs/RESILIENCE.md §2).
+    (docs/RESILIENCE.md §2). Extra keyword attrs (the storage-fault and
+    preemption records carry reasons, deadlines, pruned-step lists —
+    docs/RESILIENCE.md §7) ride flat in the record, None-valued ones
+    dropped like the named fields.
     """
     payload = {
         k: v
@@ -234,6 +237,7 @@ def record_event(name: str, *, attempt=None, step=None, wait_s=None,
                      ("wait_s", wait_s), ("error", error))
         if v is not None
     }
+    payload.update({k: v for k, v in attrs.items() if v is not None})
     rec = emit("event", name, buffer_always=True, **payload)
     legacy_path = os.environ.get("RMT_EVENT_LOG")
     if legacy_path:

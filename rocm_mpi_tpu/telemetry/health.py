@@ -183,8 +183,9 @@ class ProgressWatch:
 # cannot ride a rank's telemetry stream. They land in one append-only
 # `elastic.jsonl` sidecar next to the heartbeat sidecars, written here
 # (telemetry owns the clock reads — GL06) and read back by the monitor
-# verb, which shows the current mesh shape and a SHRUNK badge for runs
-# that resumed on fewer ranks. scripts/lint.sh schema-checks the records
+# verb, which shows the current mesh shape plus SHRUNK / GROWN badges
+# for runs that changed topology (and a PREEMPTED marker for a whole-job
+# eviction). scripts/lint.sh schema-checks the records
 # (regress.check_schema) wherever they get archived.
 
 ELASTIC_SCHEMA = "rocm_mpi_tpu.resilience.elastic"
@@ -239,12 +240,16 @@ def load_elastic_events(directory) -> tuple[list[dict], int]:
 
 def elastic_status(events: list[dict]) -> dict | None:
     """The monitor's one-line view of the elastic record: current mesh
-    dims, rank count, whether the run ever SHRANK (and from what). None
+    dims, rank count, whether the run ever SHRANK (and from what) or
+    GREW (and onto what), and whether the whole job was preempted. None
     when there are no elastic events (non-elastic run: no badge)."""
     mesh = None
     nprocs = None
     first_mesh = None
+    grow_mesh = None
     shrinks = 0
+    grows = 0
+    preempted = False
     for e in events:
         name = e.get("name")
         if name == "elastic.launch":
@@ -258,6 +263,15 @@ def elastic_status(events: list[dict]) -> dict | None:
             nprocs = e.get("new_nprocs", nprocs)
             if first_mesh is None:
                 first_mesh = e.get("old_mesh")
+        elif name == "elastic.grow":
+            grows += 1
+            mesh = e.get("new_mesh") or mesh
+            grow_mesh = e.get("new_mesh") or grow_mesh
+            nprocs = e.get("new_nprocs", nprocs)
+            if first_mesh is None:
+                first_mesh = e.get("old_mesh")
+        elif name == "elastic.preempted":
+            preempted = True
     if mesh is None and nprocs is None:
         return None
     return {
@@ -265,6 +279,10 @@ def elastic_status(events: list[dict]) -> dict | None:
         "nprocs": nprocs,
         "shrunk": shrinks > 0,
         "shrinks": shrinks,
+        "grown": grows > 0,
+        "grows": grows,
+        "grow_mesh": grow_mesh,
+        "preempted": preempted,
         "first_mesh": first_mesh,
     }
 
@@ -282,7 +300,11 @@ def _mesh_str(mesh) -> str | None:
 def format_elastic_status(status: dict | None) -> str | None:
     """`mesh (2, 1)  2 rank(s)` — plus the SHRUNK badge once a shrink
     happened: `mesh (1, 1)  1 rank(s)  [SHRUNK from (2, 1), 1
-    shrink(s)]`. Mesh fragments are omitted when the events carry no
+    shrink(s)]`, the mirror GROWN badge once a grow happened
+    (`[GROWN to (2, 1), 1 grow(s)]` — both can show: a run that shrank
+    and grew back carries its whole topology history), and
+    `[PREEMPTED — resumable]` when the supervisor recorded a whole-job
+    eviction. Mesh fragments are omitted when the events carry no
     dims."""
     if not status:
         return None
@@ -301,7 +323,59 @@ def format_elastic_status(status: dict | None) -> str | None:
         parts.append(
             f"[SHRUNK {origin}, {status['shrinks']} shrink(s)]"
         )
+    if status.get("grown"):
+        grow_s = _mesh_str(status.get("grow_mesh"))
+        target = (
+            f"to {grow_s}" if grow_s is not None
+            else "to more ranks"
+        )
+        parts.append(
+            f"[GROWN {target}, {status['grows']} grow(s)]"
+        )
+    if status.get("preempted"):
+        parts.append("[PREEMPTED — resumable]")
     return "  ".join(parts) if parts else None
+
+
+def storage_status(beats: dict[int, dict]) -> dict | None:
+    """The degraded-storage view the monitor renders next to the elastic
+    badges, computed from the heartbeat progress counters the segmented
+    loop bumps alongside its `ckpt.degraded`/`ckpt.recovered` telemetry
+    events (utils.checkpoint._guarded_save): a rank is degraded NOW when
+    it entered degraded mode more times than it recovered. None when no
+    rank ever degraded (the common case: no indicator at all)."""
+    degraded_ranks = []
+    skipped = 0
+    for rank, doc in sorted(beats.items()):
+        counters = doc.get("counters") or {}
+        skipped += int(counters.get("ckpt_skipped", 0) or 0)
+        entered = int(counters.get("ckpt_degraded", 0) or 0)
+        recovered = int(counters.get("ckpt_recovered", 0) or 0)
+        if entered > recovered:
+            degraded_ranks.append(rank)
+    if not degraded_ranks and not skipped:
+        return None
+    return {
+        "degraded": bool(degraded_ranks),
+        "degraded_ranks": degraded_ranks,
+        "skipped": skipped,
+    }
+
+
+def format_storage_status(status: dict | None) -> str | None:
+    """`[STORAGE DEGRADED rank(s) 0,1 — 3 skipped save(s)]` while an
+    outage is live; once every rank recovered, the quieter
+    `storage recovered (3 skipped save(s))` keeps the loss window
+    visible. None when checkpointing never degraded."""
+    if not status:
+        return None
+    if status["degraded"]:
+        ranks = ",".join(str(r) for r in status["degraded_ranks"])
+        return (
+            f"[STORAGE DEGRADED rank(s) {ranks} — "
+            f"{status['skipped']} skipped save(s)]"
+        )
+    return f"storage recovered ({status['skipped']} skipped save(s))"
 
 
 # ---------------------------------------------------------------------------

@@ -221,18 +221,45 @@ def _validate_classified(doc: dict, kind: str) -> list[str]:
 def _validate_elastic_record(doc: dict) -> list[str]:
     """elastic.jsonl record validation (telemetry.health owns the
     format; resilience.elastic writes it): every record names its event
-    and is wall-stamped; a shrink must carry the old→new rank counts the
-    monitor's SHRUNK badge is computed from."""
+    and is wall-stamped; a shrink or grow must carry the old→new rank
+    counts the monitor's SHRUNK / GROWN badges are computed from."""
     problems = []
     name = doc.get("name")
     if not isinstance(name, str) or not name.startswith("elastic."):
         problems.append(f"elastic record name {name!r} (want elastic.*)")
     if not isinstance(doc.get("t"), (int, float)):
         problems.append("elastic record missing wall stamp t")
-    if name == "elastic.shrink":
+    if name in ("elastic.shrink", "elastic.grow"):
         for key in ("old_nprocs", "new_nprocs"):
             if not isinstance(doc.get(key), int):
-                problems.append(f"elastic.shrink missing {key}")
+                problems.append(f"{name} missing {key}")
+    return problems
+
+
+# Event families whose archived records carry committed inner structure
+# (docs/RESILIENCE.md §7): the preemption decision trail and the
+# storage-fault plane. Validated wherever a telemetry JSONL stream gets
+# banked (chip_watcher archives rank streams per burst) — a drifted
+# writer must fail here, not as an unreadable loss-window audit after
+# the next real eviction/outage.
+_GUARDED_EVENT_PREFIXES = ("preempt.", "ckpt.")
+
+
+def _validate_event_record(doc: dict) -> list[str]:
+    """Telemetry "event"-kind records for the preempt.* / ckpt.*
+    families: every one is anchored to the segment boundary that decided
+    it (an int `step`); a `ckpt.degraded` additionally names its reason
+    — the field the loss-window audit groups on."""
+    name = doc.get("name")
+    if not isinstance(name, str) or not name.startswith(
+        _GUARDED_EVENT_PREFIXES
+    ):
+        return []
+    problems = []
+    if not isinstance(doc.get("step"), int):
+        problems.append(f"{name} event missing int step")
+    if name == "ckpt.degraded" and not isinstance(doc.get("reason"), str):
+        problems.append("ckpt.degraded event missing reason")
     return problems
 
 
@@ -272,6 +299,9 @@ def check_schema(paths) -> list[str]:
                     continue
                 if doc.get("schema") == ELASTIC_SCHEMA:
                     for p in _validate_elastic_record(doc):
+                        problems.append(f"{raw}:{i}: {p}")
+                elif doc.get("kind") == "event":
+                    for p in _validate_event_record(doc):
                         problems.append(f"{raw}:{i}: {p}")
         else:
             try:

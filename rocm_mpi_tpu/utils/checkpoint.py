@@ -62,16 +62,51 @@ global shape, dtype) raises TopologyMismatch — a clear refusal instead
 of an orbax shape error. v1 manifests (pre-metadata) keep restoring
 with a caller template, with a warning; a v2 manifest whose metadata
 fails validation is treated as corrupt (latest_valid_step skips it).
+
+Storage-fault plane (docs/RESILIENCE.md §7): checkpoint storage is the
+one dependency this framework cannot supervise away — it flakes
+(transient EIO on a network filesystem), it crawls (a throttled volume
+turning every save into a multi-second stall), and it fills (ENOSPC).
+Every save here runs under a `StoragePolicy`:
+
+* transient `OSError`s get bounded retry + exponential backoff, each
+  attempt visible as a `ckpt.retry` telemetry event;
+* `ENOSPC` first prunes the keep-list (oldest kept steps beyond the
+  newest one are deleted, `ckpt.enospc-prune`) and retries;
+* a save that completes but exceeds `slow_save_timeout_s` trips the
+  slow-write watchdog;
+* when retries are exhausted (or the watchdog trips), `run_segmented`
+  enters DEGRADED mode instead of crashing: compute continues, each
+  boundary makes one cheap probe attempt (success exits degraded mode
+  with `ckpt.recovered`; failure emits `ckpt.degraded` and skips), so a
+  storage outage costs checkpoints — bounded by the last pre-outage
+  valid step — never the run. The standalone `save_state` keeps the
+  loud contract (retries, then raise); degraded mode is the segmented
+  loop's, where "keep computing" is a meaningful alternative.
+
+The same loop is preemption-aware (resilience.preempt): at every
+segment boundary it polls for a SIGTERM grace deadline and either lands
+one final save (if the measured p90 save wall fits the remaining grace)
+or skips it — never starting a save the scheduler would SIGKILL
+mid-write — then exits RC_PREEMPTED, which every supervisor upstack
+classifies as resumable.
 """
 
 from __future__ import annotations
 
+import collections
+import dataclasses
+import errno
 import json
+import os
 import pathlib
+import shutil
+import time
 import zlib
 
 from rocm_mpi_tpu.telemetry import enabled as _telemetry_enabled
 from rocm_mpi_tpu.telemetry import flight as _flight
+from rocm_mpi_tpu.telemetry import record_event as _record_event
 from rocm_mpi_tpu.telemetry import span
 
 
@@ -102,6 +137,279 @@ class TopologyMismatch(ValueError):
     asked of a checkpoint with no topology metadata. A ValueError on
     purpose: this is a configuration error that reproduces identically —
     the supervisor must surface it, never retry it."""
+
+
+# ---------------------------------------------------------------------------
+# Storage-fault plane (docs/RESILIENCE.md §7)
+# ---------------------------------------------------------------------------
+
+_FALSY = ("0", "off", "false", "no", "")
+
+DEFAULT_SAVE_RETRIES = 2
+DEFAULT_SAVE_BACKOFF_S = 0.25
+DEFAULT_BACKOFF_FACTOR = 2.0
+DEFAULT_RESTORE_RETRIES = 2
+
+# Recent save walls (monotonic-diff seconds), feeding save_wall_p90():
+# the preemption deadline call needs to know what a save COSTS before
+# betting the remaining grace on one.
+_SAVE_WALLS: collections.deque = collections.deque(maxlen=32)
+
+
+def save_wall_p90() -> float | None:
+    """Interpolating p90 of the recent save walls this process measured
+    (None with no history) — the preemption emergency-save budget."""
+    if not _SAVE_WALLS:
+        return None
+    vals = sorted(_SAVE_WALLS)
+    if len(vals) == 1:
+        return vals[0]
+    pos = 0.9 * (len(vals) - 1)
+    lo = int(pos)
+    frac = pos - lo
+    hi = min(lo + 1, len(vals) - 1)
+    return vals[lo] * (1 - frac) + vals[hi] * frac
+
+
+@dataclasses.dataclass
+class StoragePolicy:
+    """How a save responds to a misbehaving filesystem. The defaults
+    harden every caller (bounded retries, degrade instead of crash in
+    the segmented loop); `from_env` lets a launcher forward the policy
+    to ranks without new plumbing (RMT_CKPT_* vars)."""
+
+    retries: int = DEFAULT_SAVE_RETRIES
+    backoff_s: float = DEFAULT_SAVE_BACKOFF_S
+    backoff_factor: float = DEFAULT_BACKOFF_FACTOR
+    slow_save_timeout_s: float | None = None
+    degrade: bool = True  # run_segmented only: skip-save-and-continue
+    probe_every: int = 1  # degraded mode: attempt every Nth boundary
+    sleep: object = time.sleep  # injectable for tests
+
+    @classmethod
+    def from_env(cls) -> "StoragePolicy":
+        def _num(name, cast, default):
+            raw = os.environ.get(name, "").strip()
+            if not raw:
+                return default
+            try:
+                return cast(raw)
+            except ValueError:
+                return default
+
+        return cls(
+            retries=_num("RMT_CKPT_RETRIES", int, DEFAULT_SAVE_RETRIES),
+            backoff_s=_num("RMT_CKPT_BACKOFF_S", float,
+                           DEFAULT_SAVE_BACKOFF_S),
+            slow_save_timeout_s=_num("RMT_CKPT_SLOW_S", float, None),
+            degrade=os.environ.get("RMT_CKPT_DEGRADE", "1").lower()
+            not in _FALSY,
+            probe_every=max(_num("RMT_CKPT_PROBE_EVERY", int, 1), 1),
+        )
+
+
+class _StorageState:
+    """Cross-save bookkeeping for one run_segmented loop: whether the
+    run is in degraded (skip-save-and-continue) mode, how many saves the
+    outage has cost, and the last step known durable on disk."""
+
+    def __init__(self, last_durable=None):
+        self.degraded = False
+        self.skipped = 0
+        self.boundaries_degraded = 0
+        self.last_durable = last_durable
+
+
+def _clean_partial_save(directory, step) -> None:
+    """Remove a step dir a failed save attempt may have left: a torn
+    step without a manifest is invisible to latest_valid_step, but it
+    would make the retry's orbax save collide with the leftovers."""
+    step_dir = _step_dir(directory, step)
+    if step_dir.exists() and not _manifest_path(directory, step).is_file():
+        shutil.rmtree(step_dir, ignore_errors=True)
+
+
+def _prune_for_space(directory) -> list:
+    """ENOSPC response: delete every kept checkpoint step EXCEPT the
+    newest valid one (plus its manifest) to make room for the incoming
+    save — an old checkpoint is worth strictly less than landing a new
+    one, but the newest valid step must survive in case the retry fails
+    too. Returns the pruned step numbers."""
+    root = pathlib.Path(directory)
+    if not root.is_dir():
+        return []
+    step_dirs = sorted(
+        (d for d in root.iterdir() if d.is_dir() and d.name.isdigit()),
+        key=lambda d: int(d.name),
+    )
+    keep_newest = None
+    for d in reversed(step_dirs):
+        ok, _ = _verify_step(directory, int(d.name))
+        if ok:
+            keep_newest = int(d.name)
+            break
+    pruned = []
+    for d in step_dirs:
+        step = int(d.name)
+        if step == keep_newest:
+            continue
+        shutil.rmtree(d, ignore_errors=True)
+        _manifest_path(directory, step).unlink(missing_ok=True)
+        pruned.append(step)
+    return pruned
+
+
+def _save_once(mgr, directory, step, state) -> float:
+    """One save ATTEMPT: fault point, orbax save-and-wait, manifest,
+    stale-manifest prune. Returns the measured wall (seconds); raises
+    OSError on an injected/real storage failure. The wall is recorded
+    into the p90 history only for completed saves."""
+    import orbax.checkpoint as ocp
+
+    from rocm_mpi_tpu.resilience import faults
+
+    t0 = time.monotonic()
+    faults.fault_point("save", step=step, directory=directory)
+    mgr.save(step, args=ocp.args.StandardSave(state))
+    mgr.wait_until_finished()
+    write_manifest(directory, step, state)
+    _prune_stale_manifests(directory)
+    wall = time.monotonic() - t0
+    _SAVE_WALLS.append(wall)
+    return wall
+
+
+def _retrying_save(mgr, directory, step, state, policy: StoragePolicy,
+                   log=None) -> float:
+    """Save with the policy's bounded retry + backoff and ENOSPC
+    pruning. Returns the final attempt's wall; raises the last OSError
+    when every attempt failed (the caller decides whether that means
+    degrade or crash). Every decision is a telemetry event."""
+    attempt = 0
+    pruned = False
+    while True:
+        try:
+            return _save_once(mgr, directory, step, state)
+        except OSError as exc:
+            _clean_partial_save(directory, step)
+            err = f"{type(exc).__name__}: {exc}"
+            if getattr(exc, "errno", None) == errno.ENOSPC and not pruned:
+                pruned = True
+                freed = _prune_for_space(directory)
+                _record_event("ckpt.enospc-prune", step=int(step),
+                              pruned_steps=freed)
+                if log is not None:
+                    log(f"checkpoint step {step}: ENOSPC — pruned kept "
+                        f"step(s) {freed} to make room, retrying")
+                if freed:
+                    continue  # space freed: retry without burning an attempt
+            if attempt >= policy.retries:
+                raise
+            wait = policy.backoff_s * policy.backoff_factor**attempt
+            _record_event("ckpt.retry", step=int(step), attempt=attempt,
+                          wait_s=wait, error=err)
+            if log is not None:
+                log(f"checkpoint step {step}: save attempt {attempt} "
+                    f"failed ({err}); retrying in {wait:.2f}s")
+            policy.sleep(wait)
+            attempt += 1
+
+
+def _guarded_save(mgr, directory, step, state, policy: StoragePolicy,
+                  st: _StorageState, log=None) -> bool:
+    """The segmented loop's save: `_retrying_save` plus degraded-mode
+    bookkeeping. Returns whether `step` is durable on disk.
+
+    Degraded mode (entered when retries are exhausted, or when the
+    slow-write watchdog trips): each boundary makes at most ONE cheap
+    probe attempt (every `probe_every`th boundary) — a success that is
+    also fast exits degraded mode (`ckpt.recovered`); anything else
+    emits `ckpt.degraded` and the run keeps computing. The degraded
+    decision is driven purely by (deterministic, injectable) save
+    outcomes, so SPMD drills keep every rank's decision uniform."""
+    if st.degraded:
+        st.boundaries_degraded += 1
+        if policy.probe_every > 1 and (
+            st.boundaries_degraded % policy.probe_every
+        ):
+            st.skipped += 1
+            _record_event("ckpt.degraded", step=int(step), reason="skip",
+                          skipped=st.skipped,
+                          last_valid_step=st.last_durable)
+            _flight.progress(ckpt_skipped=1)
+            return False
+        try:
+            wall = _save_once(mgr, directory, step, state)
+        except OSError as exc:
+            _clean_partial_save(directory, step)
+            st.skipped += 1
+            _record_event("ckpt.degraded", step=int(step),
+                          reason="probe-failed",
+                          error=f"{type(exc).__name__}: {exc}",
+                          skipped=st.skipped,
+                          last_valid_step=st.last_durable)
+            _flight.progress(ckpt_skipped=1)
+            if log is not None:
+                log(f"checkpoint step {step}: storage still degraded "
+                    f"({exc}); continuing without a save")
+            return False
+        st.last_durable = int(step)
+        if policy.slow_save_timeout_s is not None \
+                and wall > policy.slow_save_timeout_s:
+            _record_event("ckpt.degraded", step=int(step), reason="io-slow",
+                          wall_s=wall, skipped=st.skipped,
+                          last_valid_step=st.last_durable)
+            return True  # durable, but the storage is still crawling
+        st.degraded = False
+        _record_event("ckpt.recovered", step=int(step), skipped=st.skipped)
+        # The monitor's degraded-storage indicator compares these two
+        # cumulative counters on the heartbeat (telemetry.health): the
+        # recovery bump is what clears the badge. Flushed NOW — a
+        # counter-only bump doesn't force a heartbeat write, and a run
+        # whose last boundary is the recovery would otherwise exit with
+        # the stale DEGRADED badge on disk forever.
+        _flight.progress(ckpt_recovered=1)
+        _flight.flush()
+        if log is not None:
+            log(f"checkpoint step {step}: storage recovered after "
+                f"{st.skipped} skipped save(s)")
+        st.skipped = 0
+        st.boundaries_degraded = 0
+        return True
+
+    try:
+        wall = _retrying_save(mgr, directory, step, state, policy, log=log)
+    except OSError as exc:
+        if not policy.degrade:
+            raise
+        st.degraded = True
+        st.skipped += 1
+        _record_event("ckpt.degraded", step=int(step), reason="io-error",
+                      error=f"{type(exc).__name__}: {exc}",
+                      skipped=st.skipped, last_valid_step=st.last_durable)
+        _flight.progress(ckpt_degraded=1, ckpt_skipped=1)
+        _flight.flush()  # per-incident: the badge must land even if the
+        # run's last boundary is the one that degraded
+        if log is not None:
+            log(f"checkpoint step {step}: save failed after "
+                f"{policy.retries + 1} attempt(s) ({exc}); entering "
+                f"DEGRADED mode — compute continues, loss bounded by "
+                f"step {st.last_durable}")
+        return False
+    st.last_durable = int(step)
+    if policy.slow_save_timeout_s is not None \
+            and wall > policy.slow_save_timeout_s:
+        st.degraded = True
+        _record_event("ckpt.degraded", step=int(step), reason="io-slow",
+                      wall_s=wall, timeout_s=policy.slow_save_timeout_s,
+                      last_valid_step=st.last_durable)
+        _flight.progress(ckpt_degraded=1)
+        _flight.flush()
+        if log is not None:
+            log(f"checkpoint step {step}: save took {wall:.2f}s (> "
+                f"{policy.slow_save_timeout_s:.2f}s watchdog); entering "
+                "DEGRADED mode")
+    return True
 
 
 def _manager(directory, keep: int = 3):
@@ -406,19 +714,24 @@ def latest_valid_step(directory, log=None) -> int | None:
     return None
 
 
-def save_state(directory, step: int, state, keep: int = 3) -> None:
+def save_state(directory, step: int, state, keep: int = 3,
+               storage: StoragePolicy | None = None) -> None:
     """Save `state` (any pytree of jax arrays — sharded arrays keep their
-    sharding) labeled by absolute step count, then record its manifest."""
-    import orbax.checkpoint as ocp
+    sharding) labeled by absolute step count, then record its manifest.
 
+    Runs under the storage-fault policy (default StoragePolicy.from_env):
+    transient OSErrors retry with backoff, ENOSPC prunes the keep-list
+    first. This one-shot API stays LOUD — exhausted retries re-raise;
+    degraded skip-save-and-continue belongs to run_segmented, where
+    there is a run to keep alive."""
+    policy = storage or StoragePolicy.from_env()
     _drain(state)
     with span("checkpoint.save", step=int(step)):
         mgr = _manager(directory, keep)
-        mgr.save(step, args=ocp.args.StandardSave(state))
-        mgr.wait_until_finished()
-        mgr.close()
-        write_manifest(directory, step, state)
-        _prune_stale_manifests(directory)
+        try:
+            _retrying_save(mgr, directory, step, state, policy)
+        finally:
+            mgr.close()
 
 
 def restore_state(directory, step: int, like=None, verify: bool = True,
@@ -527,9 +840,34 @@ def _restore_body(directory, step, like, verify, devices=None):
             ),
             like,
         )
+    # Bounded retry on transient OSError: a restore reads many files
+    # through the same flaky storage the saves write (the "restore"
+    # fault point drills it). Corruption/topology refusals are NOT
+    # OSErrors and surface immediately.
+    from rocm_mpi_tpu.resilience import faults
+
     mgr = _manager(directory)
-    out = mgr.restore(step, args=ocp.args.StandardRestore(template))
-    mgr.close()
+    attempt = 0
+    try:
+        while True:
+            try:
+                faults.fault_point("restore", step=int(step),
+                                   directory=directory)
+                out = mgr.restore(
+                    step, args=ocp.args.StandardRestore(template)
+                )
+                break
+            except OSError as exc:
+                if attempt >= DEFAULT_RESTORE_RETRIES:
+                    raise
+                wait = DEFAULT_SAVE_BACKOFF_S * DEFAULT_BACKOFF_FACTOR**attempt
+                _record_event("ckpt.retry", step=int(step), attempt=attempt,
+                              wait_s=wait, op="restore",
+                              error=f"{type(exc).__name__}: {exc}")
+                time.sleep(wait)
+                attempt += 1
+    finally:
+        mgr.close()
     if as_tuple:
         out = tuple(out)
     if verify:
@@ -564,6 +902,7 @@ def run_segmented(
     every: int,
     start_step: int = 0,
     keep: int = 3,
+    storage: StoragePolicy | None = None,
 ):
     """Advance `state` by `nt - start_step` steps, checkpointing every
     `every` steps (and at the end). `advance(state, n) -> state` must
@@ -578,10 +917,24 @@ def run_segmented(
     overlapped design. The completed save is then manifested, which is
     what latest_valid_step validates on resume.
 
+    Saves run under `storage` (default StoragePolicy.from_env): bounded
+    retry/backoff on OSError, ENOSPC keep-list pruning, the slow-write
+    watchdog, and degraded skip-save-and-continue mode — a storage
+    outage costs checkpoints (loss bounded by the last valid step),
+    never the run (module docstring; docs/RESILIENCE.md §7).
+
+    Preemption (resilience.preempt): each boundary polls the SIGTERM
+    grace deadline. When preempted, the boundary save happens only if
+    the measured p90 save wall fits the remaining grace — else it is
+    skipped outright (a save SIGKILLed mid-write is a torn artifact) —
+    and the loop raises `Preempted` (SystemExit RC_PREEMPTED), which
+    supervisors classify as resumable.
+
     Fault-injection hook: resilience.faults.fault_point("segment", ...)
     fires after every completed save, so crash-at-step-k and
     truncate-latest faults exercise this exact loop (tests/
-    test_resilience.py).
+    test_resilience.py); the opt-in "save" site fires inside every save
+    attempt (storage kinds: io-error / io-slow / enospc).
 
     Resume idiom (what the apps' --resume flag does):
 
@@ -589,14 +942,15 @@ def run_segmented(
         state = restore_state(dir, start, init_state) if start else init_state
         state = run_segmented(advance, state, nt, dir, every, start)
     """
-    import orbax.checkpoint as ocp
-
     from rocm_mpi_tpu.resilience import faults
+    from rocm_mpi_tpu.resilience import preempt as _preempt
 
     if every < 1:
         raise ValueError(f"checkpoint interval must be >= 1, got {every}")
     if not 0 <= start_step <= nt:
         raise ValueError(f"need 0 <= start_step <= nt, got {start_step}, {nt}")
+    policy = storage or StoragePolicy.from_env()
+    st = _StorageState(last_durable=start_step if start_step else None)
     mgr = _manager(directory, keep)
     try:
         step = start_step
@@ -621,12 +975,55 @@ def run_segmented(
             # (telemetry.flight module docstring has the ordering
             # contract).
             _flight.progress(step=step)
+            if _preempt.requested():
+                if _preempt.note_noticed():
+                    _record_event("preempt.noticed", step=step,
+                                  remaining_grace_s=(
+                                      _preempt.remaining_grace_s()))
+                rem = _preempt.remaining_grace_s()
+                p90 = save_wall_p90()
+                if _preempt.budget_allows_save(p90):
+                    # The emergency save IS the boundary save, just
+                    # deadline-shaped: one attempt, no backoff — a
+                    # retry schedule has no place inside a grace window.
+                    _record_event("preempt.save", step=step,
+                                  remaining_grace_s=rem,
+                                  save_wall_p90_s=p90)
+                    try:
+                        with span("checkpoint.save", step=step):
+                            _save_once(mgr, directory, step, state)
+                    except OSError as exc:
+                        _clean_partial_save(directory, step)
+                        _record_event(
+                            "preempt.save-failed", step=step,
+                            error=f"{type(exc).__name__}: {exc}",
+                            last_valid_step=st.last_durable)
+                        raise _preempt.Preempted(st.last_durable,
+                                                 saved=False) from None
+                    raise _preempt.Preempted(step, saved=True)
+                _record_event("preempt.skip-save", step=step,
+                              remaining_grace_s=rem, save_wall_p90_s=p90,
+                              last_valid_step=st.last_durable)
+                raise _preempt.Preempted(st.last_durable, saved=False)
             with span("checkpoint.save", step=step):
-                mgr.save(step, args=ocp.args.StandardSave(state))
-                mgr.wait_until_finished()
-                write_manifest(directory, step, state)
-                _prune_stale_manifests(directory)
+                durable = _guarded_save(mgr, directory, step, state,
+                                        policy, st)
             faults.fault_point("segment", step=step, directory=directory)
+            if _preempt.requested():
+                # The notice landed while we were inside the save (or
+                # the post-save fault point): the boundary just
+                # published is the resume point — exit now instead of
+                # betting another whole segment against the deadline.
+                if _preempt.note_noticed():
+                    _record_event("preempt.noticed", step=step,
+                                  remaining_grace_s=(
+                                      _preempt.remaining_grace_s()))
+                _record_event("preempt.stop", step=step,
+                              saved=bool(durable),
+                              last_valid_step=st.last_durable)
+                raise _preempt.Preempted(
+                    step if durable else st.last_durable,
+                    saved=bool(durable))
     finally:
         mgr.close()
     return state

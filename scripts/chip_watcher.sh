@@ -112,10 +112,12 @@ archive_telemetry() {
       mkdir -p docs/telemetry_r5
       cp -p "$f" docs/telemetry_r5/ && found=$((found + 1))
     done
-    # elastic.jsonl + manifest-*.json above: an elastic drill's shrink
-    # record and the v2 topology-metadata manifests (docs/RESILIENCE.md
-    # "Elastic recovery") — the artifacts that explain WHY a window
-    # finished on fewer ranks than it started with.
+    # elastic.jsonl + manifest-*.json above: an elastic drill's shrink/
+    # grow records and the v2 topology-metadata manifests
+    # (docs/RESILIENCE.md "Elastic recovery" and §7) — the artifacts
+    # that explain WHY a window finished on a different mesh than it
+    # started with (and whether a preemption or storage outage drove
+    # the change).
     # A watchdog verdict leaves a postmortem/ bundle (docs/TELEMETRY.md
     # "Health plane"): the one artifact that explains a wedged window
     # after the tunnel flaps — archive it whole, next to the telemetry.
@@ -125,6 +127,20 @@ archive_telemetry() {
         && found=$((found + 1))
     fi
   fi
+  # Grow/preempt/storage drill sidecars (docs/RESILIENCE.md §7): the
+  # elastic supervisor writes each drill's elastic.jsonl next to that
+  # drill's OWN checkpoint/health dir under output/, not the default
+  # telemetry sink — archive them under per-drill names so the shrink→
+  # grow and preempted-eviction decision trails survive a flap, and so
+  # lint.sh's schema glob (docs/telemetry_r*/elastic*.jsonl) gates them.
+  local e ename
+  for e in output/*/elastic.jsonl; do
+    [ -s "$e" ] || continue
+    [ "$e" -ef "$tdir/elastic.jsonl" ] && continue  # archived above
+    ename="elastic-$(basename "$(dirname "$e")").jsonl"
+    mkdir -p docs/telemetry_r5
+    cp -p "$e" "docs/telemetry_r5/$ename" && found=$((found + 1))
+  done
   # The bench trajectory (BENCH_r{n}.json, written by bench.py --suite in
   # the telemetry regress flat-metrics format) is banked alongside: a
   # mid-watch flap must not lose the only completed-suite record either.

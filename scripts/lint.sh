@@ -55,19 +55,24 @@ health_records=(
   docs/telemetry_r*/postmortem/postmortem-rank*.json
   docs/telemetry_r*/postmortem/bundle*.json
 )
-# Elastic-recovery artifacts (docs/RESILIENCE.md "Elastic recovery"),
-# still inside the same nullglob scope: the supervisor's elastic.jsonl
-# event sidecars and the checkpoint manifests' v2 topology metadata. A
-# drifted elastic record bricks the monitor's SHRUNK badge; drifted
-# manifest metadata bricks every template-less resume that plans a mesh
-# from it — catch both here, not at the next real incident.
+# Elastic-recovery artifacts (docs/RESILIENCE.md "Elastic recovery" and
+# §7), still inside the same nullglob scope: the supervisor's
+# elastic.jsonl event sidecars (shrink AND grow records — chip_watcher
+# archives drill sidecars as elastic-*.jsonl) and the checkpoint
+# manifests' v2 topology metadata. A drifted elastic record bricks the
+# monitor's SHRUNK/GROWN badges; drifted manifest metadata bricks every
+# template-less resume that plans a mesh from it — catch both here, not
+# at the next real incident. The archived telemetry rank streams ride
+# along for the preempt.*/ckpt.* event families (the preemption decision
+# trail and the storage-fault plane's retry/degraded records).
 # (wildcard-bearing paths only: a literal path would survive nullglob
 # and report "missing" when the artifact legitimately doesn't exist)
 health_records+=(
-  output/*/elastic.jsonl
-  docs/telemetry_r*/elastic.jsonl
+  output/*/elastic*.jsonl
+  docs/telemetry_r*/elastic*.jsonl
   output/*/manifest-*.json
   docs/telemetry_r*/manifest-*.json
+  docs/telemetry_r*/telemetry-rank*.jsonl
 )
 # The graftlint artifacts: the findings document stage 1 just banked
 # (plus any chip_watcher-archived copies) and the committed baseline.

@@ -13,3 +13,19 @@ def hijack_sigusr2():
 
 def hijack_from_import():
     install_handler(signal.SIGTERM, lambda *_: None)  # GL07: alias spelling
+
+
+_DEADLINE = None
+
+
+def stray_preemption_handler(grace_s: float):
+    # The resilience.preempt SIGTERM grace-deadline pattern, copied
+    # OUTSIDE the resilience/ owner dir: exactly the stray install the
+    # GL07 seam must keep firing on — last install wins, so this copy
+    # would silently disarm the real preemption plane (and the SIGUSR2
+    # post-mortem hook keeps its own reasons to care).
+    def _handler(signum, frame):
+        global _DEADLINE
+        _DEADLINE = grace_s
+
+    signal.signal(signal.SIGTERM, _handler)  # GL07: preempt-shaped stray

@@ -42,6 +42,10 @@ def main() -> int:
     p.add_argument("--every", type=int, default=4)
     p.add_argument("--keep", type=int, default=3)
     p.add_argument("--dir", required=True)
+    # Grow/preempt drills: stretch each segment so the supervisor's
+    # rejoin probe (or the test's SIGTERM thread) reliably lands its
+    # preemption while the run is still mid-flight (test_elastic.py).
+    p.add_argument("--segment-delay-s", type=float, default=0.0)
     args = p.parse_args()
 
     import jax.numpy as jnp
@@ -66,6 +70,13 @@ def main() -> int:
     # apps/_common.setup_health wires it.
     if flight.enable_from_env():
         flight.install_postmortem_handler()
+    # The launcher's preemption contract (RMT_PREEMPT_GRACE_S →
+    # spawn_ranks preempt_grace_s): arm the SIGTERM grace-deadline
+    # handler so a preempted rank exits RC_PREEMPTED from a durable
+    # boundary instead of dying handler-less (resilience.preempt).
+    from rocm_mpi_tpu.resilience import preempt
+
+    preempt.install_from_env()
 
     cfg = DiffusionConfig(
         global_shape=(args.nx, args.ny), lengths=(10.0, 10.0),
@@ -74,7 +85,14 @@ def main() -> int:
     model = HeatDiffusion(cfg)
     T, Cp = model.init_state()
     advance = model.advance_fn("perf")
-    adv = lambda s, n: (advance(s[0], Cp, n),)  # noqa: E731
+    if args.segment_delay_s > 0:
+        import time
+
+        def adv(s, n):
+            time.sleep(args.segment_delay_s)
+            return (advance(s[0], Cp, n),)
+    else:
+        adv = lambda s, n: (advance(s[0], Cp, n),)  # noqa: E731
 
     start = ckpt.latest_valid_step(args.dir) or 0
     if start:

@@ -21,7 +21,16 @@ The claims, pinned:
     then proven gloo-real: kill / die / stall a rank mid-run on 2 ranks,
     shrink to 1, resume from the latest valid step, final checkpoint
     bitwise-equal to an uninterrupted 1-rank continuation of the same
-    global state. Clean runs never shrink.
+    global state. Clean runs never shrink;
+  * the other half (ISSUE 9): `device_budget` arms the rejoin probe and
+    run_elastic GROWS back — preempt the reduced-mesh run at a segment
+    boundary, relaunch on the largest valid larger mesh — with every
+    shrink/grow/give-up decision in the pluggable ElasticPolicy
+    (hysteresis table-drilled with fake launches, shrink precedence
+    over grow, preempted exits judged resumable and bounded), proven
+    gloo-real in test_elastic_drill_shrinks_then_grows_back with the
+    final checkpoint bitwise-equal to an uninterrupted 2-rank
+    continuation. Clean runs with budget == mesh never change topology.
 """
 
 import json
@@ -42,7 +51,9 @@ from rocm_mpi_tpu.parallel import mesh as pmesh
 from rocm_mpi_tpu.parallel.launcher import spawn_ranks
 from rocm_mpi_tpu.resilience import (
     ElasticExhausted,
+    ElasticPolicy,
     faults,
+    preempt,
     reshard,
     run_elastic,
 )
@@ -58,6 +69,7 @@ NT, EVERY = 16, 4
 def _clean_faults():
     yield
     faults.install(None)
+    preempt.reset()
 
 
 def _model(dims=(2, 4), shape=(32, 32)):
@@ -504,6 +516,189 @@ def test_elastic_callable_argv_gets_rank_count(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# ElasticPolicy: the pluggable decision table (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+
+def test_policy_wants_grow_table():
+    p = ElasticPolicy(min_grow_interval_steps=0)
+    assert p.wants_grow(2, 4) is True           # budget exceeds, no interval
+    assert p.wants_grow(2, 2) is False          # budget == running: no grow
+    assert p.wants_grow(4, 2) is False          # budget below: never
+    assert ElasticPolicy(grow=False).wants_grow(2, 4) is False  # master off
+    h = ElasticPolicy(min_grow_interval_steps=8)
+    # Hysteresis that cannot be evaluated fails CLOSED.
+    assert h.wants_grow(2, 4, step=None) is False
+    assert h.wants_grow(2, 4, step=12, last_change_step=8) is False  # 4 < 8
+    assert h.wants_grow(2, 4, step=16, last_change_step=8) is True   # 8 >= 8
+    assert h.wants_grow(2, 4, step=16, last_change_step=None) is True
+
+
+def test_policy_targets_and_give_up():
+    p = ElasticPolicy(min_ranks=2)
+    assert p.give_up(2) is True and p.give_up(3) is False
+    ident = lambda b: b  # noqa: E731
+    # Shrink plans for the SURVIVORS (never n-1 with two dead), floored.
+    assert p.shrink_target(4, 1, ident) == 3
+    assert p.shrink_target(4, 2, ident) == 2
+    assert p.shrink_target(3, 2, ident) == 2  # min_ranks floor
+    # Grow may come back equal when no larger mesh tiles the grid.
+    assert p.grow_target(2, 8, lambda b: 2) == 2
+    assert p.grow_target(2, 8, lambda b: 8) == 8
+
+
+def test_judge_classifies_preempted_exits():
+    from rocm_mpi_tpu.resilience.elastic import _judge
+
+    status, dead, reason = _judge(_fake_results([75, 75]))
+    assert status == "preempted" and dead == []
+    status, _, _ = _judge(_fake_results([0, 75]))
+    assert status == "preempted"
+    # A mix of preempted and REAL failure is a failure.
+    status, dead, _ = _judge(
+        _fake_results([75, 1], first_failure=(1, 1, 2.0))
+    )
+    assert status == "failed" and dead == [1]
+    assert _judge(_fake_results([0, 0]))[0] == "ok"
+
+
+def test_elastic_grows_after_preempted_launch(tmp_path):
+    """The between-launches grow: a preempted launch re-plans against
+    the budget and relaunches on the largest valid larger mesh."""
+    calls = []
+
+    def launch(argv, nprocs, inject_fault=None, **kw):
+        calls.append(nprocs)
+        if len(calls) == 1:
+            return _fake_results([75, 75])
+        return _fake_results([0] * nprocs)
+
+    report = run_elastic(
+        ["worker.py"], 2, global_shape=(32, 32), sidecar_dir=tmp_path,
+        launch=launch, device_budget=4,
+    )
+    assert calls == [2, 4]
+    assert report.grows == 1 and report.shrinks == 0
+    assert report.final_nprocs == 4
+    names = [e["name"] for e in report.events]
+    assert names == ["elastic.launch", "elastic.grow",
+                     "elastic.launch", "elastic.complete"]
+    grow = report.events[1]
+    assert grow["old_nprocs"] == 2 and grow["new_nprocs"] == 4
+    assert grow["old_mesh"] == [2, 1] and grow["new_mesh"] == [2, 2]
+    assert grow["reason"] == "device-budget"
+
+
+def test_elastic_preempted_without_budget_resumes_same_topology(tmp_path):
+    calls = []
+
+    def launch(argv, nprocs, inject_fault=None, **kw):
+        calls.append(nprocs)
+        if len(calls) == 1:
+            return _fake_results([75, 75])
+        return _fake_results([0] * nprocs)
+
+    report = run_elastic(["worker.py"], 2, global_shape=(32, 32),
+                         sidecar_dir=tmp_path, launch=launch)
+    assert calls == [2, 2]
+    assert report.resumes == 1 and report.grows == 0
+    assert [e["name"] for e in report.events] == [
+        "elastic.launch", "elastic.resume",
+        "elastic.launch", "elastic.complete",
+    ]
+
+
+def test_elastic_hysteresis_refuses_then_allows_grow(tmp_path, monkeypatch):
+    """The fake-launch hysteresis table: a preempted relaunch inside the
+    min-interval resumes at the same size; once the run has advanced
+    past the interval, the same budget signal grows."""
+    from rocm_mpi_tpu.utils import checkpoint as uckpt
+
+    steps = {"now": 8}
+    monkeypatch.setattr(
+        uckpt, "latest_valid_step",
+        lambda directory, log=None: steps["now"],
+    )
+    policy = ElasticPolicy(min_grow_interval_steps=6)
+    calls = []
+
+    def launch(argv, nprocs, inject_fault=None, **kw):
+        calls.append(nprocs)
+        if len(calls) == 1:
+            return _fake_results([75, 75])   # step still 8: refused
+        if len(calls) == 2:
+            steps["now"] = 16                # advanced 8 >= 6: allowed
+            return _fake_results([75, 75])
+        return _fake_results([0] * nprocs)
+
+    report = run_elastic(
+        ["worker.py"], 2, global_shape=(32, 32), sidecar_dir=tmp_path,
+        checkpoint_dir=tmp_path / "ck", launch=launch,
+        device_budget=4, policy=policy,
+    )
+    assert calls == [2, 2, 4]
+    assert report.resumes == 1 and report.grows == 1
+    names = [e["name"] for e in report.events]
+    assert names == ["elastic.launch", "elastic.resume", "elastic.launch",
+                     "elastic.grow", "elastic.launch", "elastic.complete"]
+    grow = next(e for e in report.events if e["name"] == "elastic.grow")
+    assert grow["resume_step"] == 16
+
+
+def test_elastic_shrink_takes_precedence_over_grow(tmp_path):
+    """Both signals at once — a dead rank AND an optimistic budget — and
+    the supervisor must believe the corpse, not the budget."""
+    calls = []
+
+    def launch(argv, nprocs, inject_fault=None, **kw):
+        calls.append(nprocs)
+        if len(calls) == 1:
+            return _fake_results([0, 43, 0, 0], first_failure=(1, 43, 1.0))
+        return _fake_results([0] * nprocs)
+
+    report = run_elastic(
+        ["worker.py"], 4, global_shape=(32, 32), sidecar_dir=tmp_path,
+        launch=launch, device_budget=8,
+    )
+    assert calls == [4, 2]
+    assert report.shrinks == 1 and report.grows == 0
+    names = [e["name"] for e in report.events]
+    assert "elastic.shrink" in names and "elastic.grow" not in names
+
+
+def test_elastic_parent_notice_stops_relaunching(tmp_path):
+    """When the PARENT itself holds the eviction notice (the launcher's
+    forwarder stamped it), a preempted launch is not relaunched: the
+    whole job is being taken, and the report says 'resumable'."""
+
+    def launch(argv, nprocs, inject_fault=None, **kw):
+        preempt.request(grace_s=30.0)  # the forwarder's stamp
+        return _fake_results([75, 75])
+
+    report = run_elastic(["worker.py"], 2, global_shape=(32, 32),
+                         sidecar_dir=tmp_path, launch=launch)
+    assert report.preempted is True
+    assert report.final_nprocs == 2 and report.resumes == 0
+    assert report.events[-1]["name"] == "elastic.preempted"
+    st = health.elastic_status(report.events)
+    assert st["preempted"] is True
+    assert "PREEMPTED" in health.format_elastic_status(st)
+
+
+def test_elastic_preempt_resumes_are_bounded(tmp_path):
+    def launch(argv, nprocs, inject_fault=None, **kw):
+        return _fake_results([75, 75])
+
+    with pytest.raises(ElasticExhausted, match="preempted"):
+        run_elastic(
+            ["worker.py"], 2, sidecar_dir=tmp_path, launch=launch,
+            policy=ElasticPolicy(max_preempt_resumes=2),
+        )
+    events, _ = health.load_elastic_events(tmp_path)
+    assert events[-1]["name"] == "elastic.gave-up"
+
+
+# ---------------------------------------------------------------------------
 # Schema gate + monitor badge
 # ---------------------------------------------------------------------------
 
@@ -540,7 +735,7 @@ def test_check_schema_validates_manifests_and_elastic_records(tmp_path):
     assert any("old_nprocs" in p for p in problems)
 
 
-def _write_heartbeat(directory, rank, step):
+def _write_heartbeat(directory, rank, step, **counters):
     from rocm_mpi_tpu.telemetry.flight import (
         HEARTBEAT_SCHEMA,
         HEARTBEAT_VERSION,
@@ -548,7 +743,7 @@ def _write_heartbeat(directory, rank, step):
 
     doc = {"schema": HEARTBEAT_SCHEMA, "v": HEARTBEAT_VERSION,
            "rank": rank, "t": 0.0, "t_mono": 0.0, "started_t": 0.0,
-           "counters": {"step": step}, "last_phase": "step",
+           "counters": {"step": step, **counters}, "last_phase": "step",
            "last_phase_name": "step_window", "last_phase_t": 0.0,
            "ring": []}
     (pathlib.Path(directory) / f"heartbeat-rank{rank}.json").write_text(
@@ -582,6 +777,68 @@ def test_monitor_without_elastic_sidecar_has_no_badge(tmp_path, capsys):
     rc = telemetry_main(["monitor", str(tmp_path), "--iterations", "1"])
     out = capsys.readouterr().out
     assert rc == 0 and "SHRUNK" not in out and "mesh" not in out
+    assert "GROWN" not in out and "STORAGE" not in out
+
+
+def test_elastic_status_tracks_grows():
+    events = [
+        {"name": "elastic.launch", "nprocs": 2, "mesh": [2, 1]},
+        {"name": "elastic.shrink", "old_nprocs": 2, "new_nprocs": 1,
+         "old_mesh": [2, 1], "new_mesh": [1, 1]},
+        {"name": "elastic.launch", "nprocs": 1, "mesh": [1, 1]},
+        {"name": "elastic.grow", "old_nprocs": 1, "new_nprocs": 2,
+         "old_mesh": [1, 1], "new_mesh": [2, 1]},
+        {"name": "elastic.launch", "nprocs": 2, "mesh": [2, 1]},
+    ]
+    st = health.elastic_status(events)
+    assert st["nprocs"] == 2 and st["mesh"] == [2, 1]
+    assert st["shrunk"] and st["grown"]
+    assert st["grows"] == 1 and st["grow_mesh"] == [2, 1]
+    line = health.format_elastic_status(st)
+    assert "SHRUNK from (2, 1)" in line
+    assert "GROWN to (2, 1), 1 grow(s)" in line
+
+
+def test_monitor_shows_grown_badge_and_degraded_storage(tmp_path, capsys):
+    from rocm_mpi_tpu.telemetry.__main__ import main as telemetry_main
+
+    _write_heartbeat(tmp_path, 0, 12, ckpt_degraded=1, ckpt_skipped=3)
+    _write_heartbeat(tmp_path, 1, 12)
+    health.append_elastic_event(tmp_path, "elastic.launch", attempt=0,
+                                nprocs=1, mesh=[1, 1], resume_step=None)
+    health.append_elastic_event(tmp_path, "elastic.grow", old_nprocs=1,
+                                new_nprocs=2, old_mesh=[1, 1],
+                                new_mesh=[2, 1], resume_step=8,
+                                reason="device-budget")
+    health.append_elastic_event(tmp_path, "elastic.launch", attempt=1,
+                                nprocs=2, mesh=[2, 1], resume_step=8)
+    rc = telemetry_main(["monitor", str(tmp_path), "--iterations", "1"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "mesh (2, 1)" in out
+    assert "GROWN to (2, 1), 1 grow(s)" in out
+    assert "STORAGE DEGRADED rank(s) 0 — 3 skipped save(s)" in out
+    # Recovery clears the badge but keeps the loss window visible.
+    _write_heartbeat(tmp_path, 0, 16, ckpt_degraded=1, ckpt_skipped=3,
+                     ckpt_recovered=1)
+    rc = telemetry_main(["monitor", str(tmp_path), "--iterations", "1"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "STORAGE DEGRADED" not in out
+    assert "storage recovered (3 skipped save(s))" in out
+
+
+def test_storage_status_table():
+    assert health.storage_status({}) is None
+    clean = {0: {"counters": {"step": 8}}}
+    assert health.storage_status(clean) is None
+    live = {0: {"counters": {"ckpt_degraded": 2, "ckpt_recovered": 1,
+                             "ckpt_skipped": 4}},
+            1: {"counters": {"ckpt_degraded": 1, "ckpt_recovered": 1,
+                             "ckpt_skipped": 2}}}
+    st = health.storage_status(live)
+    assert st["degraded"] and st["degraded_ranks"] == [0]
+    assert st["skipped"] == 6
+    assert "STORAGE DEGRADED rank(s) 0" in health.format_storage_status(st)
 
 
 # ---------------------------------------------------------------------------
@@ -695,7 +952,10 @@ def test_elastic_drill_shrinks_and_resumes_bitwise(tmp_path, kind, spec,
 def test_elastic_drill_clean_run_never_shrinks(tmp_path):
     """The control: same harness, no fault — one launch, no shrink, no
     SHRUNK badge, and the legacy same-mesh contract intact (the final
-    checkpoint equals a straight 2-rank reference restored in-process)."""
+    checkpoint equals a straight 2-rank reference restored in-process).
+    The device budget and the rejoin probe are ARMED (ISSUE 9): a clean
+    run whose budget matches its mesh must never change topology or get
+    preempted by its own supervisor."""
     ck = tmp_path / "ck"
     hdir = tmp_path / "health"
     report = run_elastic(
@@ -703,6 +963,7 @@ def test_elastic_drill_clean_run_never_shrinks(tmp_path):
         checkpoint_dir=ck,
         global_shape=(DRILL["nx"], DRILL["ny"]),
         health_dir=hdir,
+        device_budget=2,
         timeout=100,
         init_timeout_s=60,
         heartbeat_s=2.0,
@@ -710,6 +971,7 @@ def test_elastic_drill_clean_run_never_shrinks(tmp_path):
         vanish_grace_s=6.0,
     )
     assert report.shrinks == 0 and report.final_nprocs == 2
+    assert report.grows == 0 and report.resumes == 0
     assert [e["name"] for e in report.events] == ["elastic.launch",
                                                   "elastic.complete"]
     for pid, (p, (out, err)) in enumerate(report.results):
@@ -729,3 +991,109 @@ def test_elastic_drill_clean_run_never_shrinks(tmp_path):
                                devices=jax.devices()[:2])
     np.testing.assert_array_equal(np.asarray(final[0]),
                                   np.asarray(ref[0]))
+
+
+# ---------------------------------------------------------------------------
+# The growth acceptance drill: shrink on a kill, grow back at a boundary
+# ---------------------------------------------------------------------------
+
+GROW_NT = 24
+
+
+def _grow_argv(ck):
+    return [
+        str(ROOT / "tests" / "elastic_worker.py"),
+        "--nx", str(DRILL["nx"]), "--ny", str(DRILL["ny"]),
+        "--nt", str(GROW_NT), "--every", str(DRILL["every"]),
+        "--keep", "8",
+        "--dir", str(ck),
+        # Stretch each segment so the rejoin probe (polling the budget
+        # every 0.2 s below) reliably preempts the reduced-mesh launch
+        # while it is still mid-flight.
+        "--segment-delay-s", "0.4",
+    ]
+
+
+def _grow_reference(ck, start):
+    """The uninterrupted 2-rank twin of the grown run: restore the
+    drill's own checkpoint at the grow's resume step onto 2 devices and
+    advance to GROW_NT on the (2, 1) mesh."""
+    devices = jax.devices()[:2]
+    state = ckpt.restore_state(ck, start, like=None, devices=devices)
+    if start == GROW_NT:
+        return state[0]
+    cfg = DiffusionConfig(
+        global_shape=(DRILL["nx"], DRILL["ny"]), lengths=(10.0, 10.0),
+        nt=GROW_NT, warmup=0, dtype="f64", dims=(2, 1),
+    )
+    grid = pmesh.init_global_grid(
+        DRILL["nx"], DRILL["ny"], dims=(2, 1), devices=devices
+    )
+    model = HeatDiffusion(cfg, grid=grid)
+    _, Cp = model.init_state()
+    advance = model.advance_fn("perf")
+    return advance(state[0], Cp, GROW_NT - start)
+
+
+def test_elastic_drill_shrinks_then_grows_back(tmp_path):
+    """THE growth acceptance drill (ISSUE 9): a 2-rank gloo run loses
+    rank 1 to a kill and SHRINKS to 1; the rejoin probe then sees the
+    recovered device budget, preempts the reduced-mesh run at a segment
+    boundary (SIGTERM → emergency save → RC_PREEMPTED), and GROWS back
+    onto 2 ranks — and the final checkpoint is bitwise-equal to an
+    uninterrupted 2-rank continuation from the step the grow resumed."""
+    ck = tmp_path / "ck"
+    hdir = tmp_path / "health"
+    report = run_elastic(
+        _grow_argv(ck), 2,
+        checkpoint_dir=ck,
+        global_shape=(DRILL["nx"], DRILL["ny"]),
+        health_dir=hdir,
+        inject_fault="kill@step=8,rank=1",
+        device_budget=2,
+        policy=ElasticPolicy(grow_poll_s=0.2),
+        timeout=150,
+        init_timeout_s=60,
+        heartbeat_s=2.0,
+        peer_grace_s=6.0,
+        stall_grace_s=8.0,
+        vanish_grace_s=8.0,
+    )
+    assert report.shrinks == 1 and report.grows == 1, report.launches
+    assert report.final_nprocs == 2
+    # Launch ledger: 2 ranks (killed) -> 1 rank (preempted for growth)
+    # -> 2 ranks (complete).
+    assert [l["nprocs"] for l in report.launches] == [2, 1, 2]
+    assert report.launches[0]["status"] == "failed"
+    assert report.launches[1]["status"] == "preempted"
+    assert report.launches[1]["returncodes"] == [75]
+    assert report.launches[2]["ok"]
+    shrink = next(e for e in report.events if e["name"] == "elastic.shrink")
+    grow = next(e for e in report.events if e["name"] == "elastic.grow")
+    assert shrink["resume_step"] == 8
+    assert shrink["new_mesh"] == [1, 1] and grow["new_mesh"] == [2, 1]
+    assert grow["old_nprocs"] == 1 and grow["new_nprocs"] == 2
+    # Growth only ever happens from a boundary durably PAST the shrink's
+    # resume point — the hysteresis-by-construction contract.
+    assert grow["resume_step"] is not None and grow["resume_step"] >= 12
+    assert grow["resume_step"] % DRILL["every"] == 0
+    # The run finished on the grown mesh, bitwise equal to the
+    # uninterrupted 2-rank continuation of the same global state.
+    assert ckpt.latest_valid_step(ck) == GROW_NT
+    final = ckpt.restore_state(ck, GROW_NT, like=None,
+                               devices=jax.devices()[:2])
+    ref = _grow_reference(ck, grow["resume_step"])
+    np.testing.assert_array_equal(np.asarray(final[0]), np.asarray(ref))
+    # The monitor reads the whole topology history: both badges.
+    proc = subprocess.run(
+        [sys.executable, "-m", "rocm_mpi_tpu.telemetry", "monitor",
+         str(hdir), "--iterations", "1"],
+        capture_output=True, text=True, timeout=60, cwd=ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "SHRUNK from (2, 1)" in proc.stdout, proc.stdout
+    assert "GROWN to (2, 1)" in proc.stdout, proc.stdout
+    # And the sidecar passes the schema gate with its new grow record.
+    from rocm_mpi_tpu.telemetry import regress
+
+    assert regress.check_schema([str(hdir / health.ELASTIC_FILE)]) == []

@@ -1,0 +1,718 @@
+"""The storage-fault plane and preemption-aware shutdown
+(docs/RESILIENCE.md §7 — utils/checkpoint.py StoragePolicy + degraded
+mode, resilience/preempt.py, resilience/faults.py storage kinds).
+
+The claims, pinned:
+
+  * storage faults are deterministic drills: `io-error` / `io-slow` /
+    `enospc` clauses parse, pin to the opt-in "save" site, re-fire per
+    ATTEMPT up to `times=N`, and raise the real errnos;
+  * a transient save failure retries with bounded backoff (`ckpt.retry`
+    events) and the run never notices; an outage exhausting the retries
+    flips the segmented loop into DEGRADED mode — compute continues,
+    boundaries probe-and-skip (`ckpt.degraded`), recovery is announced
+    (`ckpt.recovered`) — and the loss window is bounded by the last
+    pre-outage valid step (the failed steps simply never exist on disk);
+  * ENOSPC prunes the keep-list before giving up; the slow-write
+    watchdog degrades without losing the (durable) slow save;
+  * a SIGTERM grace deadline lands ONE emergency save at the next
+    segment boundary iff the telemetry-measured p90 save wall fits the
+    remaining grace — else the save is skipped outright (no torn
+    artifact) — and either way the rank exits RC_PREEMPTED, which
+    run_supervised never retries and run_elastic classifies as
+    resumable, never a failure;
+  * all of it holds gloo-real: a 2-rank storage outage spanning two
+    consecutive saves keeps the run alive in degraded mode, and a
+    preempted 2-rank run resumes under run_elastic to a final state
+    bitwise-equal to the uninterrupted twin.
+"""
+
+import json
+import os
+import pathlib
+import signal
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rocm_mpi_tpu import telemetry
+from rocm_mpi_tpu.config import DiffusionConfig
+from rocm_mpi_tpu.models import HeatDiffusion
+from rocm_mpi_tpu.resilience import faults
+from rocm_mpi_tpu.resilience import preempt
+from rocm_mpi_tpu.resilience import run_elastic
+from rocm_mpi_tpu.resilience.supervisor import default_retryable
+from rocm_mpi_tpu.telemetry import health
+from rocm_mpi_tpu.telemetry import regress
+from rocm_mpi_tpu.utils import checkpoint as ckpt
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+NT, EVERY = 16, 4
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """Every test starts with no armed faults, no pending preemption, an
+    empty save-wall history, and a fresh event trail — all module-global
+    state the storage/preempt planes deliberately keep (the save-wall
+    history in particular accretes from every other test file's saves in
+    this process)."""
+    faults.install(None)
+    preempt.reset()
+    ckpt._SAVE_WALLS.clear()
+    telemetry.clear_events()
+    yield
+    faults.install(None)
+    preempt.uninstall()
+    ckpt._SAVE_WALLS.clear()
+    telemetry.clear_events()
+
+
+def _model(nt=NT, shape=(16, 16)):
+    cfg = DiffusionConfig(
+        global_shape=shape, lengths=(10.0, 10.0), nt=nt, warmup=0,
+        dtype="f64", dims=(1, 1),
+    )
+    model = HeatDiffusion(cfg)
+    T, Cp = model.init_state()
+    advance = model.advance_fn("perf")
+    adv = lambda s, n: (advance(s[0], Cp, n),)  # noqa: E731
+    return adv, (T,)
+
+
+def _policy(**kw):
+    kw.setdefault("retries", 2)
+    kw.setdefault("backoff_s", 0.01)
+    return ckpt.StoragePolicy(**kw)
+
+
+def _events(name=None):
+    return [r for r in telemetry.records(kind="event")
+            if name is None or r["name"] == name]
+
+
+# ---------------------------------------------------------------------------
+# Fault grammar: the storage kinds
+# ---------------------------------------------------------------------------
+
+
+def test_storage_fault_kinds_parse_and_default_to_save_site():
+    plan = faults.FaultPlan.parse(
+        "io-error@step=8;io-slow=0.5@step=4;enospc@step=12,times=3"
+    )
+    kinds = [(c.kind, c.site, c.times) for c in plan.clauses]
+    assert kinds == [("io-error", "save", None),
+                     ("io-slow", "save", None),
+                     ("enospc", "save", 3)]
+    assert plan.clauses[1].delay_s == 0.5
+    # Bare io-slow gets the default stall duration.
+    bare = faults.FaultPlan.parse("io-slow@step=4")
+    assert bare.clauses[0].delay_s == faults.IO_SLOW_DEFAULT_S
+    assert "times=3" in repr(plan.clauses[2])
+    with pytest.raises(ValueError, match="needs a step"):
+        faults.FaultPlan.parse("io-error")
+    with pytest.raises(ValueError, match="times"):
+        faults.FaultPlan.parse("io-error@step=4,times=0")
+
+
+def test_storage_faults_fire_with_real_errnos_and_rearm():
+    import errno
+
+    plan = faults.install("io-error@step=8,times=2")
+    with pytest.raises(OSError) as one:
+        faults.fault_point("save", step=8)
+    assert one.value.errno == errno.EIO
+    with pytest.raises(OSError):
+        faults.fault_point("save", step=8)  # times=2: re-fires per attempt
+    faults.fault_point("save", step=8)  # exhausted: the retry succeeds
+    assert plan.clauses[0].fires == 2
+    faults.install("enospc@step=4")
+    with pytest.raises(OSError) as two:
+        faults.fault_point("save", step=4)
+    assert two.value.errno == errno.ENOSPC
+    # The save site is opt-in: a legacy segment clause never fires there.
+    plan = faults.install("crash@step=8")
+    faults.fault_point("save", step=8)
+    assert plan.clauses[0].fires == 0
+
+
+# ---------------------------------------------------------------------------
+# Retry / backoff, ENOSPC pruning, the slow-write watchdog, degraded mode
+# ---------------------------------------------------------------------------
+
+
+def test_transient_io_error_retries_and_completes(tmp_path):
+    adv, state = _model()
+    ref = adv((jnp.copy(state[0]),), NT)
+    faults.install("io-error@step=8")
+    waits = []
+    out = ckpt.run_segmented(adv, state, NT, tmp_path, every=EVERY,
+                             storage=_policy(sleep=waits.append))
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(ref[0]))
+    assert ckpt.all_steps(tmp_path)[-1] == NT
+    retries = _events("ckpt.retry")
+    assert len(retries) == 1 and retries[0]["step"] == 8
+    assert retries[0]["attempt"] == 0 and retries[0]["wait_s"] == waits[0]
+    assert not _events("ckpt.degraded")
+
+
+def test_io_error_outage_degrades_bounds_loss_and_recovers(tmp_path):
+    """An outage spanning two consecutive saves (every attempt at step 8,
+    then the degraded probe at step 12): compute continues, the skipped
+    steps never exist on disk — a crash during the outage loses exactly
+    back to step 4 — and the first healthy probe exits degraded mode."""
+    adv, state = _model()
+    ref = adv((jnp.copy(state[0]),), NT)
+    faults.install("io-error@step=8,times=3;io-error@step=12")
+    out = ckpt.run_segmented(
+        adv, state, NT, tmp_path, every=EVERY,
+        storage=_policy(sleep=lambda _: None), keep=8,
+    )
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(ref[0]))
+    # Loss bound: 8 and 12 are simply absent; 4 stayed valid throughout.
+    assert ckpt.all_steps(tmp_path) == [4, 16]
+    assert ckpt.latest_valid_step(tmp_path) == 16
+    degraded = _events("ckpt.degraded")
+    assert [d["reason"] for d in degraded] == ["io-error", "probe-failed"]
+    assert degraded[0]["step"] == 8 and degraded[0]["last_valid_step"] == 4
+    assert degraded[1]["step"] == 12 and degraded[1]["last_valid_step"] == 4
+    recovered = _events("ckpt.recovered")
+    assert len(recovered) == 1 and recovered[0]["step"] == 16
+    assert recovered[0]["skipped"] == 2
+    # Two retry events: the two defeated retry attempts at step 8.
+    assert len(_events("ckpt.retry")) == 2
+
+
+def test_degrade_off_raises_after_retries(tmp_path):
+    adv, state = _model()
+    faults.install("io-error@step=8,times=3")
+    with pytest.raises(OSError):
+        ckpt.run_segmented(
+            adv, state, NT, tmp_path, every=EVERY,
+            storage=_policy(degrade=False, sleep=lambda _: None),
+        )
+    # The failed attempt left no torn artifact behind.
+    assert ckpt.all_steps(tmp_path) == [4]
+    assert ckpt.latest_valid_step(tmp_path) == 4
+
+
+def test_enospc_prunes_keep_list_then_save_lands(tmp_path):
+    adv, state = _model()
+    ckpt.run_segmented(adv, state, 8, tmp_path, every=EVERY, keep=8)
+    assert ckpt.all_steps(tmp_path) == [4, 8]
+    telemetry.clear_events()
+    faults.install("enospc@step=12")
+    _, like = _model()
+    restored = ckpt.restore_state(tmp_path, 8, like)
+    ckpt.run_segmented(adv, restored, NT, tmp_path, every=EVERY,
+                       start_step=8, keep=8,
+                       storage=_policy(sleep=lambda _: None))
+    # Step 4 was sacrificed for space; the newest valid step survived,
+    # and the retried save landed.
+    assert ckpt.all_steps(tmp_path) == [8, 12, 16]
+    prunes = _events("ckpt.enospc-prune")
+    assert len(prunes) == 1 and prunes[0]["step"] == 12
+    assert prunes[0]["pruned_steps"] == [4]
+    assert not _events("ckpt.degraded")
+
+
+def test_enospc_outage_with_nothing_to_prune_degrades(tmp_path):
+    """ENOSPC with only the newest valid step on disk frees nothing —
+    the save burns its retries and the run degrades instead of dying."""
+    adv, state = _model(nt=20)
+    faults.install("enospc@step=8,times=2;enospc@step=12")
+    ckpt.run_segmented(adv, state, 20, tmp_path, every=EVERY, keep=8,
+                       storage=_policy(retries=1, sleep=lambda _: None))
+    # Outage covers saves 8 (both attempts) and the probe at 12; the
+    # probe at 16 recovers and 20 saves normally.
+    assert ckpt.all_steps(tmp_path) == [4, 16, 20]
+    degraded = _events("ckpt.degraded")
+    assert [d["reason"] for d in degraded] == ["io-error", "probe-failed"]
+    assert _events("ckpt.recovered")[0]["skipped"] == 2
+    prunes = _events("ckpt.enospc-prune")
+    assert prunes and prunes[0]["pruned_steps"] == []
+
+
+def test_io_slow_watchdog_degrades_but_keeps_the_saves(tmp_path):
+    """A slow save is still a DURABLE save: the watchdog flips degraded
+    mode (the operator must see the storage crawling) without losing the
+    step; a fast probe exits it."""
+    adv, state = _model()
+    faults.install("io-slow=1.0@step=8;io-slow=1.0@step=12")
+    ckpt.run_segmented(
+        adv, state, NT, tmp_path, every=EVERY, keep=8,
+        storage=_policy(slow_save_timeout_s=0.5, sleep=lambda _: None),
+    )
+    assert ckpt.all_steps(tmp_path) == [4, 8, 12, 16]  # nothing lost
+    degraded = _events("ckpt.degraded")
+    assert [d["reason"] for d in degraded] == ["io-slow", "io-slow"]
+    assert degraded[0]["wall_s"] > 0.5
+    recovered = _events("ckpt.recovered")
+    assert len(recovered) == 1 and recovered[0]["step"] == 16
+
+
+def test_save_state_stays_loud(tmp_path):
+    """The one-shot API keeps the loud contract: retries, then raise —
+    degraded skip-save-and-continue belongs to the segmented loop."""
+    _, state = _model()
+    faults.install("io-error@step=4,times=3")
+    with pytest.raises(OSError):
+        ckpt.save_state(tmp_path, 4, state,
+                        storage=_policy(sleep=lambda _: None))
+    faults.install("io-error@step=8")
+    ckpt.save_state(tmp_path, 8, state,
+                    storage=_policy(sleep=lambda _: None))
+    assert ckpt.latest_valid_step(tmp_path) == 8
+
+
+def test_restore_retries_transient_io_error(tmp_path):
+    adv, state = _model()
+    ref = np.asarray(state[0])
+    ckpt.save_state(tmp_path, 4, state)
+    telemetry.clear_events()
+    faults.install("io-error@step=4,at=restore")
+    out = ckpt.restore_state(tmp_path, 4, like=None)
+    np.testing.assert_array_equal(np.asarray(out[0]), ref)
+    retries = _events("ckpt.retry")
+    assert len(retries) == 1 and retries[0]["op"] == "restore"
+
+
+def test_storage_policy_from_env(monkeypatch):
+    monkeypatch.setenv("RMT_CKPT_RETRIES", "5")
+    monkeypatch.setenv("RMT_CKPT_BACKOFF_S", "0.125")
+    monkeypatch.setenv("RMT_CKPT_SLOW_S", "2.5")
+    monkeypatch.setenv("RMT_CKPT_DEGRADE", "0")
+    monkeypatch.setenv("RMT_CKPT_PROBE_EVERY", "3")
+    p = ckpt.StoragePolicy.from_env()
+    assert (p.retries, p.backoff_s, p.slow_save_timeout_s,
+            p.degrade, p.probe_every) == (5, 0.125, 2.5, False, 3)
+    monkeypatch.setenv("RMT_CKPT_RETRIES", "garbage")
+    monkeypatch.delenv("RMT_CKPT_DEGRADE")
+    p = ckpt.StoragePolicy.from_env()
+    assert p.retries == ckpt.DEFAULT_SAVE_RETRIES and p.degrade is True
+
+
+def test_save_wall_p90_interpolates():
+    assert ckpt.save_wall_p90() is None
+    ckpt._SAVE_WALLS.append(2.0)
+    assert ckpt.save_wall_p90() == 2.0
+    ckpt._SAVE_WALLS.extend([1.0] * 9)
+    walls = sorted(ckpt._SAVE_WALLS)
+    pos = 0.9 * (len(walls) - 1)
+    lo = int(pos)
+    expect = walls[lo] * (1 - (pos - lo)) + walls[lo + 1] * (pos - lo)
+    assert ckpt.save_wall_p90() == pytest.approx(expect)
+
+
+# ---------------------------------------------------------------------------
+# Preemption: the grace-deadline machinery
+# ---------------------------------------------------------------------------
+
+
+def test_budget_allows_save_table():
+    # No preemption pending: a normal save, always allowed.
+    preempt.reset()
+    assert preempt.budget_allows_save(0.5) is True
+    # Pending with comfortable grace: p90 * safety fits.
+    preempt.request(grace_s=60.0)
+    assert preempt.budget_allows_save(1.0) is True
+    assert preempt.remaining_grace_s() == pytest.approx(60.0, abs=2.0)
+    # No history: only a grace above the floor gambles on a save.
+    assert preempt.budget_allows_save(None) is True
+    preempt.reset()
+    preempt.request(grace_s=preempt.NO_HISTORY_FLOOR_S / 2)
+    assert preempt.budget_allows_save(None) is False
+    # Tight grace vs measured p90: skip.
+    preempt.reset()
+    preempt.request(grace_s=1.0)
+    assert preempt.budget_allows_save(5.0) is False
+
+
+def test_request_latch_and_notice():
+    assert preempt.requested() is False
+    assert preempt.note_noticed() is False
+    preempt.request(grace_s=30.0)
+    first_deadline = preempt.remaining_grace_s()
+    preempt.request(grace_s=500.0)  # first request wins
+    assert preempt.remaining_grace_s() <= first_deadline
+    assert preempt.note_noticed() is True
+    assert preempt.note_noticed() is False  # latched
+    preempt.reset()
+    assert preempt.requested() is False
+
+
+def test_install_from_env_and_sigterm_handler(monkeypatch):
+    monkeypatch.delenv(preempt.ENV_GRACE, raising=False)
+    assert preempt.install_from_env() is False
+    monkeypatch.setenv(preempt.ENV_GRACE, "not-a-number")
+    assert preempt.install_from_env() is False
+    monkeypatch.setenv(preempt.ENV_GRACE, "45.5")
+    assert preempt.install_from_env() is True
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 5.0
+        while not preempt.requested() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert preempt.requested()
+        rem = preempt.remaining_grace_s()
+        assert rem is not None and 40.0 < rem <= 45.5
+    finally:
+        preempt.uninstall()
+    assert preempt.requested() is False
+
+
+def test_forwarder_relays_sigterm_to_live_ranks():
+    sent = []
+
+    class _Proc:
+        def __init__(self, live=True):
+            self.live = live
+
+        def poll(self):
+            return None if self.live else 0
+
+        def send_signal(self, sig):
+            sent.append(sig)
+
+    restore = preempt.install_forwarder([_Proc(), _Proc(live=False),
+                                         _Proc()])
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 5.0
+        while not preempt.requested() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        # The parent stamped its own notice AND relayed to the live ranks.
+        assert preempt.requested()
+        assert sent == [signal.SIGTERM, signal.SIGTERM]
+    finally:
+        restore()
+        preempt.reset()
+
+
+def test_preempted_exit_is_never_retryable():
+    exc = preempt.Preempted(step=8, saved=True)
+    assert exc.code == preempt.RC_PREEMPTED == 75
+    assert isinstance(exc, SystemExit)
+    assert default_retryable(exc) is False  # run_supervised resumes, not retries
+
+
+# ---------------------------------------------------------------------------
+# Preemption in the segmented loop
+# ---------------------------------------------------------------------------
+
+
+def test_preempt_with_grace_lands_emergency_save(tmp_path):
+    adv, state = _model()
+    preempt.request(grace_s=60.0)
+    with pytest.raises(preempt.Preempted) as ei:
+        ckpt.run_segmented(adv, state, NT, tmp_path, every=EVERY)
+    assert ei.value.saved is True and ei.value.step == EVERY
+    assert ckpt.latest_valid_step(tmp_path) == EVERY
+    names = [r["name"] for r in _events()]
+    assert "preempt.noticed" in names and "preempt.save" in names
+    save = _events("preempt.save")[0]
+    assert save["step"] == EVERY and save["remaining_grace_s"] <= 60.0
+
+
+def test_preempt_without_grace_skips_save_no_torn_artifact(tmp_path):
+    adv, state = _model()
+    ckpt.run_segmented(adv, state, 8, tmp_path, every=EVERY)
+    telemetry.clear_events()
+    _, like = _model()
+    restored = ckpt.restore_state(tmp_path, 8, like)
+    preempt.request(grace_s=0.0)
+    with pytest.raises(preempt.Preempted) as ei:
+        ckpt.run_segmented(adv, restored, NT, tmp_path, every=EVERY,
+                           start_step=8)
+    # The save was skipped OUTRIGHT: the resume point is the prior valid
+    # step and the boundary that skipped left nothing on disk at all.
+    assert ei.value.saved is False and ei.value.step == 8
+    assert ckpt.all_steps(tmp_path) == [4, 8]
+    assert ckpt.latest_valid_step(tmp_path) == 8
+    skip = _events("preempt.skip-save")
+    assert len(skip) == 1 and skip[0]["last_valid_step"] == 8
+    assert not _events("preempt.save")
+
+
+def test_preempt_noticed_after_save_exits_from_fresh_boundary(tmp_path,
+                                                              monkeypatch):
+    """A notice landing DURING the boundary save: the just-published
+    step is the resume point — the loop exits instead of betting another
+    whole segment against the deadline."""
+    adv, state = _model()
+    orig = ckpt._guarded_save
+
+    def hooked(*a, **kw):
+        durable = orig(*a, **kw)
+        if not preempt.requested():
+            preempt.request(grace_s=60.0)
+        return durable
+
+    monkeypatch.setattr(ckpt, "_guarded_save", hooked)
+    with pytest.raises(preempt.Preempted) as ei:
+        ckpt.run_segmented(adv, state, NT, tmp_path, every=EVERY)
+    assert ei.value.step == EVERY and ei.value.saved is True
+    stop = _events("preempt.stop")
+    assert len(stop) == 1 and stop[0]["saved"] is True
+
+
+# ---------------------------------------------------------------------------
+# Gloo-real drills: preemption and the storage outage, 2 ranks
+# ---------------------------------------------------------------------------
+
+DRILL = dict(nx=16, ny=16, nt=16, every=4)
+
+
+def _drill_argv(ck, keep=8, delay=0.0):
+    argv = [
+        str(ROOT / "tests" / "elastic_worker.py"),
+        "--nx", str(DRILL["nx"]), "--ny", str(DRILL["ny"]),
+        "--nt", str(DRILL["nt"]), "--every", str(DRILL["every"]),
+        "--keep", str(keep),
+        "--dir", str(ck),
+    ]
+    if delay:
+        argv += ["--segment-delay-s", str(delay)]
+    return argv
+
+
+def _reference_2rank(ck, start):
+    """The uninterrupted 2-rank twin: restore the drill's own checkpoint
+    at `start` onto 2 devices and advance to nt on the (2, 1) mesh."""
+    from rocm_mpi_tpu.parallel import mesh as pmesh
+
+    devices = jax.devices()[:2]
+    state = ckpt.restore_state(ck, start, like=None, devices=devices)
+    cfg = DiffusionConfig(
+        global_shape=(DRILL["nx"], DRILL["ny"]), lengths=(10.0, 10.0),
+        nt=DRILL["nt"], warmup=0, dtype="f64", dims=(2, 1),
+    )
+    grid = pmesh.init_global_grid(
+        DRILL["nx"], DRILL["ny"], dims=(2, 1), devices=devices
+    )
+    model = HeatDiffusion(cfg, grid=grid)
+    _, Cp = model.init_state()
+    advance = model.advance_fn("perf")
+    return advance(state[0], Cp, DRILL["nt"] - start)
+
+
+def _sigterm_when_step_durable(ck, min_step, procs_box, fired):
+    """Drill helper: deliver SIGTERM to every rank once the checkpoint
+    dir holds a valid step >= min_step (the preemption must interrupt a
+    run that is provably mid-flight, past its first durable boundary).
+
+    The signal is delayed a beat past the durability detection: the
+    manifest lands a few ms before the ranks run their post-save
+    preemption check, so firing the instant the step verifies races
+    that check PER RANK — one rank can exit from the just-saved
+    boundary while its peer runs another segment and strands in a
+    collective (the skew fallback resilience/preempt.py documents).
+    The drill wants the deterministic common case — a notice landing
+    mid-segment, every rank deciding at the SAME next boundary — and
+    the workers' --segment-delay-s stretch guarantees they are still
+    inside the next segment when the delayed signal arrives."""
+
+    def _watch():
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            try:
+                step = ckpt.latest_valid_step(ck)
+            except Exception:  # noqa: BLE001 — sidecar mid-write
+                step = None
+            if step is not None and step >= min_step:
+                time.sleep(0.15)  # into the segment stretch (docstring)
+                for p in procs_box[0]:
+                    try:
+                        if p.poll() is None:
+                            p.send_signal(signal.SIGTERM)
+                    except OSError:
+                        pass
+                fired.set()
+                return
+            time.sleep(0.05)
+
+    t = threading.Thread(target=_watch, daemon=True)
+    t.start()
+    return t
+
+
+@pytest.mark.parametrize("grace_s,saved", [(60.0, True), (0.0, False)])
+def test_preempt_drill_resumes_under_run_elastic(tmp_path, grace_s, saved):
+    """THE preemption acceptance drill: SIGTERM with sufficient grace
+    lands an emergency checkpoint; with insufficient grace the save is
+    skipped (no torn artifact anywhere on disk). Both exits are
+    classified RESUME — run_elastic relaunches on the same topology and
+    the final checkpoint is bitwise-equal to the uninterrupted twin."""
+    ck = tmp_path / "ck"
+    tdir = tmp_path / "telemetry"
+    procs_box = [[]]
+    fired = threading.Event()
+    armed = []
+
+    def on_spawn(procs):
+        # Arm the SIGTERM thread for the FIRST launch only: the
+        # supervised relaunch must run to completion undisturbed.
+        procs_box[0] = procs
+        if not armed:
+            armed.append(True)
+            _sigterm_when_step_durable(ck, 4, procs_box, fired)
+
+    report = run_elastic(
+        _drill_argv(ck, delay=0.75), 2,
+        checkpoint_dir=ck,
+        global_shape=(DRILL["nx"], DRILL["ny"]),
+        sidecar_dir=tmp_path,
+        telemetry_dir=tdir,
+        preempt_grace_s=grace_s,
+        on_spawn=on_spawn,
+        timeout=120,
+        init_timeout_s=60,
+        heartbeat_s=2.0,
+        peer_grace_s=6.0,
+        vanish_grace_s=8.0,
+    )
+    assert fired.is_set(), "the drill never delivered its SIGTERM"
+    # Launch 0 was preempted — rc 75 on every rank, judged a RESUME
+    # (not a failure: no shrink, no give-up), then the relaunch finished.
+    assert report.resumes == 1, report.launches
+    assert report.shrinks == 0 and report.grows == 0
+    assert report.launches[0]["status"] == "preempted"
+    assert report.launches[0]["returncodes"] == [75, 75]
+    assert report.launches[1]["ok"]
+    names = [e["name"] for e in report.events]
+    assert names == ["elastic.launch", "elastic.resume",
+                     "elastic.launch", "elastic.complete"]
+    resume_step = report.events[1]["resume_step"]
+    assert resume_step is not None and resume_step >= 4
+    # No torn artifact: every step dir on disk verifies.
+    for step in ckpt.all_steps(ck):
+        ok, reason = ckpt.verify_step(ck, step)
+        assert ok, (step, reason)
+    assert ckpt.latest_valid_step(ck) == DRILL["nt"]
+    # The ranks' own decision trail: an emergency save with grace, a
+    # skip without — and the archived stream passes the schema gate.
+    stream = (tdir / "telemetry-rank0.jsonl").read_text()
+    if saved:
+        assert '"preempt.save"' in stream
+    else:
+        assert '"preempt.skip-save"' in stream
+        assert '"preempt.save"' not in stream
+    assert regress.check_schema([str(tdir / "telemetry-rank0.jsonl")]) == []
+    # Bitwise: final state == the uninterrupted 2-rank continuation from
+    # the step the resume actually restored.
+    final = ckpt.restore_state(ck, DRILL["nt"], like=None,
+                               devices=jax.devices()[:2])
+    ref = _reference_2rank(ck, resume_step)
+    np.testing.assert_array_equal(np.asarray(final[0]), np.asarray(ref))
+
+
+STORAGE_SPECS = {
+    "io-error": "io-error@step=8,times=2;io-error@step=12",
+    "io-slow": "io-slow=1.2@step=8;io-slow=1.2@step=12",
+    "enospc": "enospc@step=8,times=2;enospc@step=12",
+}
+
+
+@pytest.mark.parametrize("kind", sorted(STORAGE_SPECS))
+def test_storage_outage_drill_gloo(tmp_path, monkeypatch, kind):
+    """THE storage acceptance drill: a 2-rank gloo run with an injected
+    outage spanning two consecutive saves stays ALIVE in degraded mode
+    (every rank skips the same saves — no rank enters a save barrier its
+    peer refused), recovers at the first healthy boundary, and the loss
+    window during the outage was bounded by the last pre-outage step."""
+    from rocm_mpi_tpu.parallel.launcher import spawn_ranks
+
+    monkeypatch.setenv("RMT_CKPT_RETRIES", "1")
+    monkeypatch.setenv("RMT_CKPT_BACKOFF_S", "0.05")
+    if kind == "io-slow":
+        # Watchdog threshold well above a natural 2-rank orbax save wall
+        # but well below the injected stall: only the drill trips it.
+        monkeypatch.setenv("RMT_CKPT_SLOW_S", "0.6")
+    ck = tmp_path / "ck"
+    tdir = tmp_path / "telemetry"
+    hdir = tmp_path / "health"
+    results = spawn_ranks(
+        _drill_argv(ck), nprocs=2,
+        inject_fault=STORAGE_SPECS[kind],
+        telemetry_dir=tdir,
+        health_dir=hdir,
+        timeout=120,
+        init_timeout_s=60,
+        heartbeat_s=1.0,
+        peer_grace_s=6.0,
+    )
+    for pid, (p, (out, err)) in enumerate(results):
+        assert p.returncode == 0, (pid, err[-800:])
+        assert "ELASTIC_WORKER_DONE" in out
+    steps = ckpt.all_steps(ck)
+    if kind == "io-slow":
+        # Slow saves are still durable saves: nothing lost.
+        assert steps == [4, 8, 12, 16]
+    else:
+        # The outage steps never existed; the pre-outage step bounds the
+        # loss window a crash during the outage would have paid.
+        assert steps == [4, 16]
+    assert ckpt.latest_valid_step(ck) == DRILL["nt"]
+    stream = (tdir / "telemetry-rank0.jsonl").read_text()
+    assert '"ckpt.degraded"' in stream and '"ckpt.recovered"' in stream
+    if kind == "io-error":
+        assert '"ckpt.retry"' in stream
+    if kind == "enospc":
+        assert '"ckpt.enospc-prune"' in stream
+    assert regress.check_schema([str(tdir / "telemetry-rank0.jsonl")]) == []
+    # The monitor-side view: the heartbeat counters say the outage came
+    # and went — recovered, with the skip count preserved.
+    beats, _ = health.load_heartbeats(hdir)
+    status = health.storage_status(beats)
+    if kind == "io-slow":
+        assert status is None or status["degraded"] is False
+    else:
+        assert status is not None and status["degraded"] is False
+        assert status["skipped"] >= 2
+        assert "recovered" in health.format_storage_status(status)
+
+
+def test_storage_and_monitor_schema_fixtures(tmp_path):
+    """The new record families, round-tripped through the schema gate:
+    a grow record without its rank counts fails, preempt/ckpt event
+    records without their anchors fail, well-formed ones pass."""
+    good = tmp_path / "elastic.jsonl"
+    health.append_elastic_event(tmp_path, "elastic.grow", old_nprocs=1,
+                                new_nprocs=2, old_mesh=[1, 1],
+                                new_mesh=[2, 1], resume_step=8,
+                                reason="device-budget")
+    assert regress.check_schema([str(good)]) == []
+    bad = tmp_path / "bad-elastic.jsonl"
+    bad.write_text(json.dumps({
+        "schema": health.ELASTIC_SCHEMA, "v": 1, "kind": "event",
+        "name": "elastic.grow", "t": 1.0,
+    }) + "\n")
+    problems = regress.check_schema([str(bad)])
+    assert any("old_nprocs" in p for p in problems)
+    events = tmp_path / "events.jsonl"
+    events.write_text("\n".join([
+        json.dumps({"v": 2, "kind": "event", "name": "preempt.noticed",
+                    "t": 1.0, "t_mono": 1.0, "rank": 0, "step": 8,
+                    "remaining_grace_s": 20.0}),
+        json.dumps({"v": 2, "kind": "event", "name": "ckpt.degraded",
+                    "t": 1.0, "t_mono": 1.0, "rank": 0, "step": 8,
+                    "reason": "io-error"}),
+    ]) + "\n")
+    assert regress.check_schema([str(events)]) == []
+    torn = tmp_path / "torn-events.jsonl"
+    torn.write_text("\n".join([
+        json.dumps({"v": 2, "kind": "event", "name": "preempt.save",
+                    "t": 1.0}),
+        json.dumps({"v": 2, "kind": "event", "name": "ckpt.degraded",
+                    "t": 1.0, "step": 8}),
+    ]) + "\n")
+    problems = regress.check_schema([str(torn)])
+    assert any("preempt.save event missing int step" in p
+               for p in problems)
+    assert any("ckpt.degraded event missing reason" in p
+               for p in problems)
