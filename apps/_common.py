@@ -93,6 +93,7 @@ def make_parser(
         help="use deep-halo sweeps: exchange width-K ghosts every K steps "
         "instead of width-1 every step (parallel.deep_halo; f32/bf16)",
     )
+    add_wire_mode_flag(p)
     add_driver_flag(p)
     p.add_argument(
         "--save-field", default=None, metavar="PATH.npy",
@@ -102,6 +103,22 @@ def make_parser(
     add_health_flag(p)
     add_checkpoint_flags(p)
     return p
+
+
+def add_wire_mode_flag(p) -> None:
+    """The shared --wire-mode knob (docs/PERF.md "Wire precision"): the
+    halo exchange's on-wire slab precision. The stateful int8 modes are
+    deep-halo-only (the per-step programs are stateless); telemetry
+    stamps the mode on every exchange annotation and run gauge so
+    reduced-wire summaries can't be regress-compared to f32 ones."""
+    from rocm_mpi_tpu.parallel.wire import WIRE_MODES
+
+    p.add_argument(
+        "--wire-mode", default="f32", choices=list(WIRE_MODES),
+        help="on-wire halo slab precision (default f32 — bitwise-"
+        "identical exchange; bf16 halves the wire; int8/int8_delta "
+        "quantize with error feedback and need --deep)",
+    )
 
 
 def add_driver_flag(p) -> None:
@@ -429,6 +446,8 @@ def build_config(args):
     kwargs = {}
     if args.transport:
         kwargs["halo_transport"] = args.transport
+    if getattr(args, "wire_mode", None):
+        kwargs["wire_mode"] = args.wire_mode
     if getattr(args, "b_width", None):
         kwargs["b_width"] = tuple(int(b) for b in args.b_width.split(","))
     shape = (args.nx, args.ny)
@@ -449,12 +468,15 @@ def build_config(args):
     return cfg
 
 
-def emit_run_gauges(result, variant: str, driver: str | None = None) -> None:
+def emit_run_gauges(result, variant: str, driver: str | None = None,
+                    wire: str | None = None) -> None:
     """Bank the run's headline rates into the telemetry stream (no-op
     when collection is off; rate properties divide by the timed window,
     so a fully-resumed nt=0 run emits nothing). `driver` stamps the loop
-    form (step/scan) on the gauges so summaries from different drivers
-    can't be compared silently."""
+    form (step/scan) and `wire` the on-wire halo precision on the
+    gauges, so summaries from different drivers or wire modes can't be
+    compared silently (aggregate folds non-f32 wire into the gauge key,
+    like the driver suffix)."""
     from rocm_mpi_tpu import telemetry
 
     if not telemetry.enabled() or not result.nt or not result.wtime:
@@ -462,6 +484,8 @@ def emit_run_gauges(result, variant: str, driver: str | None = None) -> None:
     attrs = {"variant": variant}
     if driver is not None:
         attrs["driver"] = driver
+    if wire is not None:
+        attrs["wire"] = wire
     telemetry.gauge("run.gpts", result.gpts, **attrs)
     telemetry.gauge("run.t_eff_gbs", result.t_eff, **attrs)
 
@@ -528,7 +552,8 @@ def run_app(variant: str, args) -> int:
         with profile_ctx:
             result = runner()
         report_checkpointed_line(result, args, log0)
-        emit_run_gauges(result, variant)
+        emit_run_gauges(result, variant,
+                        wire=getattr(args, "wire_mode", None))
     else:
         log0("Starting the time loop 🚀...", end="")
         driver = getattr(args, "driver", "step")
@@ -538,7 +563,10 @@ def run_app(variant: str, args) -> int:
                 # --driver selects among the per-step loop forms only.
                 # Stamp "deep" — the same spelling weak_scaling uses — so
                 # the two harnesses' gauges land under one key.
-                result = model.run_deep(block_steps=args.deep)
+                result = model.run_deep(
+                    block_steps=args.deep,
+                    wire_mode=getattr(args, "wire_mode", None),
+                )
                 driver = "deep"
             else:
                 result = model.run(variant=variant, driver=driver)
@@ -550,7 +578,8 @@ def run_app(variant: str, args) -> int:
             f"(@ T_eff = {result.t_eff:.2f} GB/s aggregate, "
             f"{per_chip:.2f} GB/s/chip, {result.gpts:.4f} Gpts/s)"
         )
-        emit_run_gauges(result, variant, driver=driver)
+        emit_run_gauges(result, variant, driver=driver,
+                        wire=getattr(args, "wire_mode", None))
 
     T_v = (
         gather_to_host0(result.T)

@@ -56,8 +56,14 @@ def make_parser():
         help="dump the final gathered displacement as .npy on process 0 "
         "(the machine-readable artifact, SURVEY.md §5.4)",
     )
-    from _common import add_checkpoint_flags, add_driver_flag, add_telemetry_flag
+    from _common import (
+        add_checkpoint_flags,
+        add_driver_flag,
+        add_telemetry_flag,
+        add_wire_mode_flag,
+    )
 
+    add_wire_mode_flag(p)
     add_driver_flag(p)
     add_telemetry_flag(p)
     add_checkpoint_flags(p)
@@ -82,6 +88,7 @@ def main(argv=None) -> int:
         warmup=args.warmup,
         dtype=args.dtype,
         dims=dims,
+        wire_mode=args.wire_mode,
     )
     model = AcousticWave(cfg)
     grid = model.grid

@@ -523,6 +523,36 @@ def run_suite() -> None:
             model_cls(mcfg_v).run_vmem_resident(config="auto"),
         )
 
+    # The wire-mode ladder (ROADMAP item 5's f32-vs-bf16 delta, docs/
+    # PERF.md "Wire precision"): the SAME sharded schedule per row, only
+    # the on-wire halo precision varies — the pair the next healthy chip
+    # window finally banks as a measured wire delta. Needs a real mesh
+    # (one device has no exchange to shrink); the suite's single-chip
+    # rows above are unaffected either way.
+    import jax as _jax
+
+    n_dev = len(_jax.devices())
+    if n_dev >= 2:
+        from rocm_mpi_tpu.parallel.mesh import suggest_dims
+
+        wire_dims = suggest_dims(n_dev, 2)
+        for wm in ("f32", "bf16"):
+            wcfg = DiffusionConfig(
+                global_shape=tuple(252 * d for d in wire_dims),
+                lengths=(10.0,) * 2, nt=220_000, warmup=20_000,
+                dtype="f32", dims=wire_dims, wire_mode=wm,
+            )
+            report(
+                f"252²/dev shard wire={wm} ({n_dev}dev)",
+                HeatDiffusion(wcfg).run(variant="perf"),
+            )
+    else:
+        print(
+            "bench.py --suite: single device — skipping the wire-mode "
+            "ladder rows (no exchange to measure)",
+            file=sys.stderr,
+        )
+
     # Bank the autotuner's resolve outcomes (tune.hits / tune.misses run
     # gauges + the per-key tune.resolve annotations) before the record:
     # a suite steered by a warm cache and one running hand defaults are

@@ -19,6 +19,13 @@ they encode:
   silently untested on CPU paths that never take the compiled branch).
 * **grid under-coverage** — with fully literal grid/block/out shapes,
   grid[i] * block[i] < shape[i] leaves cells unwritten.
+* **raw wire-slab arithmetic** — the wire-precision seam (PR 12,
+  parallel/wire.py): a slab received from ``neighbor_shift``/``ppermute``
+  whose SENT payload was downcast (``.astype(jnp.bfloat16)``, an
+  ``encode_slab``/``quantize_slab`` call, a wire bitcast) used in
+  arithmetic without first decoding/upcasting back to the compute dtype.
+  The storage-only-bf16 convention applied to the wire: reduced
+  precision rides the collective, never the seam accumulation.
 """
 
 from __future__ import annotations
@@ -37,6 +44,19 @@ _REF_OK_CALLEES = {"load", "store", "swap", "dslice", "ds"}
 _UNTAINT_CALLEES = {"_upcast_for_compute", "astype"}
 _ARITH_OPS = (ast.Add, ast.Sub, ast.Mult, ast.Div, ast.FloorDiv, ast.Mod,
               ast.Pow, ast.MatMult)
+
+# ---- wire-seam vocabulary (parallel/wire.py + parallel/halo.py) ----------
+# Ship points: the collective the wire payload rides.
+_WIRE_SHIP_CALLEES = {"neighbor_shift", "ppermute"}
+# Downcast markers inside a shipped expression (a reduced-precision
+# payload on the wire).
+_WIRE_ENCODE_CALLEES = {"encode_slab", "quantize_slab",
+                        "bitcast_convert_type"}
+_WIRE_NARROW_DTYPES = ("bfloat16", "int8", "uint16", "float16")
+# Decode/upcast chokepoints that launder the received-slab taint.
+_WIRE_DECODE_CALLEES = {"astype", "_upcast_for_compute", "decode_slab",
+                        "dequantize_slab", "dequantize",
+                        "_dequantize_int8"}
 
 
 def _ref_params(fn: ast.FunctionDef) -> set[str]:
@@ -226,6 +246,159 @@ class _KernelChecker:
                         self.tainted.add(n.id)
 
 
+def _wire_stmts_in_order(body):
+    """Statements in source order, compound bodies inline, nested
+    function defs skipped (they are walked as their own scope)."""
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        yield stmt
+        for attr in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, attr, None)
+            if isinstance(sub, list):
+                yield from _wire_stmts_in_order(
+                    [s for s in sub if isinstance(s, ast.stmt)]
+                )
+        for handler in getattr(stmt, "handlers", []):
+            yield from _wire_stmts_in_order(handler.body)
+
+
+def _expr_has_downcast(node: ast.AST) -> bool:
+    """Does this (to-be-shipped) expression narrow its payload — an
+    encode/quantize call, a wire bitcast, or .astype to a narrow dtype?"""
+    for n in ast.walk(node):
+        if not isinstance(n, ast.Call):
+            continue
+        callee = astutil.tail_name(astutil.call_name(n))
+        if callee in _WIRE_ENCODE_CALLEES:
+            return True
+        if callee == "astype":
+            for a in list(n.args) + [kw.value for kw in n.keywords]:
+                dump = ast.dump(a)
+                if any(d in dump for d in _WIRE_NARROW_DTYPES):
+                    return True
+    return False
+
+
+class _WireSeamChecker:
+    """Per-function flow check of the wire-precision seam: a name bound
+    to the RESULT of a ship call (`x = neighbor_shift(payload, …)`)
+    whose payload was downcast is tainted; arithmetic on it without a
+    decode/upcast (`.astype`, `decode_slab`, …) fires GL04. Names
+    holding downcast payloads propagate the marker, so
+    `p = u.astype(jnp.bfloat16); g = ppermute(p, …)` taints `g` too."""
+
+    def __init__(self, rule, ctx, fn):
+        self.rule = rule
+        self.ctx = ctx
+        self.fn = fn
+        self.downcast: set[str] = set()
+        self.tainted: set[str] = set()
+        self.findings: list = []
+
+    def _taint_of(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Call):
+            callee = astutil.tail_name(astutil.call_name(node))
+            if callee in _WIRE_DECODE_CALLEES:
+                return False
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            return any(self._taint_of(a) for a in args)
+        if isinstance(node, ast.Attribute):
+            if node.attr in _WIRE_DECODE_CALLEES:
+                return False
+            return self._taint_of(node.value)
+        if isinstance(node, ast.BinOp):
+            return self._taint_of(node.left) or self._taint_of(node.right)
+        if isinstance(node, (ast.Subscript, ast.Starred)):
+            return self._taint_of(node.value)
+        if isinstance(node, ast.UnaryOp):
+            return self._taint_of(node.operand)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self._taint_of(e) for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return self._taint_of(node.body) or self._taint_of(node.orelse)
+        return False
+
+    def _ship_of(self, value: ast.AST) -> ast.Call | None:
+        """The ship call if `value` IS one (possibly wrapped in astype —
+        which then untaints anyway)."""
+        if isinstance(value, ast.Call) and astutil.tail_name(
+            astutil.call_name(value)
+        ) in _WIRE_SHIP_CALLEES:
+            return value
+        return None
+
+    def run(self):
+        for stmt in _wire_stmts_in_order(self.fn.body):
+            for root in ast.iter_child_nodes(stmt):
+                if not isinstance(root, ast.expr):
+                    continue
+                for node in astutil.walk_no_nested_functions(root):
+                    if not (isinstance(node, ast.BinOp) and
+                            isinstance(node.op, _ARITH_OPS)):
+                        continue
+                    if not (self._taint_of(node.left) or
+                            self._taint_of(node.right)):
+                        continue
+                    self.findings.append(self.ctx.finding(
+                        node, self.rule,
+                        f"arithmetic on a reduced-precision received "
+                        f"slab in '{self.fn.name}' without the f32 "
+                        "upcast at the seam — wire precision "
+                        "(bf16/int8 payloads) is wire-only; the seam "
+                        "must consume decoded slabs "
+                        "(parallel/wire.py)",
+                        "decode/upcast the received slab "
+                        "(.astype(jnp.float32) / wire.slab_codec "
+                        "recv) before any arithmetic or seam "
+                        "accumulation",
+                    ))
+            if isinstance(stmt, ast.Assign):
+                value = stmt.value
+                ship = self._ship_of(value)
+                if ship is not None:
+                    # `x = neighbor_shift(payload, …)`: x is tainted iff
+                    # the payload was downcast (directly, or via a name
+                    # holding a downcast payload).
+                    taints = bool(ship.args) and (
+                        _expr_has_downcast(ship.args[0])
+                        or self._mentions_downcast(ship.args[0])
+                    )
+                else:
+                    taints = self._taint_of(value)
+                # A decode/upcast call clears the downcast marker —
+                # UNLESS it is itself narrowing (`u.astype(jnp.bfloat16)`
+                # spells astype too, but it is the encode).
+                is_decode = (
+                    isinstance(value, ast.Call)
+                    and astutil.tail_name(astutil.call_name(value))
+                    in _WIRE_DECODE_CALLEES
+                    and not _expr_has_downcast(value)
+                )
+                marks_downcast = not is_decode and (
+                    _expr_has_downcast(value)
+                    or (ship is None and self._mentions_downcast(value))
+                )
+                for t in stmt.targets:
+                    for n in ast.walk(t):
+                        if not isinstance(n, ast.Name):
+                            continue
+                        (self.tainted.add if taints
+                         else self.tainted.discard)(n.id)
+                        (self.downcast.add if marks_downcast
+                         else self.downcast.discard)(n.id)
+        return self.findings
+
+    def _mentions_downcast(self, node: ast.AST) -> bool:
+        return any(
+            isinstance(n, ast.Name) and n.id in self.downcast
+            for n in ast.walk(node)
+        )
+
+
 class PallasHygieneRule(Rule):
     id = "GL04"
     name = "pallas-hygiene"
@@ -249,6 +422,11 @@ class PallasHygieneRule(Rule):
             findings.extend(
                 _KernelChecker(self, ctx, fn, module_has_upcast).run()
             )
+        # The wire-precision seam check runs on EVERY function (the
+        # exchange seam lives in shard_map bodies, not Pallas kernels).
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(_WireSeamChecker(self, ctx, node).run())
         # Spec checks run on EVERY pallas_call, including ones whose
         # kernel body could not be resolved (or is shared with another
         # call that has a different grid).

@@ -52,6 +52,11 @@ class DiffusionConfig:
     halo_transport: str = dataclasses.field(
         default_factory=lambda: os.environ.get(HALO_TRANSPORT_ENV, "ici")
     )
+    # On-wire halo slab precision (parallel/wire.py): "f32" (default,
+    # bitwise-identical to the pre-wire-plane exchange), "bf16", or the
+    # stateful "int8"/"int8_delta" modes (deep-halo schedules only —
+    # per-step variants are stateless programs).
+    wire_mode: str = "f32"
 
     def __post_init__(self):
         if len(self.lengths) != len(self.global_shape):
@@ -60,6 +65,9 @@ class DiffusionConfig:
             raise ValueError(f"dtype must be one of {sorted(DTYPES)}")
         if self.halo_transport not in ("ici", "host"):
             raise ValueError("halo_transport must be 'ici' or 'host'")
+        from rocm_mpi_tpu.parallel import wire
+
+        wire.validate_mode(self.wire_mode)
 
     @property
     def ndim(self) -> int:

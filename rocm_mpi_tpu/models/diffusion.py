@@ -287,9 +287,11 @@ class HeatDiffusion:
         boundary cells. This is the structure the perf/hide ladder builds on.
         """
 
+        wire_mode = self.config.wire_mode
+
         def step(T, Cp, lam, dt, spacing, grid):
             def local_step(Tl, Cpl):
-                Tp = exchange_halo(Tl, grid)
+                Tp = exchange_halo(Tl, grid, wire_mode=wire_mode)
                 new = padded_update(Tp, Cpl, lam, dt, spacing)
                 return jnp.where(global_boundary_mask(grid), Tl, new)
 
@@ -346,9 +348,11 @@ class HeatDiffusion:
 
             return step, prepare
 
+        wire_mode = self.config.wire_mode
+
         def step(T, Cm, lam, dt, spacing, grid_):
             def local(Tl, Cml):
-                Tp = exchange_halo(Tl, grid)
+                Tp = exchange_halo(Tl, grid, wire_mode=wire_mode)
                 return fused_step_cm(Tp, Cml, spacing)
 
             return shard_map(
@@ -432,7 +436,8 @@ class HeatDiffusion:
 
         pu = lambda tp, cm, lam, dt, spacing: _cm_kernel(tp, cm, spacing)
         local = make_overlap_step(
-            grid, pu, cfg.b_width, mask_boundary=False
+            grid, pu, cfg.b_width, mask_boundary=False,
+            wire_mode=cfg.wire_mode,
         )
         prepare = self._cm_prepare()
 
@@ -563,6 +568,16 @@ class HeatDiffusion:
             if variant == "shard":
                 return self._run_host_staged(nt, warmup)
             warn_host_transport_ignored(variant)
+        if cfg.wire_mode != "f32" and variant in ("ap", "fused"):
+            import warnings
+
+            warnings.warn(
+                f"wire_mode={cfg.wire_mode!r} is not honored by variant "
+                f"{variant!r} — the GSPMD global-array variants have no "
+                "explicit exchange to encode; use shard/perf/hide or the "
+                "deep schedule.",
+                stacklevel=2,
+            )
         T, Cp = self.init_state()
         if driver == "scan":
             # q divides both windows by construction (gcd).
@@ -808,12 +823,29 @@ class HeatDiffusion:
             stacklevel=3,
         )
 
+    def effective_wire_mode(
+        self, wire_mode: str | None = None, config: str | None = None,
+    ) -> str:
+        """The state exchange's on-wire precision a deep run will use:
+        an explicit `wire_mode` wins, else `config="auto"` consults the
+        tuning cache (the PR-12 wire axis of the "diffusion.deep"
+        entry), else the config's wire_mode field (default "f32")."""
+        if wire_mode is not None:
+            return wire_mode
+        from rocm_mpi_tpu.parallel.deep_halo import resolve_deep_config
+
+        tuned = resolve_deep_config(
+            self.grid, self.config.jax_dtype, config
+        )["wire_mode"]
+        return tuned if tuned is not None else self.config.wire_mode
+
     def deep_advance_fn(
         self,
         block_steps: int | None = None,
         nt: int | None = None,
         warmup: int | None = None,
         config: str | None = None,
+        wire_mode: str | None = None,
     ):
         """(jitted (T, Cp, n_steps) -> T, executed depth k) — the deep
         schedule's advance as a first-class function, so callers beyond
@@ -821,7 +853,11 @@ class HeatDiffusion:
         `n_steps` must be a multiple of k (the fori_loop trip count
         floors) — the step-count convention every model's deep advance
         shares (wave/swe match). `config="auto"` lets an unset
-        block_steps consult the tuning cache (effective_deep_depth)."""
+        block_steps (and an unset wire_mode) consult the tuning cache
+        (effective_deep_depth / effective_wire_mode). For the stateful
+        wire modes the advance carries the exchange state internally
+        (zero-initialized per call — the first-sweep contract) and still
+        returns just T."""
         from rocm_mpi_tpu.parallel.deep_halo import make_deep_sweep
 
         cfg = self.config
@@ -831,18 +867,39 @@ class HeatDiffusion:
             warn_host_transport_ignored("deep", stacklevel=3)
         k = self.effective_deep_depth(nt, warmup, block_steps,
                                       config=config)
+        wm = self.effective_wire_mode(wire_mode, config)
         dt = cfg.jax_dtype(cfg.dt)
-        sched = make_deep_sweep(self.grid, k, cfg.lam, dt, cfg.spacing)
+        sched = make_deep_sweep(self.grid, k, cfg.lam, dt, cfg.spacing,
+                                wire_mode=wm)
 
-        @functools.partial(jax.jit, donate_argnums=0)
-        def advance(T, Cp, n_steps):
-            # The time-invariant coefficient's width-k exchange + masking
-            # runs ONCE per compiled advance, outside the sweep loop — the
-            # loop carries only the bare field (DeepSchedule contract).
-            Cm = sched.prepare(Cp)
-            return lax.fori_loop(
-                0, n_steps // k, lambda _, x: sched.sweep(x, Cm), T
-            )
+        if sched.init_wire is None:
+
+            @functools.partial(jax.jit, donate_argnums=0)
+            def advance(T, Cp, n_steps):
+                # The time-invariant coefficient's width-k exchange +
+                # masking runs ONCE per compiled advance, outside the
+                # sweep loop — the loop carries only the bare field
+                # (DeepSchedule contract).
+                Cm = sched.prepare(Cp)
+                return lax.fori_loop(
+                    0, n_steps // k, lambda _, x: sched.sweep(x, Cm), T
+                )
+
+        else:
+
+            @functools.partial(jax.jit, donate_argnums=0)
+            def advance(T, Cp, n_steps):
+                Cm = sched.prepare(Cp)
+                ws0 = sched.init_wire(T.dtype)
+
+                def body(_, carry):
+                    T_, ws = carry
+                    return sched.sweep(T_, Cm, ws)
+
+                T_out, _ws = lax.fori_loop(
+                    0, n_steps // k, body, (T, ws0)
+                )
+                return T_out
 
         return advance, k
 
@@ -852,6 +909,7 @@ class HeatDiffusion:
         warmup: int | None = None,
         block_steps: int | None = None,
         config: str | None = None,
+        wire_mode: str | None = None,
     ) -> RunResult:
         """Sharded fast path: deep-halo sweeps (parallel.deep_halo) — one
         width-k ghost exchange per k steps, the multi-chip form of temporal
@@ -870,7 +928,8 @@ class HeatDiffusion:
         if not 0 <= warmup < nt:
             raise ValueError(f"need 0 <= warmup < nt, got {warmup}, {nt}")
         advance, _ = self.deep_advance_fn(
-            block_steps=block_steps, nt=nt, warmup=warmup, config=config
+            block_steps=block_steps, nt=nt, warmup=warmup, config=config,
+            wire_mode=wire_mode,
         )
         T, Cp = self.init_state()
         timer = metrics.Timer(label="step_window", phase="step",
@@ -898,7 +957,8 @@ class HeatDiffusion:
         cfg = self.config
         T, Cp = self.init_state()
         T_np, Cp_np = np.asarray(T), np.asarray(Cp)
-        stepper = HostStagedStepper(self.grid, cfg.lam, cfg.dt)
+        stepper = HostStagedStepper(self.grid, cfg.lam, cfg.dt,
+                                    wire_mode=cfg.wire_mode)
         timer = metrics.Timer(label="step_window", phase="step",
                               steps=nt - warmup, variant="shard-host",
                               workload="diffusion")

@@ -71,12 +71,18 @@ class SWEConfig:
     dtype: str = "f64"
     dims: tuple[int, ...] | None = None
     b_width: tuple[int, ...] = (32, 4)
+    # On-wire halo slab precision (parallel/wire.py; same contract as
+    # DiffusionConfig.wire_mode — stateful modes are deep-only).
+    wire_mode: str = "f32"
 
     def __post_init__(self):
         if len(self.lengths) != len(self.global_shape):
             raise ValueError("lengths rank must match global_shape rank")
         if self.dtype not in DTYPES:
             raise ValueError(f"dtype must be one of {sorted(DTYPES)}")
+        from rocm_mpi_tpu.parallel import wire
+
+        wire.validate_mode(self.wire_mode)
 
     @property
     def ndim(self) -> int:
@@ -211,7 +217,8 @@ class ShallowWater:
                 def local(hl, *rest):
                     uls, Ml = rest[: cfg.ndim], rest[cfg.ndim:]
                     Sp = tuple(
-                        exchange_halo(f, grid) for f in (hl,) + tuple(uls)
+                        exchange_halo(f, grid, wire_mode=cfg.wire_mode)
+                        for f in (hl,) + tuple(uls)
                     )
                     outs = swe_step_padded_pallas(
                         Sp, Ml, (cfg.H0, cfg.g), dt, cfg.spacing
@@ -246,7 +253,8 @@ class ShallowWater:
             # Walls ride the mask data — no Dirichlet where (the Cm-style
             # mask_boundary=False contract).
             local = make_overlap_step(
-                grid, pu, cfg.b_width, mask_boundary=False
+                grid, pu, cfg.b_width, mask_boundary=False,
+                wire_mode=cfg.wire_mode,
             )
 
             def step(h, us):
@@ -463,31 +471,52 @@ class ShallowWater:
         block_steps: int | None = None,
         nt: int | None = None,
         warmup: int | None = None,
+        wire_mode: str | None = None,
     ):
         """(jitted (h, us, Mus, n_steps) -> (h, us), executed depth k) —
         the SWE deep schedule's advance as a first-class function
         (HeatDiffusion.deep_advance_fn); `n_steps` must be a multiple of
         k (the fori_loop trip count floors). Mus is accepted and ignored
         so the signature matches advance_fn's (deep sweeps build padded
-        masks internally)."""
+        masks internally). `wire_mode` overrides the config's on-wire
+        precision; stateful modes carry the exchange state internally."""
         from rocm_mpi_tpu.parallel.deep_halo import make_swe_deep_sweep
 
         cfg = self.config
         k = self.effective_deep_depth(nt, warmup, block_steps)
+        wm = cfg.wire_mode if wire_mode is None else wire_mode
         sched = make_swe_deep_sweep(
-            self.grid, k, cfg.dt, cfg.spacing, cfg.H0, cfg.g
+            self.grid, k, cfg.dt, cfg.spacing, cfg.H0, cfg.g,
+            wire_mode=wm,
         )
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
-        def advance(h, us, Mus, n):
-            del Mus
-            # The padded face masks are geometry-only: built ONCE per
-            # compiled advance (DeepSchedule.prepare), not inside every
-            # sweep — the loop carries only the coupled state.
-            Mp = sched.prepare(h)
-            return lax.fori_loop(
-                0, n // k, lambda _, s: sched.sweep(s[0], s[1], Mp), (h, us)
-            )
+        if sched.init_wire is None:
+
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def advance(h, us, Mus, n):
+                del Mus
+                # The padded face masks are geometry-only: built ONCE per
+                # compiled advance (DeepSchedule.prepare), not inside every
+                # sweep — the loop carries only the coupled state.
+                Mp = sched.prepare(h)
+                return lax.fori_loop(
+                    0, n // k, lambda _, s: sched.sweep(s[0], s[1], Mp),
+                    (h, us),
+                )
+
+        else:
+
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def advance(h, us, Mus, n):
+                del Mus
+                Mp = sched.prepare(h)
+                ws0 = sched.init_wire(h.dtype)
+                out = lax.fori_loop(
+                    0, n // k,
+                    lambda _, s: sched.sweep(s[0], s[1], Mp, s[2]),
+                    (h, us, ws0),
+                )
+                return out[0], out[1]
 
         return advance, k
 
@@ -496,9 +525,11 @@ class ShallowWater:
         nt: int | None = None,
         warmup: int | None = None,
         block_steps: int | None = None,
+        wire_mode: str | None = None,
     ) -> SWERunResult:
         """Sharded fast path: deep-halo sweeps — ONE width-k ghost
         exchange of the whole coupled state per k steps
         (parallel.deep_halo.make_swe_deep_sweep)."""
-        advance, _ = self.deep_advance_fn(block_steps, nt, warmup)
+        advance, _ = self.deep_advance_fn(block_steps, nt, warmup,
+                                          wire_mode=wire_mode)
         return self._run_timed(advance, nt, warmup)

@@ -71,12 +71,18 @@ class WaveConfig:
     # knob, hide.jl:42 — same default as DiffusionConfig; clamped per-shard
     # by parallel.overlap.effective_b_width).
     b_width: tuple[int, ...] = (32, 4)
+    # On-wire halo slab precision (parallel/wire.py; same contract as
+    # DiffusionConfig.wire_mode — stateful modes are deep-only).
+    wire_mode: str = "f32"
 
     def __post_init__(self):
         if len(self.lengths) != len(self.global_shape):
             raise ValueError("lengths rank must match global_shape rank")
         if self.dtype not in DTYPES:
             raise ValueError(f"dtype must be one of {sorted(DTYPES)}")
+        from rocm_mpi_tpu.parallel import wire
+
+        wire.validate_mode(self.wire_mode)
 
     @property
     def ndim(self) -> int:
@@ -210,7 +216,8 @@ class AcousticWave:
                 del P
 
                 def local(Ul, Upl, C2l):
-                    pad = exchange_halo(Ul, grid)
+                    pad = exchange_halo(Ul, grid,
+                                        wire_mode=cfg.wire_mode)
                     new = wave_step_padded_pallas(
                         pad, Upl, C2l, dt, cfg.spacing
                     )
@@ -254,7 +261,8 @@ class AcousticWave:
                 )
 
             local = make_overlap_step(
-                grid, pu, cfg.b_width, mask_boundary=False
+                grid, pu, cfg.b_width, mask_boundary=False,
+                wire_mode=cfg.wire_mode,
             )
 
             def step(U, Uprev, C2, P):
@@ -476,28 +484,49 @@ class AcousticWave:
         block_steps: int | None = None,
         nt: int | None = None,
         warmup: int | None = None,
+        wire_mode: str | None = None,
     ):
         """(jitted (U, Uprev, C2, n_steps) -> (U, Uprev), executed depth
         k) — the wave deep schedule's advance as a first-class function
         (HeatDiffusion.deep_advance_fn); `n_steps` must be a multiple of
-        k (the fori_loop trip count floors)."""
+        k (the fori_loop trip count floors). `wire_mode` overrides the
+        config's on-wire precision; the stateful modes carry the
+        exchange state internally (zero-initialized per call)."""
         from rocm_mpi_tpu.parallel.deep_halo import make_wave_deep_sweep
 
         cfg = self.config
         k = self.effective_deep_depth(nt, warmup, block_steps)
         dt = cfg.jax_dtype(cfg.dt)
-        sched = make_wave_deep_sweep(self.grid, k, dt, cfg.spacing)
+        wm = cfg.wire_mode if wire_mode is None else wire_mode
+        sched = make_wave_deep_sweep(self.grid, k, dt, cfg.spacing,
+                                     wire_mode=wm)
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
-        def advance(U, Uprev, C2, n):
-            # The time-invariant c² is exchanged + masked ONCE per
-            # compiled advance (DeepSchedule.prepare), not inside every
-            # sweep — the loop carries only the leapfrog state pair.
-            P = sched.prepare(C2)
-            return lax.fori_loop(
-                0, n // k, lambda _, s: sched.sweep(s[0], s[1], P),
-                (U, Uprev),
-            )
+        if sched.init_wire is None:
+
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def advance(U, Uprev, C2, n):
+                # The time-invariant c² is exchanged + masked ONCE per
+                # compiled advance (DeepSchedule.prepare), not inside
+                # every sweep — the loop carries only the leapfrog
+                # state pair.
+                P = sched.prepare(C2)
+                return lax.fori_loop(
+                    0, n // k, lambda _, s: sched.sweep(s[0], s[1], P),
+                    (U, Uprev),
+                )
+
+        else:
+
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def advance(U, Uprev, C2, n):
+                P = sched.prepare(C2)
+                ws0 = sched.init_wire(U.dtype)
+                out = lax.fori_loop(
+                    0, n // k,
+                    lambda _, s: sched.sweep(s[0], s[1], P, s[2]),
+                    (U, Uprev, ws0),
+                )
+                return out[0], out[1]
 
         return advance, k
 
@@ -506,11 +535,13 @@ class AcousticWave:
         nt: int | None = None,
         warmup: int | None = None,
         block_steps: int | None = None,
+        wire_mode: str | None = None,
     ) -> WaveRunResult:
         """Sharded fast path: deep-halo sweeps for the wave — one width-k
         ghost exchange of the leapfrog state pair per k steps
         (parallel.deep_halo.make_wave_deep_sweep), the second workload on
         the flagship multi-chip schedule (HeatDiffusion.run_deep).
         """
-        advance, _ = self.deep_advance_fn(block_steps, nt, warmup)
+        advance, _ = self.deep_advance_fn(block_steps, nt, warmup,
+                                          wire_mode=wire_mode)
         return self._run_timed(advance, nt, warmup)

@@ -43,6 +43,7 @@ from jax import lax
 from rocm_mpi_tpu.utils.compat import shard_map
 
 from rocm_mpi_tpu import telemetry
+from rocm_mpi_tpu.parallel import wire
 from rocm_mpi_tpu.parallel.halo import exchange_halo
 from rocm_mpi_tpu.parallel.mesh import GlobalGrid
 
@@ -55,15 +56,29 @@ class DeepSchedule(NamedTuple):
     `prepare` outside their step loop and carry only the state.
 
     `rebuild(new_grid)` re-derives the SAME schedule (physics constants,
-    depth, local form) for a new decomposition — the elastic-resume path
-    (rebuild_for_mesh below): ghost widths, padded block geometry, face
-    masks, and the VMEM-vs-HBM local-kernel routing all depend on the
-    shard shape, so nothing built for the old mesh may be reused."""
+    depth, local form, wire mode) for a new decomposition — the
+    elastic-resume path (rebuild_for_mesh below): ghost widths, padded
+    block geometry, face masks, and the VMEM-vs-HBM local-kernel routing
+    all depend on the shard shape, so nothing built for the old mesh may
+    be reused.
+
+    `wire_mode` is the state exchange's on-wire precision
+    (parallel/wire.py; the loop-invariant `prepare` exchange always
+    ships full precision — it runs once per compiled advance, so its
+    bytes are not the term that grows with the mesh, and coefficient
+    error would bias every step). For the stateful modes
+    (int8/int8_delta) `init_wire(dtype)` builds the flat zero wire-state
+    tuple and `sweep` grows a trailing wire-state argument + return:
+    `sweep(state…, prepared, wire_state) -> (state…, wire_state)` — the
+    drivers carry it alongside the field(s). `init_wire` is None for
+    stateless modes and the sweep signature is unchanged."""
 
     prepare: Callable
     sweep: Callable
     k: int
     rebuild: Callable | None = None
+    wire_mode: str = "f32"
+    init_wire: Callable | None = None
 
 
 def _validate_depth(grid: GlobalGrid, k: int, label: str = "sweep depth"):
@@ -107,18 +122,24 @@ def padded_update_coefficient(Cp_padded, grid: GlobalGrid, width: int,
     return jnp.where(mask, jnp.zeros_like(Cp_padded), (dt * lam) / safe)
 
 
-def resolve_deep_k(grid: GlobalGrid, dtype, config: str | None) -> int | None:
-    """The tuned deep-halo sweep depth for this shard/topology, or None
-    (= use the model's default_deep_depth policy). The deep edition of
-    the `config="auto"` seam: consults the tuning cache
-    (tuning/resolve.py, op "diffusion.deep", keyed by the LOCAL shard
-    shape and mesh dims — the winner shifts with both) and re-validates
-    the cached depth against this grid's shard extents, because a cache
-    entry tuned on one mesh can outlive a reshard that shrank the shards
+def resolve_deep_config(grid: GlobalGrid, dtype,
+                        config: str | None) -> dict:
+    """The tuned deep-halo configuration for this shard/topology:
+    ``{"k": int | None, "wire_mode": str | None}`` — None fields mean
+    "use the model's default policy". The deep edition of the
+    `config="auto"` seam: consults the tuning cache (tuning/resolve.py,
+    op "diffusion.deep", keyed by the LOCAL shard shape and mesh dims —
+    the winner shifts with both) and re-validates the cached depth
+    against this grid's shard extents, because a cache entry tuned on
+    one mesh can outlive a reshard that shrank the shards
     (`_validate_depth`'s own rule, applied silently: a stale depth falls
-    back to the default policy rather than crashing an auto run)."""
+    back to the default policy rather than crashing an auto run). The
+    wire mode rides the same entry (the PR-12 wire axis) — resolve's
+    sanitizer already dropped unknown modes, and the gate/validate CLI
+    is the loud half that rejects an uncertified or over-ladder one."""
+    nothing = {"k": None, "wire_mode": None}
     if config in (None, "default"):
-        return None
+        return nothing
     if config != "auto":
         raise ValueError(
             f"config must be None, 'default' or 'auto', got {config!r}"
@@ -127,23 +148,34 @@ def resolve_deep_k(grid: GlobalGrid, dtype, config: str | None) -> int | None:
 
     if jax.process_count() > 1:
         # Multi-controller: each process resolves from its own cache
-        # file, and ranks disagreeing on k build schedules with
-        # MISMATCHED collectives (one exchanges every 8 steps, another
-        # every 32 — a distributed hang, not an error). The default
-        # depth policy is deterministic on every rank; auto stays
-        # hands-off until a broadcast-consistent resolve exists.
-        return None
+        # file, and ranks disagreeing on k (or on the wire mode — a
+        # bf16 sender into an f32 receiver is a dtype-mismatched
+        # collective) build schedules with MISMATCHED collectives — a
+        # distributed hang, not an error. The default policy is
+        # deterministic on every rank; auto stays hands-off until a
+        # broadcast-consistent resolve exists.
+        return nothing
     from rocm_mpi_tpu.tuning import resolve as tuning_resolve
 
     tuned = tuning_resolve.resolve(
         "diffusion.deep", grid.local_shape, dtype, topology=grid.dims
     )
-    if not tuned or not tuned.get("k"):
-        return None
-    k = int(tuned["k"])
-    if k < 1 or any(k > ln for ln in grid.local_shape):
-        return None
-    return k
+    if not tuned:
+        return nothing
+    out = dict(nothing)
+    if tuned.get("k"):
+        k = int(tuned["k"])
+        if k >= 1 and all(k <= ln for ln in grid.local_shape):
+            out["k"] = k
+    if tuned.get("wire_mode"):
+        out["wire_mode"] = str(tuned["wire_mode"])
+    return out
+
+
+def resolve_deep_k(grid: GlobalGrid, dtype, config: str | None) -> int | None:
+    """The tuned sweep depth alone (resolve_deep_config's k field) —
+    the pre-wire-axis spelling, kept for existing callers."""
+    return resolve_deep_config(grid, dtype, config)["k"]
 
 
 def rebuild_for_mesh(sched: DeepSchedule, new_grid: GlobalGrid,
@@ -168,10 +200,13 @@ def rebuild_for_mesh(sched: DeepSchedule, new_grid: GlobalGrid,
 
 
 def make_deep_sweep(grid: GlobalGrid, k: int, lam, dt, spacing,
-                    local_form: str = "auto") -> DeepSchedule:
+                    local_form: str = "auto",
+                    wire_mode: str = "f32") -> DeepSchedule:
     """Build the diffusion DeepSchedule: `prepare(Cp)` -> block-padded Cm
     (ONE width-k Cp exchange per compiled advance), `sweep(T, Cm)` -> T
-    advanced k steps with one width-k T exchange.
+    advanced k steps with one width-k T exchange (at `wire_mode`
+    precision on the wire; stateful modes grow the sweep signature —
+    DeepSchedule docstring has the contract).
 
     The local k-step kernel is the same unrolled roll-based Pallas program
     as the single-chip VMEM-resident path (ops.pallas_kernels.multi_step_cm)
@@ -186,6 +221,8 @@ def make_deep_sweep(grid: GlobalGrid, k: int, lam, dt, spacing,
     (rocm_mpi_tpu/perf/traffic.py); "auto" is the production routing.
     """
     _validate_depth(grid, k, "sweep depth")
+    wire.validate_mode(wire_mode)
+    stateful_wire = wire.is_stateful(wire_mode)
     if local_form not in ("auto", "jnp"):
         raise ValueError(f"local_form must be 'auto' or 'jnp', got {local_form!r}")
     from rocm_mpi_tpu.ops.pallas_kernels import (
@@ -245,8 +282,13 @@ def make_deep_sweep(grid: GlobalGrid, k: int, lam, dt, spacing,
             and (n0p // tb_geometry(k)[1]) >= 2
         )
 
-    def local_sweep(Tl, Cm):
-        Tp = exchange_halo(Tl, grid, width=k)
+    def local_sweep(Tl, Cm, *wsl):
+        if stateful_wire:
+            Tp, ws2 = exchange_halo(Tl, grid, width=k, wire_mode=wire_mode,
+                                    wire_state=tuple(wsl))
+        else:
+            Tp = exchange_halo(Tl, grid, width=k, wire_mode=wire_mode)
+            ws2 = ()
         if local_form == "jnp":
             route = "jnp"
             Tp = jnp_k_steps(Tp, Cm)
@@ -263,8 +305,8 @@ def make_deep_sweep(grid: GlobalGrid, k: int, lam, dt, spacing,
             # Trace-time: which local kernel this compiled sweep routed to
             # (the halo.exchange byte annotation fired inside exchange_halo).
             telemetry.annotate("deep.sweep", k=k, route=route,
-                               steps_per_exchange=k)
-        return Tp[core]
+                               steps_per_exchange=k, wire=wire_mode)
+        return (Tp[core],) + ws2 if stateful_wire else Tp[core]
 
     def prepare(Cp):
         return shard_map(
@@ -275,19 +317,41 @@ def make_deep_sweep(grid: GlobalGrid, k: int, lam, dt, spacing,
             check_vma=False,
         )(Cp)
 
-    def sweep(T, Cm):
-        return shard_map(
-            local_sweep,
-            mesh=grid.mesh,
-            in_specs=(grid.spec, grid.spec),
-            out_specs=grid.spec,
-            check_vma=False,
-        )(T, Cm)
+    if stateful_wire:
+
+        def sweep(T, Cm, wire_state):
+            ws = tuple(wire_state)
+            outs = shard_map(
+                local_sweep,
+                mesh=grid.mesh,
+                in_specs=(grid.spec,) * (2 + len(ws)),
+                out_specs=(grid.spec,) * (1 + len(ws)),
+                check_vma=False,
+            )(T, Cm, *ws)
+            return outs[0], tuple(outs[1:])
+
+    else:
+
+        def sweep(T, Cm):
+            return shard_map(
+                local_sweep,
+                mesh=grid.mesh,
+                in_specs=(grid.spec, grid.spec),
+                out_specs=grid.spec,
+                check_vma=False,
+            )(T, Cm)
 
     return DeepSchedule(
         prepare, sweep, k,
         rebuild=lambda g: make_deep_sweep(g, k, lam, dt, spacing,
-                                          local_form=local_form),
+                                          local_form=local_form,
+                                          wire_mode=wire_mode),
+        wire_mode=wire_mode,
+        init_wire=(
+            (lambda dtype: wire.init_exchange_state(grid, k, wire_mode,
+                                                    dtype))
+            if stateful_wire else None
+        ),
     )
 
 
@@ -316,7 +380,7 @@ def padded_face_mask(shape, grid: GlobalGrid, axis: int, width: int, dtype):
 
 
 def make_swe_deep_sweep(grid: GlobalGrid, k: int, dt, spacing, H,
-                        g) -> DeepSchedule:
+                        g, wire_mode: str = "f32") -> DeepSchedule:
     """Deep-halo DeepSchedule for the shallow-water workload:
     `prepare(h)` -> the block-padded face masks (geometry-only; `h` just
     donates dtype and sharding — computed ONCE per compiled advance),
@@ -331,6 +395,8 @@ def make_swe_deep_sweep(grid: GlobalGrid, k: int, dt, spacing, H,
     else the identical-semantics jnp roll fallback (masked_swe_step — the
     one definition of the update)."""
     _validate_depth(grid, k, "sweep depth")
+    wire.validate_mode(wire_mode)
+    stateful_wire = wire.is_stateful(wire_mode)
     from rocm_mpi_tpu.ops.pallas_kernels import (
         _VMEM_BLOCK_BUDGET_BYTES,
         _compute_nbytes,
@@ -342,9 +408,13 @@ def make_swe_deep_sweep(grid: GlobalGrid, k: int, dt, spacing, H,
     )
 
     ndim = grid.ndim
+    nfields = ndim + 1  # h + one velocity per axis, all exchanged
     core = tuple(slice(k, -k) for _ in range(ndim))
     cH, cg = swe_coeffs(dt, spacing, H, g)
     padded_local = tuple(ln + 2 * k for ln in grid.local_shape)
+    # Flat wire-state arrays per exchanged field (wire.state_arity per
+    # slab, 2 slabs per axis).
+    per_field = wire.state_arity(wire_mode) * 2 * ndim
 
     def jnp_k_steps(h, us, Mus):
         for _ in range(k):
@@ -357,15 +427,31 @@ def make_swe_deep_sweep(grid: GlobalGrid, k: int, dt, spacing, H,
             for a in range(ndim)
         )
 
+    def _exchange(f, wsl, i):
+        if not stateful_wire:
+            return exchange_halo(f, grid, width=k, wire_mode=wire_mode), ()
+        return exchange_halo(
+            f, grid, width=k, wire_mode=wire_mode,
+            wire_state=tuple(wsl[i * per_field:(i + 1) * per_field]),
+        )
+
     def local_sweep(hl, *rest):
-        uls, Mus = rest[:ndim], rest[ndim:]
-        hp = exchange_halo(hl, grid, width=k)
-        ups = tuple(exchange_halo(u, grid, width=k) for u in uls)
+        uls, Mus = rest[:ndim], rest[ndim:2 * ndim]
+        wsl = rest[2 * ndim:]
+        hp, ws_h = _exchange(hl, wsl, 0)
+        ups, ws_us = [], ()
+        for i, u in enumerate(uls):
+            up, ws_u = _exchange(u, wsl, 1 + i)
+            ups.append(up)
+            ws_us += ws_u
+        ups = tuple(ups)
         if (3 * ndim + 2) * _compute_nbytes(hp) <= _VMEM_BLOCK_BUDGET_BYTES:
             h2, us2 = swe_multi_step_masked(hp, ups, Mus, cH, cg, k)
         else:
             h2, us2 = jnp_k_steps(hp, ups, Mus)
-        return (h2[core],) + tuple(u[core] for u in us2)
+        return (
+            (h2[core],) + tuple(u[core] for u in us2) + ws_h + ws_us
+        )
 
     def prepare(h):
         return shard_map(
@@ -376,24 +462,48 @@ def make_swe_deep_sweep(grid: GlobalGrid, k: int, dt, spacing, H,
             check_vma=False,
         )(h)
 
-    def sweep(h, us, Mus_padded):
-        outs = shard_map(
-            local_sweep,
-            mesh=grid.mesh,
-            in_specs=(grid.spec,) * (2 * ndim + 1),
-            out_specs=(grid.spec,) * (ndim + 1),
-            check_vma=False,
-        )(h, *us, *Mus_padded)
-        return outs[0], tuple(outs[1:])
+    if stateful_wire:
+
+        def sweep(h, us, Mus_padded, wire_state):
+            ws = tuple(wire_state)
+            outs = shard_map(
+                local_sweep,
+                mesh=grid.mesh,
+                in_specs=(grid.spec,) * (2 * ndim + 1 + len(ws)),
+                out_specs=(grid.spec,) * (ndim + 1 + len(ws)),
+                check_vma=False,
+            )(h, *us, *Mus_padded, *ws)
+            return (
+                outs[0], tuple(outs[1:nfields]), tuple(outs[nfields:])
+            )
+
+    else:
+
+        def sweep(h, us, Mus_padded):
+            outs = shard_map(
+                local_sweep,
+                mesh=grid.mesh,
+                in_specs=(grid.spec,) * (2 * ndim + 1),
+                out_specs=(grid.spec,) * (ndim + 1),
+                check_vma=False,
+            )(h, *us, *Mus_padded)
+            return outs[0], tuple(outs[1:])
 
     return DeepSchedule(
         prepare, sweep, k,
-        rebuild=lambda ng: make_swe_deep_sweep(ng, k, dt, spacing, H, g),
+        rebuild=lambda ng: make_swe_deep_sweep(ng, k, dt, spacing, H, g,
+                                               wire_mode=wire_mode),
+        wire_mode=wire_mode,
+        init_wire=(
+            (lambda dtype: wire.init_exchange_state(grid, k, wire_mode,
+                                                    dtype, fields=nfields))
+            if stateful_wire else None
+        ),
     )
 
 
-def make_wave_deep_sweep(grid: GlobalGrid, k: int, dt,
-                         spacing) -> DeepSchedule:
+def make_wave_deep_sweep(grid: GlobalGrid, k: int, dt, spacing,
+                         wire_mode: str = "f32") -> DeepSchedule:
     """Deep-halo DeepSchedule for the acoustic-wave workload:
     `prepare(C2)` -> block-padded (M, Cw) — ONE width-k exchange of the
     time-invariant squared wave speed per compiled advance, with the hold
@@ -409,6 +519,8 @@ def make_wave_deep_sweep(grid: GlobalGrid, k: int, dt,
     workload is the layering demo — it has no HBM temporal-blocked rung).
     """
     _validate_depth(grid, k, "sweep depth")
+    wire.validate_mode(wire_mode)
+    stateful_wire = wire.is_stateful(wire_mode)
     from rocm_mpi_tpu.ops.pallas_kernels import (
         _VMEM_BLOCK_BUDGET_BYTES,
         _compute_nbytes,
@@ -421,6 +533,7 @@ def make_wave_deep_sweep(grid: GlobalGrid, k: int, dt,
     core = tuple(slice(k, -k) for _ in range(grid.ndim))
     inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
     dt2 = float(dt) * float(dt)
+    per_field = wire.state_arity(wire_mode) * 2 * grid.ndim
 
     def jnp_k_steps(U, Uprev, M, Cw):
         for _ in range(k):
@@ -433,14 +546,26 @@ def make_wave_deep_sweep(grid: GlobalGrid, k: int, dt,
         M = jnp.where(hold, jnp.zeros_like(C2p), jnp.ones_like(C2p))
         return M, dt2 * C2p * M
 
-    def local_sweep(Ul, Upl, M, Cw):
-        Up_ = exchange_halo(Ul, grid, width=k)
-        Upp = exchange_halo(Upl, grid, width=k)
+    def local_sweep(Ul, Upl, M, Cw, *wsl):
+        if stateful_wire:
+            Up_, ws_u = exchange_halo(
+                Ul, grid, width=k, wire_mode=wire_mode,
+                wire_state=tuple(wsl[:per_field]),
+            )
+            Upp, ws_p = exchange_halo(
+                Upl, grid, width=k, wire_mode=wire_mode,
+                wire_state=tuple(wsl[per_field:]),
+            )
+        else:
+            Up_ = exchange_halo(Ul, grid, width=k, wire_mode=wire_mode)
+            Upp = exchange_halo(Upl, grid, width=k, wire_mode=wire_mode)
+            ws_u = ws_p = ()
         if 2 * _compute_nbytes(Up_) <= _VMEM_BLOCK_BUDGET_BYTES:
             U2, Up2 = wave_multi_step_masked(Up_, Upp, M, Cw, spacing, k)
         else:
             U2, Up2 = jnp_k_steps(Up_, Upp, M, Cw)
-        return U2[core], Up2[core]
+        out = (U2[core], Up2[core])
+        return out + ws_u + ws_p if stateful_wire else out
 
     def prepare(C2):
         return shard_map(
@@ -451,17 +576,40 @@ def make_wave_deep_sweep(grid: GlobalGrid, k: int, dt,
             check_vma=False,
         )(C2)
 
-    def sweep(U, Uprev, prepared):
-        M, Cw = prepared
-        return shard_map(
-            local_sweep,
-            mesh=grid.mesh,
-            in_specs=(grid.spec,) * 4,
-            out_specs=(grid.spec, grid.spec),
-            check_vma=False,
-        )(U, Uprev, M, Cw)
+    if stateful_wire:
+
+        def sweep(U, Uprev, prepared, wire_state):
+            M, Cw = prepared
+            ws = tuple(wire_state)
+            outs = shard_map(
+                local_sweep,
+                mesh=grid.mesh,
+                in_specs=(grid.spec,) * (4 + len(ws)),
+                out_specs=(grid.spec,) * (2 + len(ws)),
+                check_vma=False,
+            )(U, Uprev, M, Cw, *ws)
+            return outs[0], outs[1], tuple(outs[2:])
+
+    else:
+
+        def sweep(U, Uprev, prepared):
+            M, Cw = prepared
+            return shard_map(
+                local_sweep,
+                mesh=grid.mesh,
+                in_specs=(grid.spec,) * 4,
+                out_specs=(grid.spec, grid.spec),
+                check_vma=False,
+            )(U, Uprev, M, Cw)
 
     return DeepSchedule(
         prepare, sweep, k,
-        rebuild=lambda g: make_wave_deep_sweep(g, k, dt, spacing),
+        rebuild=lambda g: make_wave_deep_sweep(g, k, dt, spacing,
+                                               wire_mode=wire_mode),
+        wire_mode=wire_mode,
+        init_wire=(
+            (lambda dtype: wire.init_exchange_state(grid, k, wire_mode,
+                                                    dtype, fields=2))
+            if stateful_wire else None
+        ),
     )

@@ -38,7 +38,6 @@ correctness oracle — "is it the device collective or my math?" (SURVEY.md §4.
 
 from __future__ import annotations
 
-import math
 from typing import Callable, NamedTuple
 
 import jax
@@ -47,27 +46,26 @@ import numpy as np
 from jax import lax
 
 from rocm_mpi_tpu import telemetry
+from rocm_mpi_tpu.parallel import wire
 from rocm_mpi_tpu.parallel.mesh import GlobalGrid
 
 
 def exchange_nbytes(local_shape, itemsize: int, width: int = 1,
-                    axes=None) -> int:
+                    axes=None, wire_mode: str = "f32") -> int:
     """Bytes an interior device SENDS per `exchange_halo` call: two
     width-`width` edge slices per exchanged axis, sized against the
     block as it grows (the sequential corner trick means axis k's slices
-    include axis <k's padding). Edge-of-domain devices send less (their
-    ppermute entries are omitted); the interior figure is the per-device
-    capacity number telemetry wants."""
-    shape = list(local_shape)
-    axes = range(len(shape)) if axes is None else axes
-    total = 0
-    for ax in axes:
-        slice_elems = width * math.prod(
-            shape[a] for a in range(len(shape)) if a != ax
-        )
-        total += 2 * slice_elems * itemsize
-        shape[ax] += 2 * width
-    return total
+    include axis <k's padding), at the ON-WIRE itemsize of `wire_mode`
+    (parallel/wire.py: bf16 ships 2-byte elements, the int8 modes 1-byte
+    plus a per-slab scale scalar; "f32" means the state dtype verbatim).
+    Edge-of-domain devices send less (their ppermute entries are
+    omitted); the interior figure is the per-device capacity number
+    telemetry wants — reporting the state itemsize for a reduced-
+    precision exchange would corrupt the `halo bytes/s` aggregate and
+    any regress baseline built on it."""
+    return wire.exchange_wire_nbytes(
+        local_shape, int(itemsize), width, axes, wire_mode
+    )
 
 
 def neighbor_shift(x, axis_name: str, direction: int):
@@ -102,7 +100,8 @@ def place_core(u, width: int = 1, axes=None):
     return lax.dynamic_update_slice(jnp.zeros(shape, u.dtype), u, start)
 
 
-def exchange_into(buf, grid: GlobalGrid, width: int = 1, axes=None):
+def exchange_into(buf, grid: GlobalGrid, width: int = 1, axes=None,
+                  wire_mode: str = "f32", wire_state=None):
     """Fill the ghost ring of a padded buffer with neighbor slices
     (inside shard_map). `buf` is a `place_core`-shaped buffer: core at
     offset `width` along every exchanged axis.
@@ -119,11 +118,33 @@ def exchange_into(buf, grid: GlobalGrid, width: int = 1, axes=None):
     remove). Non-periodic boundaries: ppermute entries are omitted at the
     domain edge, so edge devices receive zeros — harmless writes into the
     zero ring.
+
+    `wire_mode` selects the on-wire slab representation (the
+    wire-precision plane, parallel/wire.py): "f32" ships the slab
+    verbatim — the identical program to the pre-wire-plane exchange;
+    "bf16" downcasts each send and upcasts on receive, BEFORE the slab
+    touches the buffer or any later axis's corner assembly (the seam
+    only ever consumes decoded, buffer-dtype slabs). The stateful modes
+    ("int8", "int8_delta") additionally take and return `wire_state` —
+    the flat per-slab state tuple `wire.init_exchange_state` builds —
+    and the return value becomes `(buf, new_state)`.
     """
     axes = tuple(range(grid.ndim) if axes is None else axes)
     exchanged = set(axes)
     ndim = buf.ndim
     width = int(width)
+    stateful = wire.is_stateful(wire_mode)
+    if stateful and wire_state is None:
+        raise ValueError(
+            f"wire_mode {wire_mode!r} carries error-feedback state across "
+            "exchanges; per-step (stateless) paths support f32/bf16 only — "
+            "use the deep-halo schedules (run_deep / --deep), which thread "
+            "the state through their sweep carry"
+        )
+    codec = wire.slab_codec(wire_mode)
+    arity = wire.state_arity(wire_mode)
+    new_state: list = []
+    slab_i = 0
 
     def core_extent(a):
         return buf.shape[a] - (2 * width if a in exchanged else 0)
@@ -163,8 +184,24 @@ def exchange_into(buf, grid: GlobalGrid, width: int = 1, axes=None):
                 )
             return piece
 
-        recv[(ax, "lo")] = neighbor_shift(send_slab(False), name, +1)
-        recv[(ax, "hi")] = neighbor_shift(send_slab(True), name, -1)
+        for side, lo_side, direction in (("lo", False, +1),
+                                         ("hi", True, -1)):
+            if wire_mode == "f32":
+                # Bitwise-identical fast path: no codec ops traced.
+                recv[(ax, side)] = neighbor_shift(
+                    send_slab(lo_side), name, direction
+                )
+            else:
+                st = tuple(
+                    wire_state[slab_i * arity + j] for j in range(arity)
+                ) if stateful else ()
+                payload, st = codec.send(send_slab(lo_side), st)
+                shipped = tuple(
+                    neighbor_shift(p, name, direction) for p in payload
+                )
+                recv[(ax, side)], st = codec.recv(shipped, st, buf.dtype)
+                new_state.extend(st)
+            slab_i += 1
         done.append(ax)
 
     for i, ax in enumerate(done):
@@ -177,53 +214,74 @@ def exchange_into(buf, grid: GlobalGrid, width: int = 1, axes=None):
                 for a in range(ndim)
             )
             buf = lax.dynamic_update_slice(buf, recv[(ax, side)], starts)
+    if stateful:
+        return buf, tuple(new_state)
     return buf
 
 
-def exchange_halo(u, grid: GlobalGrid, width: int = 1, axes=None):
+def exchange_halo(u, grid: GlobalGrid, width: int = 1, axes=None,
+                  wire_mode: str = "f32", wire_state=None):
     """Pad the local block `u` with neighbor ghost cells (inside shard_map).
 
     Returns an array grown by 2*width along each exchanged axis. This is the
     `update_halo!(T)` analog: one call per step, all axes
     (diffusion_2D_ap.jl:42). Composition of `place_core` + `exchange_into`
     — one staged copy, ghost slices written in place.
+
+    `wire_mode` selects the on-wire slab precision (exchange_into has the
+    contract); the stateful modes take/return `wire_state` and the result
+    becomes `(padded, new_state)`. The default "f32" traces the exact
+    pre-wire-plane program — bitwise identical on every workload.
     """
     axes = tuple(range(grid.ndim) if axes is None else axes)
     if telemetry.enabled():
         # Trace-time annotation: shapes are concrete while jax traces, so
         # "this program moves N bytes per exchange" is recordable exactly
         # once per compiled program (telemetry.events.annotate dedups).
+        # `bytes` is the TRUE on-wire figure for the active wire mode —
+        # a bf16 exchange must never book f32 bytes into the halo
+        # bytes/s aggregate or a regress baseline.
         telemetry.annotate(
             "halo.exchange",
-            bytes=exchange_nbytes(u.shape, u.dtype.itemsize, width, axes),
+            bytes=exchange_nbytes(u.shape, u.dtype.itemsize, width, axes,
+                                  wire_mode),
             width=width,
             block=tuple(int(n) for n in u.shape),
+            wire=wire_mode,
         )
-    return exchange_into(place_core(u, width, axes), grid, width, axes)
+    return exchange_into(place_core(u, width, axes), grid, width, axes,
+                         wire_mode=wire_mode, wire_state=wire_state)
 
 
 class HaloProgram(NamedTuple):
     """A halo exchange family bound to one decomposition: the grid it was
     derived for, the ghost width, the bound `exchange(u)` closure (inside
     shard_map), and `nbytes(itemsize)` — the per-interior-device wire
-    bytes of one call (the telemetry/traffic accounting figure)."""
+    bytes of one call (the telemetry/traffic accounting figure, at the
+    program's wire mode)."""
 
     grid: GlobalGrid
     width: int
     exchange: Callable
     nbytes: Callable
+    wire_mode: str = "f32"
 
 
-def build_for_mesh(grid: GlobalGrid, width: int = 1) -> HaloProgram:
+def build_for_mesh(grid: GlobalGrid, width: int = 1,
+                   wire_mode: str = "f32") -> HaloProgram:
     """Bind the halo exchange family to `grid` — the derivation
     `rebuild_for_mesh` re-runs when the decomposition changes."""
+    wire.validate_mode(wire_mode)
     return HaloProgram(
         grid=grid,
         width=width,
-        exchange=lambda u, axes=None: exchange_halo(u, grid, width, axes),
-        nbytes=lambda itemsize, axes=None: exchange_nbytes(
-            grid.local_shape, itemsize, width, axes
+        exchange=lambda u, axes=None: exchange_halo(
+            u, grid, width, axes, wire_mode=wire_mode
         ),
+        nbytes=lambda itemsize, axes=None: exchange_nbytes(
+            grid.local_shape, itemsize, width, axes, wire_mode
+        ),
+        wire_mode=wire_mode,
     )
 
 
@@ -247,13 +305,17 @@ def rebuild_for_mesh(
     else:
         old_grid = program_or_grid
         width = 1 if width is None else width
+    wire_mode = (
+        program_or_grid.wire_mode
+        if isinstance(program_or_grid, HaloProgram) else "f32"
+    )
     new_grid = _mesh.rebuild_for_mesh(old_grid, dims=dims, devices=devices)
     if any(width > ln for ln in new_grid.local_shape):
         raise ValueError(
             f"halo width {width} exceeds a local shard extent "
             f"{new_grid.local_shape} on the rebuilt mesh {new_grid.dims}"
         )
-    return build_for_mesh(new_grid, width)
+    return build_for_mesh(new_grid, width, wire_mode=wire_mode)
 
 
 def global_boundary_mask(grid: GlobalGrid, dtype=bool):
@@ -287,16 +349,28 @@ class HostStagedStepper:
     """
 
     def __init__(
-        self, grid: GlobalGrid, lam: float, dt: float, use_native: bool | None = None
+        self, grid: GlobalGrid, lam: float, dt: float,
+        use_native: bool | None = None, wire_mode: str = "f32",
     ):
         self.grid = grid
         self.lam = lam
         self.dt = dt
+        # The wire-precision oracle twin: apply the numpy wire codec to
+        # every ghost slab copied between shards, with the error-feedback
+        # / delta state held per logical wire in the codec itself (this
+        # stepper is the oracle world's one stateful object). "f32" is
+        # the identity — the classic oracle, bit for bit.
+        self.wire_mode = wire.validate_mode(wire_mode)
+        self._codec = (
+            wire.NumpyWireCodec(wire_mode) if wire_mode != "f32" else None
+        )
         if use_native is None:
             from rocm_mpi_tpu.parallel import native_halo
 
             use_native = native_halo.available() and grid.ndim <= 3
-        self.use_native = use_native
+        # The native C++ engine stages full-precision ghosts only; any
+        # reduced-precision wire must run the numpy path.
+        self.use_native = use_native and wire_mode == "f32"
 
     def _shard_slices(self, coords) -> tuple[slice, ...]:
         local = self.grid.local_shape
@@ -361,8 +435,17 @@ class HostStagedStepper:
                             )
                             dst[ax] = slice(local[ax] + 1, local[ax] + 2)
                         ghost = T[tuple(src)]
+                        if self._codec is not None:
+                            # One logical wire per (receiver, axis,
+                            # side): the codec's residual/reconstruction
+                            # state persists across steps under this key.
+                            ghost = self._codec.apply(
+                                (coords, ax, side), ghost
+                            )
                         block[tuple(dst)] = ghost
-                        copied += ghost.nbytes
+                        copied += wire.wire_slab_nbytes(
+                            ghost.size, T.dtype.itemsize, self.wire_mode
+                        )
                 padded[coords] = block
             hsp.set(bytes=copied)
 

@@ -78,6 +78,7 @@ def make_overlap_step(
     padded_update: Callable,
     b_width: tuple[int, ...],
     mask_boundary: bool = True,
+    wire_mode: str = "f32",
 ):
     """Build the shard-local overlap step (any ndim).
 
@@ -99,6 +100,14 @@ def make_overlap_step(
     and the whole tree is handed to `padded_update` as its second
     argument. Aux operands are read core-only, never exchanged.
 
+    `wire_mode` selects the exchange's on-wire slab precision
+    (parallel/wire.py): the per-step overlap program is stateless, so
+    only "f32" (bitwise-unchanged) and "bf16" are legal here — the
+    exchange decodes every received slab back to the buffer dtype
+    BEFORE it reaches the slab updates, so the masked seam (the region
+    kernels below) only ever consumes upcast, full-precision-dtype
+    ghosts (the GL04 contract).
+
     `mask_boundary=False` drops the Dirichlet hold entirely: for the
     masked contracts (Cm — the boundary-masked coefficient of
     models.diffusion `_make_masked_step`; the mask-as-data operands of the
@@ -117,6 +126,13 @@ def make_overlap_step(
     axis-0/…​ slabs read exchanged ghosts — the interior reads the unpadded
     local block, which is what makes the exchange hideable.
     """
+    from rocm_mpi_tpu.parallel import wire
+
+    # Mode validity checked here; the stateful-mode refusal (this
+    # program is stateless) fires at trace time inside exchange_halo,
+    # so a model whose config carries a deep-only wire mode can still
+    # BUILD its per-step variants and run its deep schedule.
+    wire.validate_mode(wire_mode)
     local = grid.local_shape
     ndim = grid.ndim
     bw = effective_b_width(local, b_width)
@@ -168,11 +184,14 @@ def make_overlap_step(
             telemetry.annotate(
                 "overlap.step", b_width=tuple(int(b) for b in bw),
                 leaves=len(jax.tree_util.tree_leaves(Tl)),
+                wire=wire_mode,
             )
         # (1) halo exchange of the current state — edge-slice ppermutes,
-        # one exchange per state leaf (SWE: 3 fields; diffusion/wave: 1).
+        # one exchange per state leaf (SWE: 3 fields; diffusion/wave: 1),
+        # at the wire mode's on-wire precision (received slabs arrive
+        # already decoded to the buffer dtype).
         Tp = jax.tree_util.tree_map(
-            lambda t: exchange_halo(t, grid), Tl
+            lambda t: exchange_halo(t, grid, wire_mode=wire_mode), Tl
         )  # core + 2 per axis
 
         def region(bounds):

@@ -1,0 +1,460 @@
+"""The wire-precision plane: what a halo slab looks like ON THE WIRE.
+
+At scale, halo bytes are the term that grows with the mesh (ROADMAP item
+4; the memory-bound analyses in arXiv:2406.08923 and the Wormhole
+data-movement accounting in arXiv:2605.07599 both identify wire traffic,
+not FLOPs, as the scaling lever). The exchange schedule is already
+message-minimal (PR 4's in-place rework, PR 4/7's traffic gates pin it
+there) — the remaining lever is the *itemsize of the payload itself*.
+This module owns that axis: the `wire_mode` registry, the per-mode slab
+codecs (jax for the compiled exchange, a numpy twin for the host-staged
+oracle), the per-mode byte accounting the telemetry annotations and the
+perf wire-bytes ladder both consume, and the tolerance contract that
+gates any non-f32 mode against the f64 host-staged oracle.
+
+Modes (the wire-bytes ladder, fractions vs the full-precision wire):
+
+* ``f32``        — full precision (the STATE dtype, so an f64 oracle run
+                   ships f64). Bitwise-identical to the pre-wire-plane
+                   exchange: the codec is the identity and traces the
+                   exact same program.
+* ``bf16``       — downcast the slab to bfloat16 on send, upcast to the
+                   buffer dtype on receive BEFORE any seam arithmetic
+                   (the storage-only-bf16 convention, applied to the
+                   wire: graftlint GL04 polices the upcast). 0.5× wire.
+* ``int8``       — per-slab symmetric int8 quantization (scale = the
+                   slab's max-abs / 127, shipped alongside) with an
+                   error-feedback residual carried in the exchange
+                   state: the quantization error of send t is ADDED to
+                   the slab of send t+1, so error is compensated across
+                   the run, never accumulated. ~0.25× wire. Stateful.
+* ``int8_delta`` — int8 over the DELTA against the previous send's
+                   reconstruction: the outer rings of a deep-halo slab
+                   barely change per sweep, so the delta has a far
+                   smaller dynamic range than the slab and the same
+                   scale buys ~k× finer quanta. Sender and receiver
+                   each carry the running reconstruction (identical by
+                   construction: both integrate the dequantized wire
+                   values; the first sweep's "previous" is zero, so
+                   sweep 1 ships a plain int8 slab). Same ~0.25× wire
+                   as int8. Stateful.
+
+Stateful modes carry their state as a FLAT tuple of arrays (fixed
+structure — safe as a `lax.fori_loop`/`lax.scan` carry), one group of
+``state_arity(mode)`` arrays per slab in exchange order (axis-major,
+lo-then-hi — `slab_shapes` is the shape contract). Per-step variants are
+stateless programs, so they support f32/bf16 only; the deep-halo
+schedules (parallel/deep_halo.py) thread the state through their sweep
+carry.
+
+Import discipline: module import is stdlib-only (numpy/jax lazy, inside
+functions) so the tuning gate's read side and the telemetry schema
+checker can consult the mode tables without a backend.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+WIRE_MODES = ("f32", "bf16", "int8", "int8_delta")
+
+# Modes that carry exchange state (error-feedback residuals / delta
+# reconstructions) across calls.
+STATEFUL_MODES = frozenset({"int8", "int8_delta"})
+
+# Default wire-bytes ladder: max allowed fraction of a mode's on-wire
+# bytes vs the full-precision (state-dtype) wire ideal. The committed
+# rows live in rocm_mpi_tpu/perf/budgets.json ("wire"); this table is
+# the fallback when a budgets file predates the ladder.
+DEFAULT_LADDER = {
+    "f32": 1.02,  # exact metric; tolerance covers rounding only
+    "bf16": 0.55,
+    "int8": 0.35,
+    "int8_delta": 0.35,
+}
+
+# The tolerance contract: max allowed relative error (max-abs, vs the
+# f64 host-staged oracle) of an f32-state run using this wire mode, at
+# the certification drill's horizon. Calibrated against the drill in
+# `check_tolerance` (headroom >= 4x measured); the end-to-end model
+# parity tests (tests/test_wire.py) hold the same bounds on all three
+# workloads. Any non-f32 mode must pass BOTH this contract and the
+# wire-bytes ladder to be accepted (tuning/gate.py double-gates).
+TOLERANCE = {
+    "f32": 2e-4,
+    "bf16": 2e-2,
+    "int8": 6e-2,
+    "int8_delta": 3e-2,
+}
+
+
+def validate_mode(mode: str) -> str:
+    if mode not in WIRE_MODES:
+        raise ValueError(
+            f"unknown wire_mode {mode!r}; known: {WIRE_MODES}"
+        )
+    return mode
+
+
+def is_stateful(mode: str) -> bool:
+    return validate_mode(mode) in STATEFUL_MODES
+
+
+def state_arity(mode: str) -> int:
+    """State arrays carried per slab: int8 carries the error-feedback
+    residual; int8_delta adds the sender's and receiver's running
+    reconstructions (prev_send, prev_recv)."""
+    if mode == "int8":
+        return 1
+    if mode == "int8_delta":
+        return 3
+    return 0
+
+
+def payload_itemsize(mode: str, itemsize: int) -> int:
+    """On-wire bytes per slab element. f32 mode ships the state dtype
+    verbatim (an f64 oracle program ships 8-byte elements)."""
+    validate_mode(mode)
+    if mode == "bf16":
+        return 2
+    if mode in STATEFUL_MODES:
+        return 1
+    return int(itemsize)
+
+
+def slab_overhead_bytes(mode: str, itemsize: int) -> int:
+    """Per-slab side-channel bytes: the int8 modes ship one scale scalar
+    (state dtype) alongside each quantized slab."""
+    return int(itemsize) if mode in STATEFUL_MODES else 0
+
+
+def wire_slab_nbytes(n_elems: int, itemsize: int, mode: str) -> int:
+    """Exact on-wire bytes of ONE slab under `mode`."""
+    return (
+        int(n_elems) * payload_itemsize(mode, itemsize)
+        + slab_overhead_bytes(mode, itemsize)
+    )
+
+
+def slab_shapes(local_shape, width: int, axes=None) -> list[tuple[int, ...]]:
+    """Per-shard send/recv slab shapes in exchange order (axis-major,
+    lo then hi). Axis k's slabs span the PADDED extent of every axis
+    exchanged before it (the sequential corner trick extends the core
+    edge with the earlier axes' received slabs) and the core extent
+    after — the shape contract the stateful codecs' state arrays and
+    `exchange_nbytes` both derive from."""
+    local_shape = tuple(int(n) for n in local_shape)
+    ndim = len(local_shape)
+    axes = tuple(range(ndim) if axes is None else axes)
+    width = int(width)
+    shapes: list[tuple[int, ...]] = []
+    done: list[int] = []
+    for ax in axes:
+        shape = tuple(
+            width if a == ax
+            else local_shape[a] + 2 * width if a in done
+            else local_shape[a]
+            for a in range(ndim)
+        )
+        shapes.append(shape)  # lo ghost (received from the -1 neighbor)
+        shapes.append(shape)  # hi ghost
+        done.append(ax)
+    return shapes
+
+
+def exchange_wire_nbytes(local_shape, itemsize: int, width: int = 1,
+                         axes=None, mode: str = "f32") -> int:
+    """Bytes an interior device SENDS per exchange under `mode` — the
+    per-mode edition of halo.exchange_nbytes (which delegates here)."""
+    return sum(
+        wire_slab_nbytes(math.prod(s), itemsize, mode)
+        for s in slab_shapes(local_shape, width, axes)
+    )
+
+
+def ladder_fraction(local_shape, width: int, mode: str,
+                    itemsize: int = 4) -> float:
+    """A mode's closed-form wire bytes as a fraction of the
+    full-precision ideal at the same geometry — the number the
+    wire-bytes ladder rows bound."""
+    full = exchange_wire_nbytes(local_shape, itemsize, width, mode="f32")
+    this = exchange_wire_nbytes(local_shape, itemsize, width, mode=mode)
+    return this / full if full else 0.0
+
+
+# ---------------------------------------------------------------------------
+# State construction (global, sharded-compatible zeros)
+# ---------------------------------------------------------------------------
+
+
+def init_exchange_state(grid, width: int, mode: str, dtype, axes=None,
+                        fields: int = 1):
+    """The initial (zero) exchange state for ONE stateful exchange per
+    sweep of `fields` same-shaped fields: a flat tuple of GLOBAL zero
+    arrays, `state_arity(mode)` per slab per field, shaped so that
+    `shard_map(..., in_specs=(grid.spec,)*len(state))` hands every shard
+    exactly its per-slab state (`slab_shapes` scaled by the mesh dims).
+    Zeros ARE the first-sweep contract: a zero residual adds nothing,
+    and a zero delta reconstruction makes sweep 1 ship the plain slab."""
+    import jax.numpy as jnp
+
+    if not is_stateful(mode):
+        return ()
+    arity = state_arity(mode)
+    out = []
+    for _ in range(int(fields)):
+        for shape in slab_shapes(grid.local_shape, width, axes):
+            gshape = tuple(
+                int(s) * int(d) for s, d in zip(shape, grid.dims)
+            )
+            for _j in range(arity):
+                out.append(jnp.zeros(gshape, dtype))
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# The jax slab codec (used inside shard_map by halo.exchange_into)
+# ---------------------------------------------------------------------------
+
+
+def _quantize_int8(x):
+    """Per-slab symmetric quantization: (int8 codes, scale scalar in
+    x.dtype). An all-zero slab gets scale 1.0 (codes are 0 either way —
+    no divide-by-zero, and a zeroed received scale still decodes to 0)."""
+    import jax.numpy as jnp
+
+    m = jnp.max(jnp.abs(x))
+    scale = jnp.where(m > 0, m / 127.0, jnp.ones_like(m))
+    q = jnp.clip(jnp.round(x / scale), -127.0, 127.0).astype(jnp.int8)
+    return q, scale
+
+
+def _dequantize_int8(q, scale, dtype):
+    return q.astype(dtype) * scale.astype(dtype)
+
+
+class SlabCodec(NamedTuple):
+    """One slab's wire transform: `send(slab, state) -> (payload_tuple,
+    state_after_send)` and `recv(shipped_tuple, state_after_send, dtype)
+    -> (decoded, final_state)`. The payload is a tuple of arrays shipped
+    leaf-by-leaf over the same ppermute; `state` is a tuple of
+    `state_arity(mode)` arrays (empty for stateless modes)."""
+
+    send: object
+    recv: object
+
+
+def slab_codec(mode: str) -> SlabCodec:
+    import jax.numpy as jnp
+
+    validate_mode(mode)
+
+    if mode == "f32":
+
+        def send(slab, state):
+            return (slab,), state
+
+        def recv(shipped, state, dtype):
+            return shipped[0], state
+
+    elif mode == "bf16":
+        from jax import lax as _lax
+
+        def send(slab, state):
+            # Bitcast the bf16 payload to uint16 for the wire: XLA's
+            # algebraic simplifier hoists a widening convert ACROSS a
+            # collective-permute (narrow->permute->widen canonicalizes
+            # to permute-at-f32 — observed on the CPU lowering, where
+            # the wire ladder measured a "bf16" exchange shipping f32
+            # bytes). A bitcast is opaque to that rewrite, so the wire
+            # provably carries 2-byte elements.
+            return (_lax.bitcast_convert_type(
+                slab.astype(jnp.bfloat16), jnp.uint16
+            ),), state
+
+        def recv(shipped, state, dtype):
+            # The f32 upcast at the seam (GL04): the decoded slab, not
+            # the wire payload, is what seam arithmetic may touch.
+            return _lax.bitcast_convert_type(
+                shipped[0], jnp.bfloat16
+            ).astype(dtype), state
+
+    elif mode == "int8":
+
+        def send(slab, state):
+            (resid,) = state
+            comp = slab + resid  # error feedback: carry last send's error
+            q, scale = _quantize_int8(comp)
+            deq = _dequantize_int8(q, scale, slab.dtype)
+            return (q, scale), (comp - deq,)
+
+        def recv(shipped, state, dtype):
+            q, scale = shipped
+            return _dequantize_int8(q, scale, dtype), state
+
+    else:  # int8_delta
+
+        def send(slab, state):
+            resid, prev_send, prev_recv = state
+            comp = slab + resid
+            q, scale = _quantize_int8(comp - prev_send)
+            deq = _dequantize_int8(q, scale, slab.dtype)
+            new_prev = prev_send + deq
+            return (q, scale), (comp - new_prev, new_prev, prev_recv)
+
+        def recv(shipped, state, dtype):
+            resid, prev_send, prev_recv = state
+            q, scale = shipped
+            decoded = prev_recv + _dequantize_int8(q, scale, dtype)
+            # The receiver's reconstruction integrates exactly what the
+            # sender's did (the dequantized wire values), so the two
+            # stay identical by construction — including the zero
+            # first-sweep and the domain-edge case (an omitted ppermute
+            # delivers zeros: scale 0 -> delta 0 -> the ghost stays 0).
+            return decoded, (resid, prev_send, decoded)
+
+    return SlabCodec(send, recv)
+
+
+# ---------------------------------------------------------------------------
+# The numpy twin (host-staged oracle + the tolerance-contract drill)
+# ---------------------------------------------------------------------------
+
+
+class NumpyWireCodec:
+    """Per-slab numpy twin of `slab_codec`, with the state held
+    internally (the host-staged stepper is the one stateful object in
+    the oracle world). `apply(key, slab)` returns the slab as the
+    receiver would decode it; `key` identifies the logical wire (sender
+    coords, axis, direction) so each wire keeps its own residual /
+    reconstruction across steps. `feedback=False` disables the
+    error-feedback residual (drift-comparison tests only — it is what
+    "compensated, not accumulated" means, made measurable)."""
+
+    def __init__(self, mode: str, feedback: bool = True):
+        self.mode = validate_mode(mode)
+        self.feedback = feedback
+        self._resid: dict = {}
+        self._prev: dict = {}
+
+    def apply(self, key, slab):
+        import numpy as np
+
+        if self.mode == "f32":
+            return slab
+        if self.mode == "bf16":
+            return _np_bf16_round(slab).astype(slab.dtype)
+        resid = self._resid.get(key, 0.0)
+        comp = slab + resid if self.feedback else slab
+        prev = self._prev.get(key, 0.0) if self.mode == "int8_delta" else 0.0
+        d = comp - prev
+        m = float(np.max(np.abs(d)))
+        scale = m / 127.0 if m > 0 else 1.0
+        deq = np.clip(np.round(d / scale), -127.0, 127.0) * scale
+        decoded = prev + deq
+        if self.feedback:
+            self._resid[key] = comp - decoded
+        if self.mode == "int8_delta":
+            self._prev[key] = decoded
+        return decoded.astype(slab.dtype)
+
+
+def _np_bf16_round(x):
+    """Round-to-nearest-even float -> bfloat16 -> float, in numpy (no ml
+    dtypes dependency): bf16 is f32 with the mantissa cut to 7 bits."""
+    import numpy as np
+
+    f = np.asarray(x, np.float32)
+    u = f.view(np.uint32)
+    rounded = ((u + 0x7FFF + ((u >> 16) & 1)) & 0xFFFF0000).astype(np.uint32)
+    out = rounded.view(np.float32)
+    return out.astype(np.asarray(x).dtype)
+
+
+# ---------------------------------------------------------------------------
+# The tolerance contract (vs the f64 host-staged oracle)
+# ---------------------------------------------------------------------------
+
+
+class ContractResult(NamedTuple):
+    mode: str
+    ok: bool
+    rel_err: float
+    bound: float
+    steps: int
+
+
+class _OracleGrid(NamedTuple):
+    """The duck-typed subset of GlobalGrid the host-staged stepper
+    reads — device-free on purpose, so the contract drill (and the
+    tuning gate that calls it) never needs a multi-device backend."""
+
+    global_shape: tuple[int, ...]
+    dims: tuple[int, ...]
+    spacing: tuple[float, ...]
+
+    @property
+    def ndim(self) -> int:
+        return len(self.global_shape)
+
+    @property
+    def local_shape(self) -> tuple[int, ...]:
+        return tuple(
+            n // d for n, d in zip(self.global_shape, self.dims)
+        )
+
+
+_CERT_CACHE: dict = {}
+
+
+def check_tolerance(mode: str, shape=(32, 32), dims=(2, 2),
+                    steps: int = 60) -> ContractResult:
+    """The certification drill: run the f64 host-staged diffusion oracle
+    plain and with the wire codec on the ghost slabs, and bound the
+    relative max-abs divergence by the mode's TOLERANCE row. Device-free
+    (numpy end to end) and deterministic — cheap enough for the tuning
+    gate to consult on every validate."""
+    import numpy as np
+
+    from rocm_mpi_tpu.parallel.halo import HostStagedStepper
+
+    validate_mode(mode)
+    bound = TOLERANCE[mode]
+    shape = tuple(int(n) for n in shape)
+    dims = tuple(int(d) for d in dims)
+    grid = _OracleGrid(
+        global_shape=shape, dims=dims,
+        spacing=tuple(10.0 / n for n in shape),
+    )
+    lam, cp0 = 1.0, 1.0
+    h2 = min(d * d for d in grid.spacing)
+    dt = h2 * cp0 / lam / (2 * grid.ndim + 0.1)
+
+    coords = np.meshgrid(
+        *[(np.arange(n) + 0.5) * d - 5.0
+          for n, d in zip(shape, grid.spacing)],
+        indexing="ij",
+    )
+    T0 = np.exp(-sum(c * c for c in coords)).astype(np.float64)
+    Cp = np.full(shape, cp0, np.float64)
+
+    oracle = HostStagedStepper(grid, lam, dt, use_native=False)
+    wired = HostStagedStepper(grid, lam, dt, use_native=False,
+                              wire_mode=mode)
+    ref = oracle.run(T0.copy(), Cp, steps)
+    got = wired.run(T0.copy(), Cp, steps)
+    rel = float(np.max(np.abs(got - ref)) / max(np.max(np.abs(ref)), 1e-30))
+    return ContractResult(mode, rel <= bound, rel, bound, steps)
+
+
+def certify(mode: str) -> ContractResult:
+    """Cached `check_tolerance` at the standard drill geometry — the
+    tolerance half of the tuning gate's double gate. The cache key
+    includes the mode's CURRENT bound so a (test-)doctored TOLERANCE row
+    re-runs the drill instead of serving a stale verdict."""
+    key = (mode, TOLERANCE[validate_mode(mode)])
+    out = _CERT_CACHE.get(key)
+    if out is None:
+        out = _CERT_CACHE[key] = check_tolerance(mode)
+    return out

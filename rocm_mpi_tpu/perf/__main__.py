@@ -3,11 +3,17 @@
     python -m rocm_mpi_tpu.perf [--local N] [--devices N] [--deep-k K]
                                 [--budgets PATH] [--json]
                                 [--include-waste-fixture]
+                                [--include-wire-fixture] [--no-wire]
 
 CPU-only by construction: it pins the CPU backend, builds a small
 virtual-device mesh, lowers + compiles each distributed step driver, and
 gates the modeled bytes-per-invocation (and exact collective wire bytes)
-against the committed budgets in rocm_mpi_tpu/perf/budgets.json.
+against the committed budgets in rocm_mpi_tpu/perf/budgets.json. It then
+runs the wire-bytes ladder (docs/PERF.md "Wire precision"): one deep
+sweep compiled per wire mode, its exact collective send bytes held to
+the mode's closed-form ideal AND the committed ladder fraction of the
+full-precision wire (--no-wire skips it; --include-wire-fixture audits
+the doctored over-ladder regression row, which must fail).
 
 Exit codes: 0 every audited variant within budget; 1 any variant over
 budget (or over the wire ideal); 2 usage/internal error. Runs in tier-1
@@ -41,6 +47,14 @@ def main(argv=None) -> int:
                    help="also audit the known-waste concatenate-splice "
                    "fixture (regression-tests the gate itself; EXPECTED "
                    "to fail, so the exit code goes 1)")
+    p.add_argument("--include-wire-fixture", action="store_true",
+                   help="also audit the doctored over-ladder wire row "
+                   "(a full-precision program claiming the bf16 ladder "
+                   "row; regression-tests the wire-bytes ladder — "
+                   "EXPECTED to fail, so the exit code goes 1)")
+    p.add_argument("--no-wire", action="store_true",
+                   help="skip the wire-bytes ladder (docs/PERF.md 'Wire "
+                   "precision'); the ladder runs by default")
     args = p.parse_args(argv)
 
     # CPU pinning BEFORE any backend use: the gate must neither need nor
@@ -75,7 +89,20 @@ def main(argv=None) -> int:
         local=local, dims=dims, deep_k=deep_k, budgets=budgets,
         include_waste_fixture=args.include_waste_fixture,
     )
+    wire_rows = []
+    if not args.no_wire:
+        wire_geo = budgets.get("wire", {})
+        wire_rows = traffic.audit_wire_modes(
+            local=int(wire_geo.get("local", traffic.DEFAULT_WIRE_LOCAL)),
+            dims=dims,
+            deep_k=int(wire_geo.get("deep_k",
+                                    traffic.DEFAULT_WIRE_DEEP_K)),
+            budgets=budgets,
+            include_wire_fixture=args.include_wire_fixture,
+        )
     table = traffic.render_table(rows)
+    if wire_rows:
+        table += "\n\n" + traffic.render_wire_table(wire_rows)
     if args.json:
         print(table, file=sys.stderr)
         for r in rows:
@@ -86,18 +113,30 @@ def main(argv=None) -> int:
                 "wire_ideal": r.wire_ideal, "budget": r.budget,
                 "ok": r.ok,
             }))
+        for w in wire_rows:
+            print(json.dumps({
+                "metric": f"wire {w.mode}", "bytes": w.wire_bytes,
+                "full_ideal": w.full_ideal, "mode_ideal": w.mode_ideal,
+                "fraction": round(w.fraction, 4), "ladder": w.ladder,
+                "ok": w.ok,
+            }))
     else:
         print(table)
     bad = [r for r in rows if not r.ok]
-    if bad:
-        print(
-            "perf: TRAFFIC GATE FAILED — "
-            + ", ".join(f"{r.variant} ({r.ratio:.2f}x vs "
-                        f"{r.budget if r.budget is not None else '—'}"
-                        f"{'' if r.wire_ok else ', wire over ideal'})"
-                        for r in bad),
-            file=sys.stderr,
-        )
+    bad_wire = [w for w in wire_rows if not w.ok]
+    if bad or bad_wire:
+        msgs = [
+            f"{r.variant} ({r.ratio:.2f}x vs "
+            f"{r.budget if r.budget is not None else '—'}"
+            f"{'' if r.wire_ok else ', wire over ideal'})"
+            for r in bad
+        ] + [
+            f"wire {w.mode} ({w.fraction:.3f} of the f32 wire vs ladder "
+            f"{w.ladder if w.ladder is not None else '—'})"
+            for w in bad_wire
+        ]
+        print("perf: TRAFFIC GATE FAILED — " + ", ".join(msgs),
+              file=sys.stderr)
         return 1
     return 0
 

@@ -199,6 +199,17 @@ def ideal_deep_sweep_bytes(local_shape, itemsize: int, k: int) -> int:
     return n + npad + 4 * halo + k * 3 * npad
 
 
+def ideal_wire_bytes(local_shape, itemsize: int, width: int,
+                     wire_mode: str = "f32") -> int:
+    """Per-mode closed-form wire ideal of one exchange — the wire-bytes
+    ladder's row anchor (halo.exchange_nbytes at the mode's on-wire
+    itemsize; parallel/wire.py owns the per-mode tables)."""
+    from rocm_mpi_tpu.parallel.halo import exchange_nbytes
+
+    return exchange_nbytes(local_shape, itemsize, width,
+                           wire_mode=wire_mode)
+
+
 # ---------------------------------------------------------------------------
 # The audit
 # ---------------------------------------------------------------------------
@@ -424,6 +435,154 @@ def audit_variants(local: int = DEFAULT_LOCAL, dims=(2, 1),
                 budget=r.budget if r.budget is not None else -1.0,
             )
     return rows
+
+
+# ---------------------------------------------------------------------------
+# The wire-bytes ladder (per-mode reduced-precision exchange audit)
+# ---------------------------------------------------------------------------
+
+DEFAULT_WIRE_LOCAL = 64
+DEFAULT_WIRE_DEEP_K = 4
+
+
+@dataclasses.dataclass(frozen=True)
+class WireRow:
+    """One wire mode's audited deep-sweep program: its EXACT collective
+    send bytes from the optimized HLO, held against two anchors — the
+    mode's own closed-form ideal (the program must not ship more than
+    the codec's accounting, WIRE_TOLERANCE) and the committed ladder
+    row (the fraction of the full-precision wire this mode is allowed
+    to ship; rocm_mpi_tpu/perf/budgets.json "wire")."""
+
+    mode: str
+    wire_bytes: int  # measured send bytes per sweep (per shard)
+    full_ideal: int  # full-precision (f32) closed-form wire bytes
+    mode_ideal: int  # this mode's closed-form wire bytes
+    ladder: float | None  # committed max wire_bytes/full_ideal fraction
+    fixture: bool = False  # the doctored over-ladder regression row
+
+    @property
+    def fraction(self) -> float:
+        return self.wire_bytes / self.full_ideal if self.full_ideal else 0.0
+
+    @property
+    def ok(self) -> bool:
+        under_ladder = (
+            self.ladder is None or self.fraction <= self.ladder
+        )
+        exact = self.wire_bytes <= WIRE_TOLERANCE * self.mode_ideal
+        return under_ladder and exact
+
+
+def audit_wire_modes(local: int = DEFAULT_WIRE_LOCAL, dims=(2, 1),
+                     deep_k: int = DEFAULT_WIRE_DEEP_K,
+                     budgets: dict | None = None,
+                     include_wire_fixture: bool = False) -> list[WireRow]:
+    """Compile the deep-halo sweep (jnp local form, f32 state — the one
+    schedule every wire mode supports, stateful modes included) once per
+    wire mode on the current (CPU) backend and measure its EXACT
+    collective send bytes from the optimized HLO. Each row must land
+    within WIRE_TOLERANCE of the mode's closed-form ideal AND under the
+    committed ladder fraction of the full-precision wire — the proof
+    that a bf16 exchange really ships half the bytes (and the int8/delta
+    modes strictly less), not just that a flag flipped.
+
+    `include_wire_fixture` appends the doctored regression row: a
+    program that SHIPS full-precision slabs audited against the bf16
+    ladder row — the drift class the ladder exists to catch (a codec
+    edit that silently stops downcasting). It must fail."""
+    import jax
+    import jax.numpy as jnp
+
+    from rocm_mpi_tpu import telemetry
+    from rocm_mpi_tpu.config import DiffusionConfig
+    from rocm_mpi_tpu.models import HeatDiffusion
+    from rocm_mpi_tpu.parallel import wire
+    from rocm_mpi_tpu.parallel.deep_halo import make_deep_sweep
+
+    if budgets is None:
+        budgets = load_budgets()
+    wire_cfg = budgets.get("wire", {})
+    ladder_of = wire_cfg.get("ladder", dict(wire.DEFAULT_LADDER))
+
+    dims = tuple(int(d) for d in dims)
+    cfg = DiffusionConfig(
+        global_shape=tuple(local * d for d in dims),
+        lengths=(10.0,) * len(dims),
+        nt=8, warmup=0, dtype="f32", dims=dims,
+    )
+    model = HeatDiffusion(cfg)
+    local_shape = model.grid.local_shape
+    k = min(int(deep_k), min(local_shape))
+    itemsize = 4  # f32 state — the production wire-plane dtype
+    full_ideal = ideal_wire_bytes(local_shape, itemsize, k, "f32")
+    T, Cp = model.init_state()
+    dt = cfg.jax_dtype(cfg.dt)
+
+    def measure(mode: str) -> int:
+        sched = make_deep_sweep(model.grid, k, cfg.lam, dt, cfg.spacing,
+                                local_form="jnp", wire_mode=mode)
+        Cm = jax.jit(sched.prepare)(Cp)
+        jitted = jax.jit(sched.sweep, donate_argnums=0)
+        args = (T, Cm) if sched.init_wire is None else (
+            T, Cm, sched.init_wire(jnp.float32)
+        )
+        text = jitted.lower(*args).compile().as_text()
+        return hlo_wire_bytes(text)
+
+    rows: list[WireRow] = []
+    for mode in wire.WIRE_MODES:
+        rows.append(WireRow(
+            mode=mode,
+            wire_bytes=measure(mode),
+            full_ideal=full_ideal,
+            mode_ideal=ideal_wire_bytes(local_shape, itemsize, k, mode),
+            ladder=ladder_of.get(mode),
+        ))
+
+    if include_wire_fixture:
+        # The doctored row: a full-precision sweep claiming the bf16
+        # ladder row. fraction 1.0 > 0.55 — the gate must exit 1.
+        rows.append(WireRow(
+            mode="bf16(fixture)",
+            wire_bytes=measure("f32"),
+            full_ideal=full_ideal,
+            mode_ideal=ideal_wire_bytes(local_shape, itemsize, k, "bf16"),
+            ladder=ladder_of.get("bf16"),
+            fixture=True,
+        ))
+
+    if telemetry.enabled():
+        for r in rows:
+            telemetry.annotate(
+                "wire.ladder", mode=r.mode, bytes=int(r.wire_bytes),
+                full_ideal=int(r.full_ideal),
+                mode_ideal=int(r.mode_ideal),
+                fraction=round(r.fraction, 4),
+                ladder=r.ladder if r.ladder is not None else -1.0,
+            )
+    return rows
+
+
+def render_wire_table(rows: list[WireRow]) -> str:
+    head = (
+        f"{'wire mode':16s} {'wire/sweep':>10s} {'f32 ideal':>10s} "
+        f"{'mode ideal':>10s} {'frac':>6s} {'ladder':>6s} status"
+    )
+    lines = [head, "-" * len(head)]
+    for r in rows:
+        ladder = f"{r.ladder:.2f}" if r.ladder is not None else "   —"
+        if r.ok:
+            status = "ok"
+        elif r.ladder is not None and r.fraction > r.ladder:
+            status = "OVER LADDER"
+        else:
+            status = "OVER MODE IDEAL"
+        lines.append(
+            f"{r.mode:16s} {r.wire_bytes:10d} {r.full_ideal:10d} "
+            f"{r.mode_ideal:10d} {r.fraction:6.3f} {ladder:>6s} {status}"
+        )
+    return "\n".join(lines)
 
 
 def render_table(rows: list[TrafficRow]) -> str:

@@ -28,9 +28,12 @@
         the elastic supervisor left an elastic.jsonl sidecar in DIR
         (docs/RESILIENCE.md "Elastic recovery" and §7), the header shows
         the CURRENT mesh shape plus SHRUNK / GROWN badges for runs that
-        changed topology, and a STORAGE DEGRADED indicator when the
+        changed topology, a STORAGE DEGRADED indicator when the
         ckpt_* heartbeat counters say a rank is skipping saves through a
-        storage outage. Curses-free — redraws in place on a TTY, appends
+        storage outage, and a WIRE badge when the run's telemetry
+        streams carry reduced-precision exchange annotations
+        (docs/PERF.md "Wire precision"). Curses-free — redraws in place
+        on a TTY, appends
         snapshots otherwise. Exit 0 after N iterations (default: run
         until ^C), 2 when DIR has no heartbeat sidecars to watch.
 
@@ -173,6 +176,14 @@ def _cmd_monitor(args) -> int:
     prev: dict[int, dict] | None = None
     i = 0
     clear_screen = sys.stdout.isatty()
+    # Reduced-precision wire badge (docs/PERF.md "Wire precision"):
+    # annotation-sourced from the rank streams — an f32 run and a
+    # bf16-wire run must never be eyeballed (or regress-compared) as
+    # the same measurement. Wire modes are trace-time facts, fixed per
+    # compiled program: read the streams ONCE here, not per poll (they
+    # grow with the run; the heartbeat sidecars the loop re-reads stay
+    # small by construction).
+    wire_line = health.format_wire_status(health.wire_status(args.dir))
     try:
         while True:
             rows = health.monitor_rows(beats, prev)
@@ -199,6 +210,8 @@ def _cmd_monitor(args) -> int:
             )
             if storage_line:
                 print(storage_line)
+            if wire_line:
+                print(wire_line)
             print(health.format_monitor(rows, skipped))
             sys.stdout.flush()
             i += 1

@@ -108,6 +108,18 @@ def load_rank_streams(directory) -> tuple[dict[int, list[dict]], int]:
     return streams, skipped
 
 
+def record_wire_mode(rec: dict) -> str | None:
+    """The wire-precision mode a telemetry record annotates, or None.
+    THE one definition of "this record stamps a wire mode" — the
+    summary's `wire_modes` list and the monitor's WIRE badge
+    (telemetry.health.wire_status) both consume it, so the annotation
+    shape can never drift between the two read sides."""
+    if rec.get("kind") != "trace":
+        return None
+    w = (rec.get("attrs") or {}).get("wire")
+    return str(w) if w else None
+
+
 def phase_of(rec: dict) -> str:
     """A record's phase: the explicit `phase` attr wins, else the dotted
     name's first component, with the step-window spelling folded in."""
@@ -142,6 +154,7 @@ def summarize(streams: dict[int, list[dict]], skipped_lines: int = 0,
     counters: dict[str, float] = {}
     event_counts: dict[str, int] = {}
     traced: dict[str, dict] = {}
+    wire_modes: set[str] = set()
     n_records = 0
 
     for rk, recs in sorted(streams.items()):
@@ -176,6 +189,12 @@ def summarize(streams: dict[int, list[dict]], skipped_lines: int = 0,
                     # under distinct keys so the regress gate can never
                     # compare them silently (apps/_common.py --driver).
                     key = f"{key}:{attrs['driver']}"
+                if attrs.get("wire") and attrs["wire"] != "f32":
+                    # Same identity rule for the wire-precision plane:
+                    # an f32 rate and a bf16-wire rate are different
+                    # measurements (the default spelling is unchanged
+                    # so committed baselines keep gating f32 runs).
+                    key = f"{key}:{attrs['wire']}"
                 gauge_samples.setdefault(key, []).append(rec.get("value"))
                 gauge_series.append({
                     "name": rec["name"], "value": rec.get("value"),
@@ -194,6 +213,14 @@ def summarize(streams: dict[int, list[dict]], skipped_lines: int = 0,
                 )
             elif kind == "trace":
                 traced[rec["name"]] = attrs
+                # The active wire-precision mode(s), annotation-sourced
+                # (halo.exchange / deep.sweep / overlap.step stamp it at
+                # trace time): collected ACROSS records, because `traced`
+                # keeps only the last attrs per name and a mixed-mode
+                # run would otherwise report just one mode.
+                w = record_wire_mode(rec)
+                if w:
+                    wire_modes.add(w)
 
     gauges: dict[str, object] = {}
     for key, samples in gauge_samples.items():
@@ -260,6 +287,7 @@ def summarize(streams: dict[int, list[dict]], skipped_lines: int = 0,
         "counters": counters,
         "events": event_counts,
         "traced": traced,
+        "wire_modes": sorted(wire_modes),
         "stragglers": stragglers,
     }
 
@@ -316,6 +344,12 @@ def format_summary(summary: dict) -> str:
             f"per-step us mean={p['mean']} p50={p['p50']} "
             f"p90={p['p90']} p99={p['p99']}"
         )
+    wire_modes = summary.get("wire_modes") or []
+    if wire_modes and wire_modes != ["f32"]:
+        # The badge: a reduced-precision (or mixed) wire must be
+        # impossible to miss next to an f32 summary — the f32-only case
+        # stays silent so existing reports are byte-identical.
+        lines.append("WIRE MODE: " + ", ".join(wire_modes))
     for name, value in sorted(summary["gauges"].items()):
         lines.append(f"gauge {name} = {value}")
     for name, n in sorted(summary["events"].items()):

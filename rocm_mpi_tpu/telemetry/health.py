@@ -378,6 +378,34 @@ def format_storage_status(status: dict | None) -> str | None:
     return f"storage recovered ({status['skipped']} skipped save(s))"
 
 
+def wire_status(directory) -> list[str]:
+    """The run's active wire-precision mode(s) (docs/PERF.md "Wire
+    precision"), annotation-sourced from the telemetry rank streams in
+    `directory` (the halo.exchange / deep.sweep / overlap.step trace
+    records stamp `wire` per compiled program). Sorted, [] when the
+    streams carry no wire-stamped annotations (pre-wire-plane runs)."""
+    from rocm_mpi_tpu.telemetry import aggregate
+
+    modes: set[str] = set()
+    streams, _skipped = aggregate.load_rank_streams(directory)
+    for recs in streams.values():
+        for rec in recs:
+            w = aggregate.record_wire_mode(rec)
+            if w:
+                modes.add(w)
+    return sorted(modes)
+
+
+def format_wire_status(modes: list[str]) -> str | None:
+    """`[WIRE bf16]` for a reduced-precision (or mixed-mode) run — like
+    the GROWN/DEGRADED badges, the operator must see at a glance that
+    this run's halo bytes are not comparable to an f32 run's. None for
+    f32-only or unstamped streams (no badge — the common case)."""
+    if not modes or modes == ["f32"]:
+        return None
+    return "[WIRE " + ", ".join(m for m in modes) + "]"
+
+
 # ---------------------------------------------------------------------------
 # Post-mortem composition and bundling (the watchdog's out-of-process half)
 # ---------------------------------------------------------------------------

@@ -186,6 +186,9 @@ def _classify_json(doc: dict) -> str | None:
         return named[doc["schema"]]
     if "step" in doc and "leaves" in doc and "files" in doc:
         return "checkpoint manifest"
+    if "budgets" in doc and isinstance(doc.get("budgets"), dict) \
+            and "v" in doc:
+        return "perf budgets"
     if "metrics" in doc and isinstance(doc["metrics"], dict):
         return "flat metrics baseline"
     if "metric" in doc and "north_star" in doc:
@@ -215,7 +218,48 @@ def _validate_classified(doc: dict, kind: str) -> list[str]:
         from rocm_mpi_tpu.analysis.baseline import validate_baseline_doc
 
         return validate_baseline_doc(doc)
+    if kind == "perf budgets":
+        return _validate_perf_budgets(doc)
     return []
+
+
+# The wire-mode registry, spelled here so the telemetry read side stays
+# importable without jax (parallel.wire's tables are behind the
+# parallel package's jax-importing __init__). tests/test_wire.py pins
+# this tuple equal to parallel.wire.WIRE_MODES — drift fails loudly.
+_WIRE_MODES = ("f32", "bf16", "int8", "int8_delta")
+
+
+def _validate_perf_budgets(doc: dict) -> list[str]:
+    """perf/budgets.json (docs/PERF.md): per-variant A_eff ratio budgets
+    plus the PR-12 wire-bytes ladder block. A hand-edited row (negative
+    budget, unknown wire mode, fraction over 1.02) must fail HERE, not
+    silently loosen — or brick — the traffic gate that reads it."""
+    problems = []
+    for name, v in doc["budgets"].items():
+        if not isinstance(v, (int, float)) or isinstance(v, bool) or v <= 0:
+            problems.append(f"budget {name!r} is not a positive number")
+    wire = doc.get("wire")
+    if wire is None:
+        return problems
+    if not isinstance(wire, dict):
+        return problems + ["'wire' block is not an object"]
+    ladder = wire.get("ladder")
+    if not isinstance(ladder, dict) or not ladder:
+        problems.append("wire block missing its 'ladder' rows")
+        return problems
+    for mode, frac in ladder.items():
+        if mode not in _WIRE_MODES:
+            problems.append(
+                f"wire ladder names unknown mode {mode!r} "
+                f"(known: {list(_WIRE_MODES)})"
+            )
+        if not isinstance(frac, (int, float)) or isinstance(frac, bool) \
+                or not 0 < frac <= 1.02:
+            problems.append(
+                f"wire ladder row {mode!r}={frac!r} outside (0, 1.02]"
+            )
+    return problems
 
 
 def _validate_elastic_record(doc: dict) -> list[str]:

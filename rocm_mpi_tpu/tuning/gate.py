@@ -58,11 +58,86 @@ def _prod(xs) -> int:
     return out
 
 
+def _wire_ladder() -> dict:
+    """The committed wire-bytes ladder rows (perf/budgets.json "wire"),
+    falling back to the code defaults when a budgets file predates the
+    ladder. Cached: the gate may run per cache entry."""
+    ladder = _WIRE_LADDER_CACHE.get("ladder")
+    if ladder is None:
+        from rocm_mpi_tpu.parallel import wire as _wire
+
+        try:
+            from rocm_mpi_tpu.perf.traffic import load_budgets
+
+            ladder = dict(_wire.DEFAULT_LADDER)
+            ladder.update(load_budgets().get("wire", {}).get("ladder", {}))
+        except (OSError, ValueError):
+            ladder = dict(_wire.DEFAULT_LADDER)
+        _WIRE_LADDER_CACHE["ladder"] = ladder
+    return ladder
+
+
+_WIRE_LADDER_CACHE: dict = {}
+
+
+def _validate_wire_mode(op: str, family: str, shape, config: dict,
+                        budget: float, ideal: int) -> GateResult | None:
+    """The wire-precision double gate on a config's `wire_mode` field
+    (None = no wire field = nothing to check). A non-f32 mode is
+    accepted ONLY when (a) its closed-form wire bytes land under the
+    committed ladder row — fast-but-fat rejected — AND (b) the mode
+    passes the tolerance contract vs the f64 host-staged oracle
+    (parallel/wire.certify) — fast-but-out-of-tolerance rejected."""
+    wm = config.get("wire_mode")
+    if wm is None:
+        return None
+    from rocm_mpi_tpu.parallel import wire as _wire
+
+    bad = lambda reason: GateResult(  # noqa: E731 — local shorthand
+        False, float("inf"), 0, ideal, budget, reason
+    )
+    if wm not in _wire.WIRE_MODES:
+        return bad(f"wire_mode={wm!r} is not one of {_wire.WIRE_MODES}")
+    if family not in ("deep", "scan"):
+        return bad(
+            f"wire_mode is not a knob for op family {family!r} (the "
+            "exchangeful families are deep/scan)"
+        )
+    if _wire.is_stateful(wm) and family != "deep":
+        return bad(
+            f"wire_mode={wm!r} carries error-feedback state; only the "
+            "deep-halo schedule threads it (per-step programs are "
+            "stateless)"
+        )
+    if wm == "f32":
+        return None
+    width = int(config.get("k", 1) or 1) if family == "deep" else 1
+    frac = _wire.ladder_fraction(shape, width, wm)
+    row = _wire_ladder().get(wm)
+    if row is not None and frac > row:
+        return bad(
+            f"wire_mode={wm} models {frac:.3f} of the full-precision "
+            f"wire vs its ladder row {row:.2f} (perf/budgets.json) — "
+            "over the wire-bytes ladder, rejected"
+        )
+    cert = _wire.certify(wm)
+    if not cert.ok:
+        return bad(
+            f"wire_mode={wm} fails the tolerance contract vs the f64 "
+            f"host-staged oracle (rel err {cert.rel_err:.2e} > bound "
+            f"{cert.bound:.2e} over {cert.steps} steps) — fast-but-"
+            "out-of-tolerance, rejected"
+        )
+    return None
+
+
 def validate_config(op: str, shape, dtype: str, config: dict,
                     budget: float | None = None) -> GateResult:
     """Model one config's per-step HBM traffic against the A_eff ideal
     and gate the ratio. `shape` is the per-shard field shape; `dtype`
-    the storage dtype name from the tuning key."""
+    the storage dtype name from the tuning key. A `wire_mode` field is
+    double-gated (_validate_wire_mode): the wire-bytes ladder AND the
+    f64-oracle tolerance contract must both hold."""
     family = op.split(".", 1)[1] if "." in op else op
     if budget is None:
         budget = BUDGETS[family]
@@ -70,6 +145,11 @@ def validate_config(op: str, shape, dtype: str, config: dict,
     itemsize = _space.compute_itemsize(dtype)
     n = _prod(shape) * itemsize
     ideal = 3 * n  # the (2+1)-traversal bound per step
+
+    wire_verdict = _validate_wire_mode(op, family, shape, config,
+                                       budget, ideal)
+    if wire_verdict is not None:
+        return wire_verdict
 
     if family == "vmem_loop":
         # Knob validity is part of the gate's contract: the runtime

@@ -60,6 +60,12 @@ def _doc() -> dict:
     return doc
 
 
+def _valid_wire_mode(v) -> bool:
+    from rocm_mpi_tpu.parallel.wire import WIRE_MODES
+
+    return v in WIRE_MODES
+
+
 # Per-knob validity at the consumption seam: a cache entry is UNTRUSTED
 # input (hand-edited, written by a future version, doctored) and the
 # miss contract says auto is never worse than the defaults — so a field
@@ -77,6 +83,10 @@ _FIELD_VALID = {
     and v >= 8 and v % 8 == 0,
     "k": lambda v: isinstance(v, int) and not isinstance(v, bool)
     and v >= 1,
+    # Crash-safety only, like every row here: an unknown mode would be a
+    # trace-time ValueError out of the exchange; the gate/validate CLI
+    # is the loud half that rejects an uncertified or over-ladder one.
+    "wire_mode": _valid_wire_mode,
 }
 
 

@@ -103,7 +103,12 @@ def _make_runner(op: str, shape, dtype: str):
             model = HeatDiffusion(
                 DiffusionConfig(nt=2 * k, warmup=k, **common)
             )
-            return model.run_deep(block_steps=k).wtime_it
+            # The wire axis: a candidate IS its (k, wire_mode) pair —
+            # measuring a bf16 candidate through the f32 exchange would
+            # crown winners on numbers they never produced.
+            return model.run_deep(
+                block_steps=k, wire_mode=config.get("wire_mode")
+            ).wtime_it
 
     else:
         raise ValueError(

@@ -21,7 +21,13 @@ The knobs per op family (ISSUE 7 / ROADMAP item 2):
                      defaults (the config="auto" contract).
 * `diffusion.masked_step` — the stripe height `tm` (the threads=(32,8)
                      analog) for HBM-class fields.
-* `diffusion.deep` — the sweep depth `k` (exchange every k steps).
+* `diffusion.deep` — the sweep depth `k` (exchange every k steps), and
+                     the state exchange's `wire_mode` (the PR-12 wire-
+                     precision plane, parallel/wire.py) — the deep sweep
+                     is the one schedule every mode supports, stateful
+                     int8/delta included. Default-precision candidates
+                     enumerate first so the tie-break keeps f32 when a
+                     cheaper wire buys nothing.
 * `*.scan`         — the scan drivers' static chunk `q`.
 """
 
@@ -126,8 +132,15 @@ def enumerate_space(op: str, shape, dtype: str,
         return out
 
     if family == "deep":
+        from rocm_mpi_tpu.parallel.wire import WIRE_MODES
+
+        # wire_mode outer, k inner, f32 first: the search's "earlier
+        # candidate wins" tie-break must prefer full precision at equal
+        # speed, and within a mode the shallower sweep.
         return [
-            {"k": k} for k in _DEEP_KS if k <= min(shape)
+            {"k": k, "wire_mode": wm}
+            for wm in WIRE_MODES
+            for k in _DEEP_KS if k <= min(shape)
         ]
 
     if family == "scan":

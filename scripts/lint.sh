@@ -88,6 +88,7 @@ shopt -u nullglob
 env JAX_PLATFORMS=cpu python -m rocm_mpi_tpu.telemetry regress \
   --check-schema BASELINE.json MULTICHIP_r0*.json \
   rocm_mpi_tpu/analysis/baseline.json \
+  rocm_mpi_tpu/perf/budgets.json \
   ${bench_records[@]+"${bench_records[@]}"} \
   ${health_records[@]+"${health_records[@]}"} \
   docs/weak_scaling_*mechanics*.jsonl 1>&2 || exit $?

@@ -1,0 +1,25 @@
+"""GL04 wire-seam true negatives: the decoded-slab discipline
+(upcast/decode before any seam arithmetic), and full-precision ships
+that never taint."""
+
+import jax.numpy as jnp
+
+from rocm_mpi_tpu.parallel.halo import neighbor_shift
+
+
+def ok_upcast_at_seam(u, name):
+    # The received slab is upcast BEFORE arithmetic — the contract.
+    ghost = neighbor_shift(u.astype(jnp.bfloat16), name, +1)
+    decoded = ghost.astype(jnp.float32)
+    return decoded + u
+
+
+def ok_inline_upcast(u, name):
+    ghost = neighbor_shift(u.astype(jnp.bfloat16), name, -1)
+    return u - ghost.astype(u.dtype) * 2.0
+
+
+def ok_full_precision_ship(u, name):
+    # Full-precision wire: nothing to decode, arithmetic is fine.
+    ghost = neighbor_shift(u, name, +1)
+    return ghost + u

@@ -1,0 +1,21 @@
+"""GL04 wire-seam true positives: arithmetic on a received
+reduced-precision slab without the f32 upcast at the seam
+(docs/ANALYSIS.md#gl04; parallel/wire.py owns the codec)."""
+
+import jax.numpy as jnp
+
+from rocm_mpi_tpu.parallel.halo import neighbor_shift
+
+
+def bad_direct_downcast(u, name):
+    # Payload downcast at the ship call; the received slab is consumed
+    # raw by seam arithmetic — GL04 fires.
+    ghost = neighbor_shift(u.astype(jnp.bfloat16), name, +1)
+    return ghost + u
+
+
+def bad_named_payload(u, name):
+    # The downcast marker propagates through the payload name.
+    payload = u.astype(jnp.bfloat16)
+    ghost = neighbor_shift(payload, name, -1)
+    return u - ghost * 2.0

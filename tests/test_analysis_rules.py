@@ -577,6 +577,33 @@ def test_gl04_coverage_ignores_broadcast_in_specs():
     assert lint_source(src, "bcast.py") == []
 
 
+def test_gl04_wire_seam_true_positive():
+    """Arithmetic on a received bf16 slab without the f32 upcast at the
+    seam fires (PR 12 wire-precision plane, docs/ANALYSIS.md#gl04) —
+    both the inline-downcast and named-payload shapes."""
+    findings = lint_fixture("gl04_wire_pos.py")
+    live = [f for f in findings if not f.suppressed]
+    assert live and all(f.rule == "GL04" for f in live)
+    assert all("upcast at the seam" in f.message for f in live)
+    # Both fixture functions fire.
+    lines = {f.line for f in live}
+    assert len(lines) >= 2
+
+
+def test_gl04_wire_seam_true_negative():
+    """Decoded-before-use slabs and full-precision ships stay clean."""
+    assert lint_fixture("gl04_wire_neg.py") == []
+
+
+def test_gl04_wire_seam_repo_clean():
+    """The shipped exchange itself (halo.py routes every received slab
+    through the codec decode before the seam) must not fire."""
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    halo = repo / "rocm_mpi_tpu" / "parallel" / "halo.py"
+    findings = lint_source(halo.read_text(), str(halo))
+    assert [f for f in findings if f.rule == "GL04"] == []
+
+
 def test_lint_file_cache_returns_fresh_copies(tmp_path):
     """Mutating a returned Finding must not poison later cache hits, and
     display_path must not be served from another label's entry."""

@@ -167,8 +167,8 @@ def test_hide_interior_consumes_no_collective_results(monkeypatch):
     step_clean = m_clean.step_fn("hide")
     out_clean = np.asarray(jax.block_until_ready(step_clean(T, Cp)))
 
-    def poisoned_exchange(u, grid, width=1, axes=None):
-        padded = exchange_halo(u, grid, width=width, axes=axes)
+    def poisoned_exchange(u, grid, width=1, axes=None, **wire_kw):
+        padded = exchange_halo(u, grid, width=width, axes=axes, **wire_kw)
         # Everything outside the original core is ghost data that arrived
         # (or would arrive) via collective_permute: poison it all.
         core = tuple(slice(width, width + n) for n in u.shape)

@@ -63,10 +63,22 @@ class TestGateCLI:
         rows = [json.loads(ln) for ln in proc.stdout.splitlines() if ln]
         assert {r["metric"] for r in rows} >= {"traffic shard",
                                                "traffic overlap"}
-        for r in rows:
+        traffic_rows = [r for r in rows
+                        if r["metric"].startswith("traffic ")]
+        for r in traffic_rows:
             assert r["ok"] is True
             assert r["bytes"] > 0 and r["ideal"] > 0
             assert r["wire"] == r["wire_ideal"]  # exact wire accounting
+        # The wire-bytes ladder rows ride the same JSON stream
+        # (docs/PERF.md "Wire precision").
+        wire_rows = {r["metric"]: r for r in rows
+                     if r["metric"].startswith("wire ")}
+        assert {"wire f32", "wire bf16", "wire int8",
+                "wire int8_delta"} <= set(wire_rows)
+        for r in wire_rows.values():
+            assert r["ok"] is True
+            assert r["bytes"] == r["mode_ideal"]  # exact accounting
+        assert wire_rows["wire bf16"]["fraction"] <= 0.55
 
 
 class TestTrafficModel:

@@ -1,0 +1,676 @@
+"""The wire-precision plane (docs/PERF.md "Wire precision"): per-mode
+codecs and byte accounting, the f32 bitwise pin, the tolerance contract
+vs the f64 host-staged oracle on all three workloads, error-feedback
+drift, delta round-trips including the first-sweep edge, the wire-bytes
+ladder (and its doctored fixture's teeth), and the tuning-axis double
+gate."""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec
+
+from rocm_mpi_tpu.config import DiffusionConfig
+from rocm_mpi_tpu.models import (
+    AcousticWave,
+    HeatDiffusion,
+    ShallowWater,
+    SWEConfig,
+    WaveConfig,
+)
+from rocm_mpi_tpu.parallel import (
+    HostStagedStepper,
+    exchange_halo,
+    init_global_grid,
+)
+from rocm_mpi_tpu.parallel import wire
+from rocm_mpi_tpu.parallel.halo import build_for_mesh, exchange_nbytes
+from rocm_mpi_tpu.utils.compat import shard_map
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+NON_F32 = [m for m in wire.WIRE_MODES if m != "f32"]
+STATEFUL = sorted(wire.STATEFUL_MODES)
+
+
+def _rel_err(got, ref):
+    got = np.asarray(got, np.float64)
+    ref = np.asarray(ref, np.float64)
+    return float(np.max(np.abs(got - ref)) / np.max(np.abs(ref)))
+
+
+# ---------------------------------------------------------------------------
+# Byte accounting (satellite: annotations report actual on-wire itemsize)
+# ---------------------------------------------------------------------------
+
+
+class TestWireBytes:
+    def test_exchange_nbytes_per_mode(self):
+        # (64,64) width-1: f32 slabs 2*(64+66)*4; bf16 half; int8 one
+        # byte per element + one f32 scale per slab (4 slabs).
+        assert exchange_nbytes((64, 64), 4, 1) == 1040
+        assert exchange_nbytes((64, 64), 4, 1, wire_mode="bf16") == 520
+        assert exchange_nbytes((64, 64), 4, 1, wire_mode="int8") == 276
+        assert exchange_nbytes(
+            (64, 64), 4, 1, wire_mode="int8_delta"
+        ) == 276
+        # f32 mode ships the STATE dtype verbatim (f64 oracle -> 8B).
+        assert exchange_nbytes((64, 64), 8, 1) == 2080
+
+    def test_ladder_fractions_closed_form(self):
+        assert wire.ladder_fraction((64, 64), 1, "f32") == 1.0
+        assert wire.ladder_fraction((64, 64), 1, "bf16") == 0.5
+        assert wire.ladder_fraction((64, 64), 1, "int8") < 0.5
+        assert wire.ladder_fraction((64, 64), 1, "int8_delta") < 0.5
+
+    def test_slab_shapes_corner_growth(self):
+        # Axis 1's slabs span axis 0's padding (the corner trick).
+        assert wire.slab_shapes((4, 4), 1) == [
+            (1, 4), (1, 4), (6, 1), (6, 1)
+        ]
+
+    def test_unknown_mode_rejected(self):
+        with pytest.raises(ValueError, match="unknown wire_mode"):
+            wire.validate_mode("fp4")
+        with pytest.raises(ValueError, match="wire_mode"):
+            DiffusionConfig(wire_mode="fp4")
+
+    def test_annotation_reports_mode_bytes(self, tmp_path):
+        from rocm_mpi_tpu import telemetry
+
+        grid = init_global_grid(8, 8, dims=(2, 2))
+        x = jax.device_put(
+            jnp.arange(64.0, dtype=jnp.float32).reshape(8, 8),
+            grid.sharding,
+        )
+        telemetry.configure(enabled=True, directory=str(tmp_path), rank=0)
+        try:
+            jax.jit(lambda v: shard_map(
+                lambda b: exchange_halo(b, grid, wire_mode="bf16"),
+                mesh=grid.mesh, in_specs=grid.spec, out_specs=grid.spec,
+            )(v))(x)
+            recs = telemetry.records(kind="trace", name="halo.exchange")
+            assert recs, "no halo.exchange annotation"
+            attrs = recs[-1]["attrs"]
+            assert attrs["wire"] == "bf16"
+            # TRUE on-wire bytes: the bf16 figure, not the f32 one.
+            assert attrs["bytes"] == exchange_nbytes(
+                (4, 4), 4, 1, wire_mode="bf16"
+            )
+        finally:
+            telemetry.configure(enabled=False)
+            telemetry.clear()
+
+    def test_halo_program_carries_wire_mode(self):
+        grid = init_global_grid(16, 16, dims=(2, 2))
+        prog = build_for_mesh(grid, width=2, wire_mode="bf16")
+        assert prog.wire_mode == "bf16"
+        assert prog.nbytes(4) == exchange_nbytes(
+            (8, 8), 4, 2, wire_mode="bf16"
+        )
+
+
+# ---------------------------------------------------------------------------
+# The f32 bitwise pin
+# ---------------------------------------------------------------------------
+
+
+class TestF32Bitwise:
+    def test_exchange_jaxpr_identical(self):
+        # wire_mode="f32" must trace the EXACT pre-wire-plane program.
+        grid = init_global_grid(32, lengths=(1.0,), dims=(8,))
+
+        def padded(wm_kw):
+            return jax.make_jaxpr(lambda v: shard_map(
+                lambda b: exchange_halo(b, grid, **wm_kw),
+                mesh=grid.mesh,
+                in_specs=PartitionSpec("gx"),
+                out_specs=PartitionSpec("gx"),
+            )(v))(jnp.arange(32.0))
+
+        assert str(padded({})) == str(padded({"wire_mode": "f32"}))
+
+    @pytest.mark.parametrize("variant", ["shard", "perf", "hide"])
+    def test_run_bitwise_equal_to_default(self, variant):
+        base = DiffusionConfig(global_shape=(32, 32), nt=12, warmup=0,
+                               dims=(2, 2), dtype="f64")
+        pinned = DiffusionConfig(global_shape=(32, 32), nt=12, warmup=0,
+                                 dims=(2, 2), dtype="f64",
+                                 wire_mode="f32")
+        r0 = HeatDiffusion(base).run(variant=variant)
+        r1 = HeatDiffusion(pinned).run(variant=variant)
+        np.testing.assert_array_equal(np.asarray(r0.T), np.asarray(r1.T))
+
+    def test_wave_and_swe_f32_bitwise_equal_to_default(self):
+        w0 = AcousticWave(WaveConfig(
+            global_shape=(32, 32), nt=12, warmup=0, dims=(2, 2),
+            dtype="f64",
+        )).run(variant="perf")
+        w1 = AcousticWave(WaveConfig(
+            global_shape=(32, 32), nt=12, warmup=0, dims=(2, 2),
+            dtype="f64", wire_mode="f32",
+        )).run(variant="perf")
+        np.testing.assert_array_equal(np.asarray(w0.U), np.asarray(w1.U))
+        s0 = ShallowWater(SWEConfig(
+            global_shape=(32, 32), nt=12, warmup=0, dims=(2, 2),
+            dtype="f64",
+        )).run(variant="perf")
+        s1 = ShallowWater(SWEConfig(
+            global_shape=(32, 32), nt=12, warmup=0, dims=(2, 2),
+            dtype="f64", wire_mode="f32",
+        )).run(variant="perf")
+        np.testing.assert_array_equal(np.asarray(s0.h), np.asarray(s1.h))
+
+    def test_deep_f32_bitwise_equal_to_default(self):
+        base = DiffusionConfig(global_shape=(32, 32), nt=16, warmup=0,
+                               dims=(2, 2), dtype="f64")
+        r0 = HeatDiffusion(base).run_deep(block_steps=4)
+        r1 = HeatDiffusion(base).run_deep(block_steps=4, wire_mode="f32")
+        np.testing.assert_array_equal(np.asarray(r0.T), np.asarray(r1.T))
+
+
+# ---------------------------------------------------------------------------
+# Tolerance contract: per-mode parity vs the f64 oracle, all 3 workloads
+# ---------------------------------------------------------------------------
+
+
+class TestToleranceContract:
+    @pytest.mark.parametrize("mode", wire.WIRE_MODES)
+    def test_certification_drill(self, mode):
+        res = wire.check_tolerance(mode)
+        assert res.ok, (
+            f"{mode}: rel err {res.rel_err:.3e} > bound {res.bound:.0e}"
+        )
+
+    @pytest.mark.parametrize("mode", ["bf16"])
+    def test_diffusion_per_step_vs_host_staged_oracle(self, mode):
+        # f64 host-staged oracle vs the f32-state wire-mode device path
+        # (per-step shard variant; stateless modes only by design).
+        oracle = DiffusionConfig(global_shape=(32, 32), nt=40, warmup=0,
+                                 dims=(2, 2), dtype="f64",
+                                 halo_transport="host")
+        ref = HeatDiffusion(oracle).run(variant="shard")
+        cfg = DiffusionConfig(global_shape=(32, 32), nt=40, warmup=0,
+                              dims=(2, 2), dtype="f32", wire_mode=mode)
+        got = HeatDiffusion(cfg).run(variant="shard")
+        assert _rel_err(got.T, ref.T) <= wire.TOLERANCE[mode]
+
+    @pytest.mark.parametrize("mode", NON_F32)
+    def test_diffusion_deep_vs_host_staged_oracle(self, mode):
+        oracle = DiffusionConfig(global_shape=(32, 32), nt=40, warmup=0,
+                                 dims=(2, 2), dtype="f64",
+                                 halo_transport="host")
+        ref = HeatDiffusion(oracle).run(variant="shard")
+        cfg = DiffusionConfig(global_shape=(32, 32), nt=40, warmup=0,
+                              dims=(2, 2), dtype="f32")
+        got = HeatDiffusion(cfg).run_deep(block_steps=4, wire_mode=mode)
+        assert _rel_err(got.T, ref.T) <= wire.TOLERANCE[mode]
+
+    @pytest.mark.parametrize("mode", NON_F32)
+    def test_wave_deep_vs_f64_oracle(self, mode):
+        ref = AcousticWave(WaveConfig(
+            global_shape=(32, 32), nt=24, warmup=0, dims=(2, 2),
+            dtype="f64",
+        )).run_deep(block_steps=4)
+        got = AcousticWave(WaveConfig(
+            global_shape=(32, 32), nt=24, warmup=0, dims=(2, 2),
+            dtype="f32", wire_mode=mode,
+        )).run_deep(block_steps=4)
+        assert _rel_err(got.U, ref.U) <= wire.TOLERANCE[mode]
+
+    @pytest.mark.parametrize("mode", NON_F32)
+    def test_swe_deep_vs_f64_oracle(self, mode):
+        ref = ShallowWater(SWEConfig(
+            global_shape=(32, 32), nt=24, warmup=0, dims=(2, 2),
+            dtype="f64",
+        )).run_deep(block_steps=4)
+        got = ShallowWater(SWEConfig(
+            global_shape=(32, 32), nt=24, warmup=0, dims=(2, 2),
+            dtype="f32", wire_mode=mode,
+        )).run_deep(block_steps=4)
+        assert _rel_err(got.h, ref.h) <= wire.TOLERANCE[mode]
+
+    def test_host_staged_bf16_matches_device_bf16_wire(self):
+        # The oracle twin IS the device path, codec included: f64 state
+        # both sides, bf16 wire both sides — transport-bisection holds
+        # for reduced-precision exchanges too.
+        host = DiffusionConfig(global_shape=(32, 32), nt=20, warmup=0,
+                               dims=(2, 2), dtype="f64",
+                               halo_transport="host", wire_mode="bf16")
+        r_host = HeatDiffusion(host).run(variant="shard")
+        ici = DiffusionConfig(global_shape=(32, 32), nt=20, warmup=0,
+                              dims=(2, 2), dtype="f64", wire_mode="bf16")
+        r_ici = HeatDiffusion(ici).run(variant="shard")
+        np.testing.assert_allclose(
+            np.asarray(r_host.T), np.asarray(r_ici.T),
+            rtol=1e-13, atol=1e-15,
+        )
+
+    def test_stateful_mode_refused_on_stateless_path(self):
+        cfg = DiffusionConfig(global_shape=(32, 32), nt=8, warmup=0,
+                              dims=(2, 2), dtype="f32", wire_mode="int8")
+        model = HeatDiffusion(cfg)
+        with pytest.raises(Exception, match="error-feedback state"):
+            model.run(variant="shard")
+
+
+# ---------------------------------------------------------------------------
+# Error feedback and delta encoding
+# ---------------------------------------------------------------------------
+
+
+class TestErrorFeedbackAndDelta:
+    def test_drift_bounded_over_500_steps(self):
+        # The long-horizon contract: quantization error is compensated,
+        # not accumulated — 500 steps stays within the per-mode bound.
+        for mode in STATEFUL:
+            res = wire.check_tolerance(mode, steps=500)
+            assert res.ok, (
+                f"{mode} drifted: {res.rel_err:.3e} > {res.bound:.0e}"
+            )
+
+    def test_feedback_compensates_vs_accumulates(self):
+        # The same int8 wire WITHOUT the residual drifts measurably
+        # worse — what "compensated, not accumulated" means.
+        grid = wire._OracleGrid(global_shape=(32, 32), dims=(2, 2),
+                                spacing=(10 / 32, 10 / 32))
+        dt = (10 / 32) ** 2 / (2 * 2 + 0.1)
+        coords = np.meshgrid(
+            *[(np.arange(32) + 0.5) * (10 / 32) - 5.0] * 2,
+            indexing="ij",
+        )
+        T0 = np.exp(-sum(c * c for c in coords))
+        Cp = np.ones((32, 32))
+        ref = HostStagedStepper(grid, 1.0, dt, use_native=False).run(
+            T0.copy(), Cp, 300
+        )
+
+        def drift(feedback):
+            s = HostStagedStepper(grid, 1.0, dt, use_native=False,
+                                  wire_mode="int8")
+            s._codec = wire.NumpyWireCodec("int8", feedback=feedback)
+            return _rel_err(s.run(T0.copy(), Cp, 300), ref)
+
+        with_fb, without_fb = drift(True), drift(False)
+        assert with_fb < without_fb, (with_fb, without_fb)
+
+    def test_delta_first_sweep_edge_matches_plain_int8(self):
+        # No previous slab (zero state): the delta IS the slab, so the
+        # first exchange decodes identically to plain int8.
+        grid = init_global_grid(8, 8, dims=(2, 2))
+        x = jax.device_put(
+            jnp.linspace(0.0, 1.0, 64, dtype=jnp.float32).reshape(8, 8),
+            grid.sharding,
+        )
+
+        def one(mode):
+            ws = wire.init_exchange_state(grid, 1, mode, jnp.float32)
+
+            def local(b, *wsl):
+                p, ws2 = exchange_halo(b, grid, wire_mode=mode,
+                                       wire_state=tuple(wsl))
+                return (p,) + ws2
+
+            outs = jax.jit(lambda v, w: shard_map(
+                local, mesh=grid.mesh,
+                in_specs=(grid.spec,) * (1 + len(w)),
+                out_specs=(grid.spec,) * (1 + len(w)),
+                check_vma=False,
+            )(v, *w))(x, ws)
+            return np.asarray(outs[0]), outs[1:]
+
+        p_int8, _ = one("int8")
+        p_delta, ws_delta = one("int8_delta")
+        np.testing.assert_array_equal(p_int8, p_delta)
+        # And the delta state evolved: the receiver reconstruction is no
+        # longer the zero first-sweep state everywhere.
+        assert any(float(jnp.abs(w).max()) > 0 for w in ws_delta)
+
+    def test_repeated_exchange_average_converges(self):
+        # Error feedback's guarantee is on the STREAM, not one pass:
+        # repeatedly exchanging the same field, the residual dithers the
+        # quantizer so the time-averaged decode lands far closer to the
+        # true slab than any single pass (the compensated-not-
+        # accumulated property, measured).
+        grid = init_global_grid(8, 8, dims=(2, 2))
+        x = jax.device_put(
+            jnp.linspace(0.0, 2.0, 64, dtype=jnp.float32).reshape(8, 8),
+            grid.sharding,
+        )
+        ref = np.asarray(jax.jit(lambda v: shard_map(
+            lambda b: exchange_halo(b, grid),
+            mesh=grid.mesh, in_specs=grid.spec, out_specs=grid.spec,
+        )(v))(x))
+
+        def local(b, *wsl):
+            p, ws2 = exchange_halo(b, grid, wire_mode="int8",
+                                   wire_state=tuple(wsl))
+            return (p,) + ws2
+
+        ws = wire.init_exchange_state(grid, 1, "int8", jnp.float32)
+        run = jax.jit(lambda v, w: shard_map(
+            local, mesh=grid.mesh,
+            in_specs=(grid.spec,) * (1 + len(w)),
+            out_specs=(grid.spec,) * (1 + len(w)),
+            check_vma=False,
+        )(v, *w))
+        decodes = []
+        for _ in range(8):
+            outs = run(x, ws)
+            decodes.append(np.asarray(outs[0], np.float64))
+            ws = tuple(outs[1:])
+        err_single = np.abs(decodes[0] - ref).max()
+        err_avg = np.abs(np.mean(decodes, axis=0) - ref).max()
+        assert err_avg < err_single
+
+    def test_exchange_requires_state_for_stateful_modes(self):
+        grid = init_global_grid(8, 8, dims=(2, 2))
+        with pytest.raises(ValueError, match="error-feedback state"):
+            jax.jit(lambda v: shard_map(
+                lambda b: exchange_halo(b, grid, wire_mode="int8"),
+                mesh=grid.mesh, in_specs=grid.spec, out_specs=grid.spec,
+                check_vma=False,
+            )(v))(jnp.zeros((8, 8), jnp.float32))
+
+    def test_numpy_codec_matches_jax_quantizer(self):
+        rng = np.random.default_rng(7)
+        slab = rng.normal(size=(4, 16)).astype(np.float32)
+        q, scale = wire._quantize_int8(jnp.asarray(slab))
+        jax_deq = np.asarray(wire._dequantize_int8(q, scale, jnp.float32))
+        codec = wire.NumpyWireCodec("int8")
+        np_deq = codec.apply(("k",), slab)
+        np.testing.assert_allclose(jax_deq, np_deq, rtol=1e-6, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# The wire-bytes ladder
+# ---------------------------------------------------------------------------
+
+
+class TestWireLadder:
+    def test_ladder_rows_prove_the_fractions(self):
+        from rocm_mpi_tpu.perf.traffic import audit_wire_modes
+
+        rows = {r.mode: r for r in audit_wire_modes(local=16, deep_k=4)}
+        assert set(rows) == set(wire.WIRE_MODES)
+        assert all(r.ok for r in rows.values()), {
+            m: (r.fraction, r.ladder) for m, r in rows.items()
+        }
+        # THE acceptance numbers: bf16 <= 0.55x the f32 wire ideal,
+        # int8 and int8+delta strictly less than bf16's fraction.
+        assert rows["f32"].fraction == pytest.approx(1.0)
+        assert rows["bf16"].fraction <= 0.55
+        assert rows["int8"].fraction < rows["bf16"].fraction
+        assert rows["int8_delta"].fraction < rows["bf16"].fraction
+
+    def test_doctored_fixture_fails(self):
+        from rocm_mpi_tpu.perf.traffic import audit_wire_modes
+
+        rows = audit_wire_modes(local=16, deep_k=4,
+                                include_wire_fixture=True)
+        fixture = [r for r in rows if r.fixture]
+        assert len(fixture) == 1
+        assert not fixture[0].ok
+        assert fixture[0].fraction > fixture[0].ladder
+
+    def test_cli_exits_1_on_wire_fixture(self):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)
+        proc = subprocess.run(
+            [sys.executable, "-m", "rocm_mpi_tpu.perf",
+             "--include-wire-fixture"],
+            capture_output=True, text=True, cwd=REPO, env=env,
+            timeout=600,
+        )
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "bf16(fixture)" in proc.stdout
+        assert "OVER LADDER" in proc.stdout
+        # The real modes still pass inside the same run.
+        for mode in wire.WIRE_MODES:
+            for line in proc.stdout.splitlines():
+                if line.startswith(mode + " "):
+                    assert line.rstrip().endswith("ok"), line
+
+    def test_budgets_wire_block_schema(self):
+        from rocm_mpi_tpu.telemetry import regress
+
+        doc = json.loads(
+            (REPO / "rocm_mpi_tpu/perf/budgets.json").read_text()
+        )
+        assert regress.check_schema(
+            [REPO / "rocm_mpi_tpu/perf/budgets.json"]
+        ) == []
+        assert set(doc["wire"]["ladder"]) == set(wire.WIRE_MODES)
+
+    def test_regress_wire_mode_registry_pinned(self):
+        # regress spells the registry locally (stdlib read side);
+        # drift against the real one fails here.
+        from rocm_mpi_tpu.telemetry import regress
+
+        assert tuple(regress._WIRE_MODES) == tuple(wire.WIRE_MODES)
+
+    def test_doctored_budgets_fail_schema(self, tmp_path):
+        from rocm_mpi_tpu.telemetry import regress
+
+        doc = json.loads(
+            (REPO / "rocm_mpi_tpu/perf/budgets.json").read_text()
+        )
+        doc["wire"]["ladder"]["fp4"] = 0.1
+        bad = tmp_path / "budgets.json"
+        bad.write_text(json.dumps(doc))
+        problems = regress.check_schema([bad])
+        assert problems and "unknown mode" in problems[0]
+
+
+# ---------------------------------------------------------------------------
+# The tuning axis (space / gate / resolve / search / validate CLI)
+# ---------------------------------------------------------------------------
+
+
+class TestTuningWireAxis:
+    def test_deep_space_enumerates_wire_modes(self):
+        from rocm_mpi_tpu.tuning import space
+
+        cands = space.enumerate_space("diffusion.deep", (32, 32), "f32")
+        modes = {c["wire_mode"] for c in cands}
+        assert modes == set(wire.WIRE_MODES)
+        # f32 first: the tie-break must prefer full precision.
+        assert cands[0]["wire_mode"] == "f32"
+
+    def test_gate_accepts_certified_modes(self):
+        from rocm_mpi_tpu.tuning import gate
+
+        for mode in wire.WIRE_MODES:
+            g = gate.validate_config(
+                "diffusion.deep", (32, 32), "f32",
+                {"k": 8, "wire_mode": mode},
+            )
+            assert g.ok, (mode, g.reason)
+
+    def test_gate_rejects_unknown_and_misfamilied(self):
+        from rocm_mpi_tpu.tuning import gate
+
+        g = gate.validate_config("diffusion.deep", (32, 32), "f32",
+                                 {"k": 8, "wire_mode": "fp4"})
+        assert not g.ok and "fp4" in g.reason
+        g = gate.validate_config("diffusion.vmem_loop", (32, 32), "f32",
+                                 {"chunk": 16, "wire_mode": "bf16"})
+        assert not g.ok and "not a knob" in g.reason
+        g = gate.validate_config("diffusion.scan", (32, 32), "f32",
+                                 {"chunk": 16, "wire_mode": "int8"})
+        assert not g.ok and "stateless" in g.reason
+
+    def test_gate_rejects_out_of_tolerance_winner(self, monkeypatch):
+        # THE teeth: a mode failing the f64-oracle contract is rejected
+        # no matter what it measured.
+        from rocm_mpi_tpu.tuning import gate
+
+        monkeypatch.setitem(wire.TOLERANCE, "int8", 1e-12)
+        g = gate.validate_config("diffusion.deep", (32, 32), "f32",
+                                 {"k": 8, "wire_mode": "int8"})
+        assert not g.ok and "tolerance contract" in g.reason
+
+    def test_gate_rejects_over_ladder_winner(self, monkeypatch):
+        from rocm_mpi_tpu.tuning import gate
+
+        monkeypatch.setitem(gate._WIRE_LADDER_CACHE, "ladder",
+                            {"bf16": 0.1})
+        g = gate.validate_config("diffusion.deep", (32, 32), "f32",
+                                 {"k": 8, "wire_mode": "bf16"})
+        assert not g.ok and "wire-bytes ladder" in g.reason
+
+    def test_search_refuses_to_measure_uncertified_candidate(
+        self, monkeypatch, tmp_path
+    ):
+        from rocm_mpi_tpu.tuning import search
+
+        monkeypatch.setitem(wire.TOLERANCE, "int8", 1e-12)
+        out = search.search_op(
+            "diffusion.deep", (16, 16), "f32",
+            cache_path=tmp_path / "cache.json",
+            candidates=[{"k": 4, "wire_mode": "int8"}],
+        )
+        assert out["status"] == "all-rejected"
+        assert "tolerance contract" in out["rejected"][0][1]
+
+    def test_validate_cli_rejects_doctored_wire_winner(
+        self, monkeypatch, tmp_path
+    ):
+        from rocm_mpi_tpu.tuning import cache as tcache
+        from rocm_mpi_tpu.tuning import keys as tkeys
+        from rocm_mpi_tpu.tuning.__main__ import main as tuning_main
+
+        key = tkeys.tuning_key("diffusion.deep", (16, 16), "f32",
+                               topology=(2, 2))
+        path = tmp_path / "cache.json"
+        tcache.store(path, key, {
+            "config": {"k": 4, "wire_mode": "int8"},
+            "median_us": 1.0, "compile_s": 0.0, "gate_ratio": 1.0,
+            "fingerprint": tkeys.fingerprint(key.backend),
+        })
+        assert tuning_main(["validate", str(path)]) == 0
+        monkeypatch.setitem(wire.TOLERANCE, "int8", 1e-12)
+        assert tuning_main(["validate", str(path)]) == 1
+
+    def test_resolve_sanitizes_wire_field(self):
+        from rocm_mpi_tpu.tuning import resolve
+
+        assert resolve._sanitize(
+            {"k": 8, "wire_mode": "bf16"}
+        ) == {"k": 8, "wire_mode": "bf16"}
+        assert resolve._sanitize({"k": 8, "wire_mode": "fp4"}) == {"k": 8}
+
+    def test_auto_resolves_tuned_wire_mode(self, tmp_path):
+        from rocm_mpi_tpu.tuning import cache as tcache
+        from rocm_mpi_tpu.tuning import keys as tkeys
+        from rocm_mpi_tpu.tuning import resolve
+
+        cfg = DiffusionConfig(global_shape=(32, 32), nt=16, warmup=0,
+                              dims=(2, 2), dtype="f32")
+        model = HeatDiffusion(cfg)
+        key = tkeys.tuning_key("diffusion.deep",
+                               model.grid.local_shape, "f32",
+                               topology=model.grid.dims)
+        path = tmp_path / "cache.json"
+        tcache.store(path, key, {
+            "config": {"k": 4, "wire_mode": "bf16"},
+            "median_us": 1.0, "compile_s": 0.0, "gate_ratio": 1.0,
+            "fingerprint": tkeys.fingerprint(key.backend),
+        })
+        resolve.configure(path)
+        try:
+            # tuned wins under config="auto"; an explicit wire_mode
+            # wins over tuned; no config means the cfg field.
+            assert model.effective_wire_mode(None, "auto") == "bf16"
+            assert model.effective_wire_mode("int8", "auto") == "int8"
+            assert model.effective_wire_mode(None, None) == "f32"
+        finally:
+            resolve.configure(None)
+
+
+# ---------------------------------------------------------------------------
+# Telemetry surfacing (summary badge, gauge fold, monitor)
+# ---------------------------------------------------------------------------
+
+
+class TestTelemetrySurfacing:
+    def test_summary_wire_modes_and_gauge_fold(self):
+        from rocm_mpi_tpu.telemetry import aggregate
+
+        streams = {0: [
+            {"kind": "trace", "name": "halo.exchange",
+             "attrs": {"wire": "bf16", "bytes": 520}},
+            {"kind": "gauge", "name": "run.gpts", "value": 2.0,
+             "attrs": {"devices": 4, "driver": "scan", "wire": "bf16"}},
+            {"kind": "gauge", "name": "run.t_eff_gbs", "value": 9.0,
+             "attrs": {"wire": "f32"}},
+        ]}
+        summary = aggregate.summarize(streams)
+        assert summary["wire_modes"] == ["bf16"]
+        assert "run.gpts@4dev:scan:bf16" in summary["gauges"]
+        # f32 keeps the classic key — committed baselines stay live.
+        assert "run.t_eff_gbs" in summary["gauges"]
+        assert "WIRE MODE: bf16" in aggregate.format_summary(summary)
+
+    def test_f32_summary_has_no_badge(self):
+        from rocm_mpi_tpu.telemetry import aggregate
+
+        streams = {0: [
+            {"kind": "trace", "name": "halo.exchange",
+             "attrs": {"wire": "f32", "bytes": 1040}},
+        ]}
+        summary = aggregate.summarize(streams)
+        assert summary["wire_modes"] == ["f32"]
+        assert "WIRE MODE" not in aggregate.format_summary(summary)
+
+    def test_monitor_wire_status(self, tmp_path):
+        from rocm_mpi_tpu.telemetry import health
+
+        (tmp_path / "telemetry-rank0.jsonl").write_text(
+            json.dumps({"kind": "trace", "name": "deep.sweep", "v": 2,
+                        "attrs": {"wire": "int8_delta", "k": 8}}) + "\n"
+        )
+        modes = health.wire_status(tmp_path)
+        assert modes == ["int8_delta"]
+        assert health.format_wire_status(modes) == "[WIRE int8_delta]"
+        assert health.format_wire_status(["f32"]) is None
+        assert health.format_wire_status([]) is None
+
+
+# ---------------------------------------------------------------------------
+# Schedule plumbing (rebuild keeps the mode; state shapes shard cleanly)
+# ---------------------------------------------------------------------------
+
+
+class TestSchedulePlumbing:
+    def test_deep_schedule_rebuild_keeps_wire_mode(self):
+        from rocm_mpi_tpu.parallel.deep_halo import make_deep_sweep
+
+        grid = init_global_grid(32, 32, dims=(2, 2))
+        sched = make_deep_sweep(grid, 4, 1.0, jnp.float32(0.01),
+                                (0.3, 0.3), wire_mode="int8_delta")
+        assert sched.wire_mode == "int8_delta"
+        assert sched.init_wire is not None
+        rebuilt = sched.rebuild(grid)
+        assert rebuilt.wire_mode == "int8_delta"
+        assert rebuilt.init_wire is not None
+
+    def test_init_exchange_state_shapes(self):
+        grid = init_global_grid(8, 8, dims=(2, 2))
+        ws = wire.init_exchange_state(grid, 1, "int8", jnp.float32)
+        # 2 axes x 2 sides x arity 1; global shapes scale by dims.
+        assert [w.shape for w in ws] == [
+            (2, 8), (2, 8), (12, 2), (12, 2)
+        ]
+        wd = wire.init_exchange_state(grid, 1, "int8_delta", jnp.float32)
+        assert len(wd) == 12  # arity 3
+        assert wire.init_exchange_state(grid, 1, "f32", jnp.float32) == ()
