@@ -1,0 +1,234 @@
+"""Multi-tenant batched simulation service — the serving-layer driver
+(docs/SERVING.md; ROADMAP item 1).
+
+One-shot trace mode (default): load a request trace (--trace FILE.jsonl,
+rmt-serve-request records) or generate a deterministic synthetic mix
+(--synthetic N --seed S), serve it through serving.SimulationService,
+print the bin report, and bank the sidecars under --out:
+
+    serve-requests.jsonl   the served trace (schema-checked by lint.sh)
+    serve-manifest.json    bins/programs/occupancy/waste accounting
+
+Daemon mode (--serve): drain the queue until idle for --idle-exit-s
+(a SIGTERM preemption notice requeues pending work and exits rc 75 —
+the scheduler's requeue signal, resilience/preempt.py).
+
+Exit codes: 0 served clean; 1 any request failed; 75 preempted
+(EX_TEMPFAIL, pending work requeued in the manifest); 2 usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import random
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from apps._common import (  # noqa: E402
+    add_health_flag,
+    add_telemetry_flag,
+    positive_int,
+    setup_health,
+    setup_telemetry,
+)
+
+SYNTH_SHAPES = ((16, 16), (24, 24), (32, 32))
+SYNTH_WORKLOADS = ("diffusion", "wave", "swe")
+
+
+def synthetic_trace(n: int, seed: int, nt_max: int = 64,
+                    dtype: str = "f32", sessions: bool = False):
+    """Deterministic heterogeneous request mix: >=3 shape classes,
+    mixed workloads/physics/step counts — the acceptance-trace shape
+    (ISSUE: 50 requests through apps/serve.py compile exactly
+    len(bins) programs)."""
+    from rocm_mpi_tpu.serving.queue import Request
+
+    rng = random.Random(seed)
+    reqs = []
+    for i in range(n):
+        wl = SYNTH_WORKLOADS[i % len(SYNTH_WORKLOADS)]
+        shape = SYNTH_SHAPES[rng.randrange(len(SYNTH_SHAPES))]
+        nt = rng.randrange(max(nt_max // 2, 1), nt_max + 1)
+        physics = ()
+        if wl == "diffusion" and rng.random() < 0.3:
+            physics = (("lam", rng.choice([0.5, 1.0])),)
+        reqs.append(Request(
+            request_id=f"synth-{seed}-{i:04d}",
+            workload=wl,
+            global_shape=shape,
+            dtype=dtype,
+            nt=nt,
+            physics=physics,
+            ic_scale=1.0 + 0.01 * (i % 17),
+            session=f"sess-{i:04d}" if sessions else None,
+        ))
+    return reqs
+
+
+def make_parser():
+    p = argparse.ArgumentParser(
+        description="multi-tenant batched simulation service "
+        "(docs/SERVING.md)"
+    )
+    src = p.add_mutually_exclusive_group()
+    src.add_argument("--trace", default=None, metavar="FILE.jsonl",
+                     help="serve this request trace "
+                     "(rmt-serve-request records, one per line)")
+    src.add_argument("--synthetic", type=positive_int, default=None,
+                     metavar="N", help="serve N deterministic synthetic "
+                     "requests (default 12)")
+    p.add_argument("--seed", type=int, default=1,
+                   help="synthetic-trace seed (determinism contract)")
+    p.add_argument("--nt-max", type=positive_int, default=64,
+                   help="synthetic per-request step-count cap")
+    p.add_argument("--dtype", default="f32", choices=["f32", "f64", "bf16"],
+                   help="synthetic-trace dtype")
+    p.add_argument("--max-width", type=positive_int, default=8,
+                   help="widest batch lane count (pow2-capped)")
+    p.add_argument("--occupancy-floor", type=float, default=None,
+                   help="min live/width per batch (default: "
+                   "perf/budgets.json 'serving' row)")
+    p.add_argument("--batch-dims", type=positive_int, default=1,
+                   help="device rows along the batch mesh axis")
+    p.add_argument("--cpu-devices", type=int, default=0, metavar="N",
+                   help="simulate N virtual CPU devices")
+    p.add_argument("--sessions", default=None, metavar="DIR",
+                   help="checkpoint-multiplex root: requests with a "
+                   "session id save their final state under DIR/<id>/")
+    p.add_argument("--synthetic-sessions", action="store_true",
+                   help="give every synthetic request a session id "
+                   "(needs --sessions)")
+    p.add_argument("--out", default=None, metavar="DIR",
+                   help="bank serve-requests.jsonl + serve-manifest.json "
+                   "under DIR")
+    p.add_argument("--elastic", action="store_true",
+                   help="consume the ElasticPolicy: grow batch rows when "
+                   "the queue is deep, shrink when idle")
+    p.add_argument("--grow-depth", type=positive_int, default=8,
+                   help="queue depth that makes the policy consider a "
+                   "grow (--elastic)")
+    p.add_argument("--serve", action="store_true",
+                   help="daemon mode: keep draining until idle for "
+                   "--idle-exit-s")
+    p.add_argument("--idle-exit-s", type=float, default=2.0,
+                   help="daemon idle exit (seconds; --serve)")
+    add_telemetry_flag(p)
+    add_health_flag(p)
+    return p
+
+
+def main(argv=None) -> int:
+    args = make_parser().parse_args(argv)
+
+    import jax
+
+    from rocm_mpi_tpu.parallel.distributed import maybe_initialize_distributed
+
+    maybe_initialize_distributed()
+    if args.cpu_devices:
+        from rocm_mpi_tpu.utils.backend import set_cpu_device_count
+
+        jax.config.update("jax_platforms", "cpu")
+        set_cpu_device_count(args.cpu_devices)
+    setup_telemetry(args, jax)
+    setup_health(args, jax)
+    # Compile accounting is the steady-state contract's instrument —
+    # install it even without telemetry so the report's
+    # compiles.steady_state is real, not a fabricated zero.
+    from rocm_mpi_tpu.telemetry import compiles
+
+    compiles.install()
+    # Preemption awareness: SIGTERM → grace-deadline notice → the drain
+    # loop requeues pending work and exits 75 (resilience/preempt.py).
+    from rocm_mpi_tpu.resilience import preempt
+
+    preempt.install_from_env()
+
+    from rocm_mpi_tpu.serving.queue import load_trace, request_to_record
+    from rocm_mpi_tpu.serving.service import ServeConfig, SimulationService
+    from rocm_mpi_tpu.utils.logging import log0
+
+    if args.trace:
+        requests = load_trace(args.trace)
+    else:
+        n = args.synthetic or 12
+        if args.synthetic_sessions and not args.sessions:
+            print("--synthetic-sessions needs --sessions DIR",
+                  file=sys.stderr)
+            return 2
+        requests = synthetic_trace(
+            n, args.seed, nt_max=args.nt_max, dtype=args.dtype,
+            sessions=args.synthetic_sessions,
+        )
+    if any(r.dtype == "f64" for r in requests):
+        # x64 follows the TRACE, not just the synthetic --dtype knob: a
+        # recorded f64 request served at canonicalized f32 would
+        # silently break the bitwise-equal-to-standalone contract while
+        # the bin key still claims f64.
+        jax.config.update("jax_enable_x64", True)
+
+    policy = None
+    if args.elastic:
+        from rocm_mpi_tpu.resilience.policy import ElasticPolicy
+
+        policy = ElasticPolicy()
+
+    svc = SimulationService(config=ServeConfig(
+        max_width=args.max_width,
+        occupancy_floor=args.occupancy_floor,
+        batch_dims=args.batch_dims,
+        sessions_dir=args.sessions,
+        policy=policy,
+        grow_queue_depth=args.grow_depth,
+    ))
+
+    log0(f"serving {len(requests)} request(s) "
+         f"(max_width={args.max_width}, batch_dims={args.batch_dims}, "
+         f"devices={len(jax.devices())})")
+    if args.serve:
+        for r in requests:
+            svc.queue.submit(r)
+        report = svc.serve_forever(idle_exit_s=args.idle_exit_s)
+    else:
+        report = svc.run_trace(requests)
+
+    log0(
+        f"served {report.served}/{len(requests)} "
+        f"({report.failed} failed, {report.requeued} requeued) — "
+        f"{report.n_bins} bin(s), {report.n_programs} program(s), "
+        f"compiles.steady_state={report.compiles.get('steady_state')}"
+    )
+    for key, st in sorted(report.bins.items()):
+        log0(
+            f"  bin {key.key_str():48s} req={st.requests:3d} "
+            f"batches={st.batches} widths={list(st.widths)} "
+            f"occ={st.occupancy:.2f} waste={st.padding_waste:.2f}"
+            + (f" splits={st.splits}" if st.splits else "")
+        )
+    for ev in report.elastic:
+        log0(f"  elastic: {ev}")
+
+    if args.out and jax.process_index() == 0:
+        out = pathlib.Path(args.out)
+        out.mkdir(parents=True, exist_ok=True)
+        trace_path = out / "serve-requests.jsonl"
+        import json
+
+        with open(trace_path, "w", encoding="utf-8") as fh:
+            for r in requests:
+                fh.write(json.dumps(request_to_record(r)) + "\n")
+        doc = svc.write_manifest(out / "serve-manifest.json")
+        log0(f"banked {trace_path} and serve-manifest.json "
+             f"({len(doc['bins'])} bin row(s))")
+
+    if report.preempted:
+        log0("preempted: pending work requeued; rc 75 (EX_TEMPFAIL)")
+        return 75
+    return 1 if report.failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

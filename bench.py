@@ -553,6 +553,53 @@ def run_suite() -> None:
             file=sys.stderr,
         )
 
+    # The multi-tenant batching rung (ROADMAP item 1, docs/SERVING.md):
+    # B=1 vs B=4 lanes of the flagship shape through the SAME batched
+    # "shard" program class the serving layer compiles — the aggregate
+    # Gpts/s pair IS the batching win (one program, B lanes of work).
+    # RunResult's shape-prod accounting makes the B-lane rate aggregate
+    # automatically; the per-lane jnp explicit-exchange path is the
+    # serving layer's own rung, so the ratio is honest.
+    import jax as _jax2
+    import numpy as _np
+
+    from rocm_mpi_tpu.models.diffusion import RunResult as _RunResult
+
+    for B in (1, 4):
+        bcfg = DiffusionConfig(
+            global_shape=BENCH_SHAPE, lengths=(10.0, 10.0),
+            nt=22_000, warmup=2_000, dtype="f32", dims=(1, 1),
+        )
+        bmodel = HeatDiffusion(bcfg)
+        advance, bg = bmodel.batched_advance_fn(batch=B)
+        T0, Cp = bmodel.init_state()
+        T0n = _np.asarray(T0)
+        Tb = _jax2.device_put(
+            _np.stack([T0n * (1.0 + 0.01 * i) for i in range(B)]),
+            bg.sharding,
+        )
+        Cpb = _jax2.device_put(_np.asarray(Cp), bg.aux_sharding)
+        steps_full = _jax2.device_put(
+            _np.full(B, bcfg.nt, _np.int32), bg.batch_sharding
+        )
+
+        from rocm_mpi_tpu.utils import metrics as _metrics
+
+        timer = _metrics.Timer(
+            label="step_window", phase="step",
+            steps=bcfg.nt - bcfg.warmup, variant=f"batched{B}",
+            workload="diffusion",
+        )
+        Tb = advance(Tb, Cpb, steps_full, bcfg.warmup)
+        timer.tic(Tb)
+        Tb = advance(Tb, Cpb, steps_full, bcfg.nt - bcfg.warmup)
+        wtime = timer.toc(Tb)
+        report(
+            f"252² batched B={B} lanes (shard)",
+            _RunResult(T=Tb, wtime=wtime, nt=bcfg.nt,
+                       warmup=bcfg.warmup, config=bcfg),
+        )
+
     # Bank the autotuner's resolve outcomes (tune.hits / tune.misses run
     # gauges + the per-key tune.resolve annotations) before the record:
     # a suite steered by a warm cache and one running hand defaults are

@@ -14,6 +14,15 @@ the module's literal axis vocabulary — names in `Mesh(...)` /
 `AXIS_NAMES`-style module constants. Variables (the common in-tree case:
 `grid.axis_names[ax]`) are skipped — the rule only judges what it can see.
 Modules with no axis literals at all are skipped entirely.
+
+The `batch` axis vocabulary (PR 13, docs/SERVING.md): on a space×batch
+mesh the leading `batch` axis carries INDEPENDENT simulation lanes —
+separate tenants. A permutation-family collective (`ppermute`,
+`pshuffle`, `all_to_all`) over the literal `batch` axis moves one
+tenant's state into another's lane — a cross-tenant leak no 1-device
+CPU test ever executes — so it is a finding even though `batch` is in
+the mesh vocabulary. Reductions (`psum`/`pmean`/…) over `batch` stay
+clean: cross-lane diagnostics are legitimate.
 """
 
 from __future__ import annotations
@@ -27,6 +36,12 @@ _COLLECTIVES = {
     "psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle", "all_gather",
     "all_to_all", "psum_scatter", "axis_index", "axis_size",
 }
+
+# The permutation family: collectives that MOVE state between mesh
+# positions (vs reducing over them). Over the multi-tenant lane axis
+# that is a cross-tenant leak (parallel.mesh.BATCH_AXIS contract).
+_PERMUTING = {"ppermute", "pshuffle", "all_to_all"}
+_BATCH_AXIS = "batch"  # literal twin of parallel.mesh.BATCH_AXIS
 
 
 def _module_axis_vocabulary(tree: ast.Module) -> set[str]:
@@ -91,5 +106,18 @@ class AxisConsistencyRule(Rule):
                             "use an axis name from the mesh (or thread "
                             "grid.axis_names through instead of a "
                             "literal)",
+                        ))
+                    elif axis == _BATCH_AXIS and callee in _PERMUTING:
+                        findings.append(ctx.finding(
+                            node, self,
+                            f"halo/permutation collective '{callee}' over "
+                            f"the '{_BATCH_AXIS}' lane axis inside "
+                            f"shard_map body '{traced.fn.name}' — lanes "
+                            "are independent tenants (docs/SERVING.md); "
+                            "permuting state across the batch axis leaks "
+                            "one simulation into another",
+                            "halo collectives belong on the space axes "
+                            "only (reductions like psum over 'batch' — "
+                            "cross-lane diagnostics — are fine)",
                         ))
         return findings

@@ -540,6 +540,174 @@ class HeatDiffusion:
 
         return advance, q
 
+    # ---- multi-tenant batching (docs/SERVING.md) ------------------------
+
+    def make_batched_grid(self, batch: int, batch_dims: int = 1,
+                          devices=None):
+        """The space×batch mesh for `batch` lanes of THIS model's space
+        problem (mesh.init_batched_grid), space decomposition pinned to
+        the model's own grid dims so a lane's spatial shards match its
+        standalone twin's."""
+        from rocm_mpi_tpu.parallel.mesh import init_batched_grid
+
+        cfg = self.config
+        return init_batched_grid(
+            batch,
+            *cfg.global_shape,
+            lengths=cfg.lengths,
+            space_dims=self.grid.dims,
+            batch_dims=batch_dims,
+            devices=devices,
+        )
+
+    def _make_batched_step(self, bgrid, variant: str):
+        """`step(Tb, C) -> Tb` over `(batch, *space)` lane-batched state
+        (C is the UNBATCHED space-shaped coefficient every lane shares —
+        physics is a bin-key field, docs/SERVING.md). "shard" runs the
+        explicit exchange machinery — shard_map over the space×batch
+        mesh, the per-lane local step vmapped over the leading lane axis,
+        halo collectives per-space-axis only; "ap"/"fused" vmap the
+        global-array step and let GSPMD partition the batched array.
+        Every form is bitwise-equal per lane to the unbatched variant
+        (the serving layer's parity contract)."""
+        from rocm_mpi_tpu.ops.diffusion import step_fused_padded
+        from rocm_mpi_tpu.parallel.halo import exchange_halo_batched
+
+        cfg = self.config
+        space = bgrid.space
+        dt = cfg.jax_dtype(cfg.dt)
+
+        if variant in ("ap", "fused"):
+            raw = (step_flux_form if variant == "ap" else step_fused)
+
+            def step(Tb, C):
+                return jax.vmap(
+                    lambda T: raw(T, C, cfg.lam, dt, cfg.spacing)
+                )(Tb)
+
+            return step
+
+        if variant != "shard":
+            raise ValueError(
+                f"batched advance supports variants 'shard', 'ap', "
+                f"'fused'; got {variant!r} (the Pallas/overlap rungs "
+                "are single-lane)"
+            )
+
+        wire_mode = cfg.wire_mode
+
+        def lane_local(Tb_l, Cl):
+            # Tb_l: (local_batch, *local_space); Cl: local space block.
+            Tp = exchange_halo_batched(Tb_l, bgrid, wire_mode=wire_mode)
+            mask = global_boundary_mask(space)
+
+            def lane(Tl, Tpl):
+                new = step_fused_padded(Tpl, Cl, cfg.lam, dt, cfg.spacing)
+                return jnp.where(mask, Tl, new)
+
+            return jax.vmap(lane)(Tb_l, Tp)
+
+        def step(Tb, C):
+            return shard_map(
+                lane_local,
+                mesh=bgrid.mesh,
+                in_specs=(bgrid.spec, bgrid.aux_spec),
+                out_specs=bgrid.spec,
+                check_vma=False,
+            )(Tb, C)
+
+        return step
+
+    def batched_step_fn(self, bgrid, variant: str = "shard",
+                        donate: bool = False):
+        """jitted steady-state `step(Tb, C) -> Tb` — one batched step as
+        its own program (what the perf traffic gate audits: per-lane
+        compiled bytes of the B-lane program vs B× the single-lane
+        ideal, rocm_mpi_tpu/perf/traffic.py)."""
+        step = self._make_batched_step(bgrid, variant)
+        return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+    def batched_advance_fn(
+        self,
+        batch: int | None = None,
+        variant: str = "shard",
+        bgrid=None,
+        batch_dims: int = 1,
+        devices=None,
+    ):
+        """(jitted `advance(Tb, Cp, lane_steps, n) -> Tb`, bgrid) — the
+        multi-tenant batched advance (docs/SERVING.md): `Tb` is
+        `(batch, *space)` lane-batched state sharded `bgrid.spec`; `Cp`
+        the single space-shaped coefficient all lanes share;
+        `lane_steps` a `(batch,)` int32 of per-lane step counts (the bin
+        scheduler's steps padding: the batch runs `n` = max steps, a
+        lane freezes bitwise once its own count is reached — the
+        pass-through select is exact, so every lane is bitwise-equal to
+        a standalone run of its own length); `n` the dynamic trip count.
+        Donates Tb (rebind from the result). One compiled program serves
+        any lane_steps/n mix — the bin scheduler's compile-amortization
+        contract (`compiles.steady_state == 0`)."""
+        if bgrid is None:
+            if batch is None:
+                raise ValueError("pass batch= or a prebuilt bgrid=")
+            bgrid = self.make_batched_grid(batch, batch_dims, devices)
+        step = self._make_batched_step(bgrid, variant)
+        shape1 = (-1,) + (1,) * bgrid.space.ndim
+
+        @functools.partial(jax.jit, donate_argnums=0)
+        def advance(Tb, Cp, lane_steps, n):
+            def body(i, T):
+                new = step(T, Cp)
+                active = (i < lane_steps).reshape(shape1)
+                return jnp.where(active, new, T)
+
+            return lax.fori_loop(0, n, body, Tb)
+
+        return advance, bgrid
+
+    def batched_deep_advance_fn(
+        self,
+        batch: int | None = None,
+        block_steps: int | None = None,
+        bgrid=None,
+        batch_dims: int = 1,
+        devices=None,
+        wire_mode: str | None = None,
+    ):
+        """(jitted `advance(Tb, Cp, n) -> Tb`, bgrid, k) — the deep-halo
+        schedule against the space×batch mesh (make_deep_sweep with a
+        BatchedGrid): one width-k exchange of the whole lane batch per k
+        steps, the vmapped jnp local sweep. Uniform steps only (`n` a
+        multiple of k for every lane — the bin scheduler routes
+        heterogeneous-step bins to the per-step batched advance)."""
+        from rocm_mpi_tpu.parallel.deep_halo import make_deep_sweep
+
+        cfg = self.config
+        if bgrid is None:
+            if batch is None:
+                raise ValueError("pass batch= or a prebuilt bgrid=")
+            bgrid = self.make_batched_grid(batch, batch_dims, devices)
+        k = block_steps
+        if k is None:
+            from rocm_mpi_tpu.ops.pallas_kernels import _compute_itemsize
+
+            k = default_deep_depth(
+                bgrid.space.local_shape, _compute_itemsize(cfg.jax_dtype)
+            )
+        wm = cfg.wire_mode if wire_mode is None else wire_mode
+        dt = cfg.jax_dtype(cfg.dt)
+        sched = make_deep_sweep(bgrid, k, cfg.lam, dt, cfg.spacing,
+                                wire_mode=wm)
+
+        @functools.partial(jax.jit, donate_argnums=0)
+        def advance(Tb, Cp, n):
+            Cm = sched.prepare(Cp)
+            return lax.fori_loop(
+                0, n // k, lambda _, x: sched.sweep(x, Cm), Tb
+            )
+
+        return advance, bgrid, sched.k
+
     # ---- driver ---------------------------------------------------------
 
     def run(

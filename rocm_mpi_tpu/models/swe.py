@@ -210,6 +210,35 @@ class ShallowWater:
                 return masked_swe_step(h, us, Mus, cH, cg)
 
             return step
+        if variant == "shard":
+            # The explicit-decomposition jnp rung (the diffusion/wave
+            # "shard" vocabulary): one exchange of the full state + the
+            # pure-jnp padded forward-backward update, walls as mask
+            # data. Pallas-free — the per-lane body the batched
+            # multi-tenant advance vmaps (docs/SERVING.md).
+            from rocm_mpi_tpu.ops.swe_kernels import swe_step_padded
+
+            def step(h, us):
+                def local(hl, *rest):
+                    uls, Ml = rest[: cfg.ndim], rest[cfg.ndim:]
+                    Sp = tuple(
+                        exchange_halo(f, grid, wire_mode=cfg.wire_mode)
+                        for f in (hl,) + tuple(uls)
+                    )
+                    return swe_step_padded(
+                        Sp, Ml, (cfg.H0, cfg.g), dt, cfg.spacing
+                    )
+
+                outs = shard_map(
+                    local,
+                    mesh=grid.mesh,
+                    in_specs=(grid.spec,) * (2 * cfg.ndim + 1),
+                    out_specs=(grid.spec,) * (cfg.ndim + 1),
+                    check_vma=False,
+                )(h, *us, *Mus)
+                return outs[0], tuple(outs[1:])
+
+            return step
         if variant == "perf":
             from rocm_mpi_tpu.ops.swe_kernels import swe_step_padded_pallas
 
@@ -276,8 +305,122 @@ class ShallowWater:
 
             return step
         raise ValueError(
-            f"unknown SWE variant {variant!r} (ap, perf, hide)"
+            f"unknown SWE variant {variant!r} (ap, shard, perf, hide)"
         )
+
+    # ---- multi-tenant batching (docs/SERVING.md) ------------------------
+
+    def make_batched_grid(self, batch: int, batch_dims: int = 1,
+                          devices=None):
+        """Space×batch mesh for `batch` lanes of this model's space
+        problem (see HeatDiffusion.make_batched_grid)."""
+        from rocm_mpi_tpu.parallel.mesh import init_batched_grid
+
+        cfg = self.config
+        return init_batched_grid(
+            batch,
+            *cfg.global_shape,
+            lengths=cfg.lengths,
+            space_dims=self.grid.dims,
+            batch_dims=batch_dims,
+            devices=devices,
+        )
+
+    def _make_batched_step(self, bgrid, variant: str):
+        """`step(hb, usb, Mus) -> (hb', usb')` over lane-batched SWE
+        state; the face masks `Mus` are UNBATCHED (wall geometry is
+        config-derived, shared by every lane). Same vocabulary as
+        HeatDiffusion._make_batched_step."""
+        from rocm_mpi_tpu.parallel.halo import exchange_halo_batched
+
+        cfg = self.config
+        ndim = cfg.ndim
+        dt = cfg.dt
+        cH, cg = swe_coeffs(dt, cfg.spacing, cfg.H0, cfg.g)
+
+        if variant == "ap":
+
+            def step(hb, usb, Mus):
+                return jax.vmap(
+                    lambda h, us: masked_swe_step(h, us, Mus, cH, cg),
+                    in_axes=(0, 0),
+                )(hb, usb)
+
+            return step
+
+        if variant != "shard":
+            raise ValueError(
+                f"batched SWE advance supports variants 'shard', 'ap'; "
+                f"got {variant!r} (the Pallas/overlap rungs are "
+                "single-lane)"
+            )
+
+        from rocm_mpi_tpu.ops.swe_kernels import swe_step_padded
+
+        def lane_local(hb_l, *rest):
+            ub_ls, Ml = rest[:ndim], rest[ndim:]
+            padded = tuple(
+                exchange_halo_batched(f, bgrid, wire_mode=cfg.wire_mode)
+                for f in (hb_l,) + tuple(ub_ls)
+            )
+
+            def lane(*Sp):
+                return swe_step_padded(
+                    Sp, Ml, (cfg.H0, cfg.g), dt, cfg.spacing
+                )
+
+            return jax.vmap(lane)(*padded)
+
+        def step(hb, usb, Mus):
+            outs = shard_map(
+                lane_local,
+                mesh=bgrid.mesh,
+                in_specs=(bgrid.spec,) * (ndim + 1)
+                + (bgrid.aux_spec,) * ndim,
+                out_specs=(bgrid.spec,) * (ndim + 1),
+                check_vma=False,
+            )(hb, *usb, *Mus)
+            return outs[0], tuple(outs[1:])
+
+        return step
+
+    def batched_advance_fn(
+        self,
+        batch: int | None = None,
+        variant: str = "shard",
+        bgrid=None,
+        batch_dims: int = 1,
+        devices=None,
+    ):
+        """(jitted `advance(hb, usb, Mus, lane_steps, n) -> (hb, usb)`,
+        bgrid) — the SWE edition of the multi-tenant batched advance
+        (HeatDiffusion.batched_advance_fn has the lane_steps/bitwise
+        contract; every state field freezes together when a lane's count
+        is reached). Donates (hb, usb)."""
+        if bgrid is None:
+            if batch is None:
+                raise ValueError("pass batch= or a prebuilt bgrid=")
+            bgrid = self.make_batched_grid(batch, batch_dims, devices)
+        step = self._make_batched_step(bgrid, variant)
+        shape1 = (-1,) + (1,) * bgrid.space.ndim
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def advance(hb, usb, Mus, lane_steps, n):
+            def body(i, s):
+                h, us = s
+                nh, nus = step(h, us, Mus)
+                active = (i < lane_steps).reshape(shape1)
+                return (
+                    jnp.where(active, nh, h),
+                    tuple(
+                        jnp.where(active, nu, u)
+                        for nu, u in zip(nus, us)
+                    ),
+                )
+
+            return lax.fori_loop(0, n, body, (hb, usb))
+
+        return advance, bgrid
 
     def advance_fn(self, variant: str = "perf"):
         """jitted (h, us, Mus, n) -> (h, us) after n steps."""

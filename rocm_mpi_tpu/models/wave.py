@@ -209,6 +209,32 @@ class AcousticWave:
                 return wave_step_fused(U, Uprev, C2, dt, cfg.spacing), U
 
             return step, None
+        if variant == "shard":
+            # The explicit-decomposition jnp rung (the diffusion model's
+            # "shard" vocabulary): exchange_halo + the pure-jnp padded
+            # leapfrog update + Dirichlet mask. Pallas-free by
+            # construction — the f64-safe explicit path on TPU, and the
+            # per-lane body the batched multi-tenant advance vmaps
+            # (docs/SERVING.md: batched results must be bitwise-equal to
+            # a standalone run of the SAME op sequence).
+            def step(U, Uprev, C2, P):
+                del P
+
+                def local(Ul, Upl, C2l):
+                    pad = exchange_halo(Ul, grid, wire_mode=cfg.wire_mode)
+                    new = wave_step_padded(pad, Upl, C2l, dt, cfg.spacing)
+                    return jnp.where(global_boundary_mask(grid), Ul, new)
+
+                new = shard_map(
+                    local,
+                    mesh=grid.mesh,
+                    in_specs=(grid.spec,) * 3,
+                    out_specs=grid.spec,
+                    check_vma=False,
+                )(U, Uprev, C2)
+                return new, U
+
+            return step, None
         if variant == "perf":
             from rocm_mpi_tpu.ops.wave_kernels import wave_step_padded_pallas
 
@@ -280,8 +306,112 @@ class AcousticWave:
 
             return step, self._mask_prepare()
         raise ValueError(
-            f"unknown wave variant {variant!r} (ap, perf, hide)"
+            f"unknown wave variant {variant!r} (ap, shard, perf, hide)"
         )
+
+    # ---- multi-tenant batching (docs/SERVING.md) ------------------------
+
+    def make_batched_grid(self, batch: int, batch_dims: int = 1,
+                          devices=None):
+        """Space×batch mesh for `batch` lanes of this model's space
+        problem (see HeatDiffusion.make_batched_grid)."""
+        from rocm_mpi_tpu.parallel.mesh import init_batched_grid
+
+        cfg = self.config
+        return init_batched_grid(
+            batch,
+            *cfg.global_shape,
+            lengths=cfg.lengths,
+            space_dims=self.grid.dims,
+            batch_dims=batch_dims,
+            devices=devices,
+        )
+
+    def _make_batched_step(self, bgrid, variant: str):
+        """`step(Ub, Upb, C2) -> (Ub⁺, Ub)` over lane-batched leapfrog
+        state; `C2` is the UNBATCHED squared wave speed every lane
+        shares. Same vocabulary as HeatDiffusion._make_batched_step."""
+        from rocm_mpi_tpu.parallel.halo import exchange_halo_batched
+
+        cfg = self.config
+        space = bgrid.space
+        dt = cfg.jax_dtype(cfg.dt)
+
+        if variant == "ap":
+
+            def step(Ub, Upb, C2):
+                new = jax.vmap(
+                    lambda U, Up: wave_step_fused(U, Up, C2, dt,
+                                                  cfg.spacing)
+                )(Ub, Upb)
+                return new, Ub
+
+            return step
+
+        if variant != "shard":
+            raise ValueError(
+                f"batched wave advance supports variants 'shard', 'ap'; "
+                f"got {variant!r} (the Pallas/overlap rungs are "
+                "single-lane)"
+            )
+
+        def lane_local(Ub_l, Upb_l, C2l):
+            pad = exchange_halo_batched(Ub_l, bgrid,
+                                        wire_mode=cfg.wire_mode)
+            mask = global_boundary_mask(space)
+
+            def lane(Ul, Upl, padl):
+                new = wave_step_padded(padl, Upl, C2l, dt, cfg.spacing)
+                return jnp.where(mask, Ul, new)
+
+            return jax.vmap(lane)(Ub_l, Upb_l, pad)
+
+        def step(Ub, Upb, C2):
+            new = shard_map(
+                lane_local,
+                mesh=bgrid.mesh,
+                in_specs=(bgrid.spec, bgrid.spec, bgrid.aux_spec),
+                out_specs=bgrid.spec,
+                check_vma=False,
+            )(Ub, Upb, C2)
+            return new, Ub
+
+        return step
+
+    def batched_advance_fn(
+        self,
+        batch: int | None = None,
+        variant: str = "shard",
+        bgrid=None,
+        batch_dims: int = 1,
+        devices=None,
+    ):
+        """(jitted `advance(Ub, Upb, C2, lane_steps, n) -> (Ub, Upb)`,
+        bgrid) — the wave edition of the multi-tenant batched advance
+        (HeatDiffusion.batched_advance_fn has the lane_steps/bitwise
+        contract; both leapfrog carries freeze together when a lane's
+        count is reached). Donates (Ub, Upb)."""
+        if bgrid is None:
+            if batch is None:
+                raise ValueError("pass batch= or a prebuilt bgrid=")
+            bgrid = self.make_batched_grid(batch, batch_dims, devices)
+        step = self._make_batched_step(bgrid, variant)
+        shape1 = (-1,) + (1,) * bgrid.space.ndim
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def advance(Ub, Upb, C2, lane_steps, n):
+            def body(i, s):
+                U, Up = s
+                newU, newUp = step(U, Up, C2)
+                active = (i < lane_steps).reshape(shape1)
+                return (
+                    jnp.where(active, newU, U),
+                    jnp.where(active, newUp, Up),
+                )
+
+            return lax.fori_loop(0, n, body, (Ub, Upb))
+
+        return advance, bgrid
 
     def advance_fn(self, variant: str = "perf"):
         """jitted (U, Uprev, C2, n) -> (U after n steps, U after n-1)."""

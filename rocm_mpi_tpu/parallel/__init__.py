@@ -1,7 +1,9 @@
 """Parallelism layer: device-mesh global grid, halo exchange, gather, overlap."""
 
 from rocm_mpi_tpu.parallel.mesh import (  # noqa: F401
+    BatchedGrid,
     GlobalGrid,
+    init_batched_grid,
     init_global_grid,
     suggest_dims,
 )

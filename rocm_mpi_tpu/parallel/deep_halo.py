@@ -199,7 +199,7 @@ def rebuild_for_mesh(sched: DeepSchedule, new_grid: GlobalGrid,
     return sched.rebuild(new_grid)
 
 
-def make_deep_sweep(grid: GlobalGrid, k: int, lam, dt, spacing,
+def make_deep_sweep(grid, k: int, lam, dt, spacing,
                     local_form: str = "auto",
                     wire_mode: str = "f32") -> DeepSchedule:
     """Build the diffusion DeepSchedule: `prepare(Cp)` -> block-padded Cm
@@ -219,12 +219,35 @@ def make_deep_sweep(grid: GlobalGrid, k: int, lam, dt, spacing,
     crop. `local_form="jnp"` forces the any-shape XLA fallback — the form
     whose compiled byte counts the perf traffic gate audits on CPU
     (rocm_mpi_tpu/perf/traffic.py); "auto" is the production routing.
+
+    `grid` may be a `mesh.BatchedGrid` (space×batch, docs/SERVING.md):
+    the sweep then advances `(batch, *space)` lane-batched state —
+    `prepare` takes the UNBATCHED space-shaped Cp every lane shares
+    (physics is a bin-key field: one coefficient serves the whole
+    batch), the local k-step body is vmapped over the leading lane
+    axis, and the halo collectives stay per-space-axis. Batched sweeps
+    pin the jnp local form (Pallas-under-vmap routing is not in the
+    audited envelope) and the stateless wire modes (f32/bf16).
     """
-    _validate_depth(grid, k, "sweep depth")
+    from rocm_mpi_tpu.parallel.mesh import BatchedGrid
+
+    batched = isinstance(grid, BatchedGrid)
+    space = grid.space if batched else grid
+    _validate_depth(space, k, "sweep depth")
     wire.validate_mode(wire_mode)
     stateful_wire = wire.is_stateful(wire_mode)
     if local_form not in ("auto", "jnp"):
         raise ValueError(f"local_form must be 'auto' or 'jnp', got {local_form!r}")
+    if batched:
+        if stateful_wire:
+            raise ValueError(
+                f"wire_mode {wire_mode!r} is stateful; batched deep sweeps "
+                "support the stateless modes (f32/bf16) only"
+            )
+        # The vmapped local body stays on the any-shape XLA form: the
+        # Pallas kernels' batching path is untested/unaudited here, and
+        # a crashed batched sweep serves no tenant.
+        local_form = "jnp"
     from rocm_mpi_tpu.ops.pallas_kernels import (
         _TB_MAX_STEPS,
         _VMEM_BLOCK_BUDGET_BYTES,
@@ -235,8 +258,8 @@ def make_deep_sweep(grid: GlobalGrid, k: int, lam, dt, spacing,
         tb_slab_fits,
     )
 
-    core = tuple(slice(k, -k) for _ in range(grid.ndim))
-    inner = tuple(slice(1, -1) for _ in range(grid.ndim))
+    core = tuple(slice(k, -k) for _ in range(space.ndim))
+    inner = tuple(slice(1, -1) for _ in range(space.ndim))
     inv_d2 = tuple(1.0 / (float(d) * float(d)) for d in spacing)
 
     def jnp_k_steps(Tp, Cm):
@@ -269,8 +292,8 @@ def make_deep_sweep(grid: GlobalGrid, k: int, lam, dt, spacing,
         return Tp
 
     def local_prepare(Cpl):
-        Cpp = exchange_halo(Cpl, grid, width=k)
-        return padded_update_coefficient(Cpp, grid, k, lam, dt)
+        Cpp = exchange_halo(Cpl, space, width=k)
+        return padded_update_coefficient(Cpp, space, k, lam, dt)
 
     def tb_ok(Tp):
         n0p = Tp.shape[0]
@@ -284,10 +307,10 @@ def make_deep_sweep(grid: GlobalGrid, k: int, lam, dt, spacing,
 
     def local_sweep(Tl, Cm, *wsl):
         if stateful_wire:
-            Tp, ws2 = exchange_halo(Tl, grid, width=k, wire_mode=wire_mode,
+            Tp, ws2 = exchange_halo(Tl, space, width=k, wire_mode=wire_mode,
                                     wire_state=tuple(wsl))
         else:
-            Tp = exchange_halo(Tl, grid, width=k, wire_mode=wire_mode)
+            Tp = exchange_halo(Tl, space, width=k, wire_mode=wire_mode)
             ws2 = ()
         if local_form == "jnp":
             route = "jnp"
@@ -308,12 +331,16 @@ def make_deep_sweep(grid: GlobalGrid, k: int, lam, dt, spacing,
                                steps_per_exchange=k, wire=wire_mode)
         return (Tp[core],) + ws2 if stateful_wire else Tp[core]
 
+    aux_spec = grid.aux_spec if batched else grid.spec
+
     def prepare(Cp):
+        # Batched: Cp is the UNBATCHED space-shaped coefficient every
+        # lane shares — same local program, replicated over batch rows.
         return shard_map(
             local_prepare,
             mesh=grid.mesh,
-            in_specs=(grid.spec,),
-            out_specs=grid.spec,
+            in_specs=(aux_spec,),
+            out_specs=aux_spec,
             check_vma=False,
         )(Cp)
 
@@ -324,19 +351,45 @@ def make_deep_sweep(grid: GlobalGrid, k: int, lam, dt, spacing,
             outs = shard_map(
                 local_sweep,
                 mesh=grid.mesh,
-                in_specs=(grid.spec,) * (2 + len(ws)),
+                in_specs=(grid.spec, aux_spec) + (grid.spec,) * len(ws),
                 out_specs=(grid.spec,) * (1 + len(ws)),
                 check_vma=False,
             )(T, Cm, *ws)
             return outs[0], tuple(outs[1:])
 
     else:
+        if batched:
+            import jax
+
+            from rocm_mpi_tpu.parallel.halo import exchange_halo_batched
+
+            def sweep_body(Tb_l, Cm):
+                # The exchange runs through exchange_halo_batched so
+                # the trace-time `halo.exchange.batched` annotation
+                # books the TRUE lane-aggregate wire bytes — vmapping
+                # exchange_halo would annotate a single lane's slab
+                # and under-report the wire by the lane count. Only
+                # the k-step local kernel is vmapped (shared Cm rides
+                # unbatched in its closure).
+                Tp_b = exchange_halo_batched(Tb_l, grid, width=k,
+                                             wire_mode=wire_mode)
+                if telemetry.enabled():
+                    telemetry.annotate(
+                        "deep.sweep", k=k, route="jnp",
+                        steps_per_exchange=k, wire=wire_mode,
+                        lanes=int(Tb_l.shape[0]),
+                    )
+                return jax.vmap(
+                    lambda Tp: jnp_k_steps(Tp, Cm)[core]
+                )(Tp_b)
+        else:
+            sweep_body = local_sweep
 
         def sweep(T, Cm):
             return shard_map(
-                local_sweep,
+                sweep_body,
                 mesh=grid.mesh,
-                in_specs=(grid.spec, grid.spec),
+                in_specs=(grid.spec, aux_spec),
                 out_specs=grid.spec,
                 check_vma=False,
             )(T, Cm)

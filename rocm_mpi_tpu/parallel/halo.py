@@ -253,6 +253,46 @@ def exchange_halo(u, grid: GlobalGrid, width: int = 1, axes=None,
                          wire_mode=wire_mode, wire_state=wire_state)
 
 
+def exchange_halo_batched(ub, bgrid, width: int = 1, axes=None,
+                          wire_mode: str = "f32"):
+    """Per-lane halo exchange of a lane-leading batched block (inside a
+    shard_map over a space×batch mesh, docs/SERVING.md): `ub` is the
+    local block of `bgrid.spec`-sharded state, shape
+    ``(local_batch, *local_space)``, and the exchange is `exchange_halo`
+    vmapped over the leading lane axis — the halo collectives stay
+    strictly per-space-axis (ppermute's batching rule carries the lane
+    dim along each slab, so lane k's ghosts only ever come from lane
+    k's spatial neighbors; nothing is permuted over the `batch` axis —
+    lanes are separate tenants).
+
+    Stateless wire modes only (f32/bf16): the error-feedback state of
+    the int8 modes is per-logical-wire, and a lane-batched exchange
+    would need a per-lane state plane nothing carries yet."""
+    if wire.is_stateful(wire_mode):
+        raise ValueError(
+            f"wire_mode {wire_mode!r} is stateful; batched exchanges "
+            "support the stateless modes (f32/bf16) only"
+        )
+    space = bgrid.space if hasattr(bgrid, "space") else bgrid
+    if telemetry.enabled():
+        telemetry.annotate(
+            "halo.exchange.batched",
+            lanes=int(ub.shape[0]),
+            bytes=int(ub.shape[0]) * exchange_nbytes(
+                ub.shape[1:], ub.dtype.itemsize, width, axes, wire_mode
+            ),
+            width=width,
+            block=tuple(int(n) for n in ub.shape[1:]),
+            wire=wire_mode,
+        )
+    return jax.vmap(
+        lambda u: exchange_into(
+            place_core(u, width, axes), space, width, axes,
+            wire_mode=wire_mode,
+        )
+    )(ub)
+
+
 class HaloProgram(NamedTuple):
     """A halo exchange family bound to one decomposition: the grid it was
     derived for, the ghost width, the bound `exchange(u)` closure (inside

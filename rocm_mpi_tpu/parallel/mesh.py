@@ -39,6 +39,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 AXIS_NAMES = ("gx", "gy", "gz")
 
+# The multi-tenant lane axis (docs/SERVING.md): a space×batch mesh leads
+# with this axis, lanes are INDEPENDENT simulations, and no halo
+# collective may ever permute over it (graftlint GL05 polices the
+# literal spelling; reductions over it — cross-lane diagnostics — are
+# legitimate).
+BATCH_AXIS = "batch"
+
 
 def suggest_dims(nprocs: int, ndim: int) -> tuple[int, ...]:
     """Factor `nprocs` into `ndim` near-equal factors, largest first.
@@ -318,4 +325,217 @@ def rebuild_for_mesh(
         mesh=Mesh(dev_grid, grid.axis_names),
         global_shape=grid.global_shape,
         lengths=grid.lengths,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchedGrid:
+    """A space×batch device mesh: `batch` independent simulation lanes of
+    one space grid, sharded over a mesh whose LEADING axis is the lane
+    axis (docs/SERVING.md).
+
+    The multi-tenant layout (ROADMAP item 1): batched state is
+    ``(batch, *space_shape)`` under ``PartitionSpec("batch", gx, …)``,
+    so XLA splits lanes over the batch device rows and each lane's
+    spatial shards over the space axes. Halo collectives stay strictly
+    per-space-axis — inside a `shard_map` over `self.mesh`, the
+    per-lane local step is `vmap`ped over the leading lane axis and the
+    existing `exchange_halo`/sweep machinery runs against the `space`
+    descriptor unchanged (ppermute batching carries the lane dim along;
+    lane k's slabs only ever meet lane k's neighbors). Nothing is ever
+    permuted over the `batch` axis — lanes are separate tenants
+    (graftlint GL05's batch rule is the static police).
+
+    `space` is the per-lane grid DESCRIPTOR: its mesh is one batch row
+    of `mesh` (shapes/axis names are what the halo machinery reads; the
+    collectives resolve axis names against the surrounding combined-mesh
+    shard_map, so the descriptor's device objects never matter)."""
+
+    mesh: Mesh  # axes (BATCH_AXIS, *space axis names)
+    space: GlobalGrid  # the per-lane space grid descriptor
+    batch: int  # global lane count B
+
+    def __post_init__(self):
+        names = tuple(self.mesh.axis_names)
+        if not names or names[0] != BATCH_AXIS:
+            raise ValueError(
+                f"batched mesh must lead with axis {BATCH_AXIS!r}, "
+                f"got {names}"
+            )
+        if names[1:] != self.space.axis_names:
+            raise ValueError(
+                f"batched mesh space axes {names[1:]} != space grid "
+                f"axes {self.space.axis_names}"
+            )
+        if tuple(self.mesh.devices.shape[1:]) != self.space.dims:
+            raise ValueError(
+                f"batched mesh space dims {self.mesh.devices.shape[1:]} "
+                f"!= space grid dims {self.space.dims}"
+            )
+        if self.batch < 1:
+            raise ValueError(f"batch must be >= 1, got {self.batch}")
+        if self.batch % self.batch_dims != 0:
+            raise ValueError(
+                f"batch {self.batch} not divisible by the {self.batch_dims} "
+                f"device rows along {BATCH_AXIS!r}"
+            )
+
+    # ---- topology -------------------------------------------------------
+
+    @property
+    def batch_dims(self) -> int:
+        """Device rows along the lane axis."""
+        return int(self.mesh.devices.shape[0])
+
+    @property
+    def local_batch(self) -> int:
+        """Lanes per batch device row."""
+        return self.batch // self.batch_dims
+
+    @property
+    def dims(self) -> tuple[int, ...]:
+        return tuple(self.mesh.devices.shape)
+
+    @property
+    def nprocs(self) -> int:
+        return int(np.prod(self.dims))
+
+    @property
+    def ndim(self) -> int:
+        """Rank of the BATCHED state (1 + space rank)."""
+        return 1 + self.space.ndim
+
+    @property
+    def axis_names(self) -> tuple[str, ...]:
+        return tuple(self.mesh.axis_names)
+
+    @property
+    def global_shape(self) -> tuple[int, ...]:
+        """Batched state shape: (batch, *space global shape)."""
+        return (self.batch,) + self.space.global_shape
+
+    @property
+    def local_shape(self) -> tuple[int, ...]:
+        return (self.local_batch,) + self.space.local_shape
+
+    # ---- sharding -------------------------------------------------------
+
+    @property
+    def spec(self) -> PartitionSpec:
+        """P(batch, *space axes) — the batched-state partition spec."""
+        return PartitionSpec(*self.axis_names)
+
+    @property
+    def sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, self.spec)
+
+    @property
+    def aux_spec(self) -> PartitionSpec:
+        """Spec of an UNBATCHED space-shaped operand inside the combined
+        mesh (prepare coefficients shared by every lane)."""
+        return PartitionSpec(*self.space.axis_names)
+
+    @property
+    def aux_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, self.aux_spec)
+
+    @property
+    def batch_spec(self) -> PartitionSpec:
+        """Spec of a per-lane scalar/vector operand, e.g. lane step
+        counts shaped (batch,)."""
+        return PartitionSpec(BATCH_AXIS)
+
+    @property
+    def batch_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, self.batch_spec)
+
+
+def init_batched_grid(
+    batch: int,
+    *global_shape: int,
+    lengths: Sequence[float] | None = None,
+    space_dims: Sequence[int] | None = None,
+    batch_dims: int = 1,
+    devices: Sequence[jax.Device] | None = None,
+) -> BatchedGrid:
+    """Build a BatchedGrid: `batch` lanes of a `global_shape` space grid
+    over `batch_dims × space_dims` devices (leading `batch` mesh axis).
+
+    `space_dims` defaults to the largest valid sub-mesh over the devices
+    left after the batch rows take theirs (plan_dims); `batch_dims`
+    defaults to 1 — the serving layer grows it when the queue is deep
+    and the device budget allows (docs/SERVING.md "Elasticity")."""
+    if batch < 1:
+        raise ValueError(f"batch must be >= 1, got {batch}")
+    if batch_dims < 1:
+        raise ValueError(f"batch_dims must be >= 1, got {batch_dims}")
+    if batch % batch_dims != 0:
+        raise ValueError(
+            f"batch {batch} not divisible by batch_dims {batch_dims}"
+        )
+    shape = tuple(int(n) for n in global_shape)
+    ndim = len(shape)
+    if lengths is None:
+        lengths = (10.0,) * ndim
+    lengths = tuple(float(l) for l in lengths)
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if batch_dims > len(devices):
+        raise ValueError(
+            f"batch_dims {batch_dims} needs {batch_dims} devices, "
+            f"have {len(devices)}"
+        )
+    if space_dims is None:
+        space_dims = plan_dims(shape, len(devices) // batch_dims)
+    space_dims = tuple(int(d) for d in space_dims)
+    need = batch_dims * int(np.prod(space_dims))
+    if need > len(devices):
+        raise ValueError(
+            f"batched mesh ({batch_dims}, {space_dims}) needs {need} "
+            f"devices, have {len(devices)}"
+        )
+    dev_grid = np.asarray(devices[:need]).reshape((batch_dims,) + space_dims)
+    space = GlobalGrid(
+        mesh=Mesh(dev_grid[0], AXIS_NAMES[:ndim]),
+        global_shape=shape,
+        lengths=lengths,
+    )
+    return BatchedGrid(
+        mesh=Mesh(dev_grid, (BATCH_AXIS,) + space.axis_names),
+        space=space,
+        batch=int(batch),
+    )
+
+
+def rebuild_batched_for_mesh(
+    bgrid: BatchedGrid,
+    batch: int | None = None,
+    batch_dims: int | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> BatchedGrid:
+    """Re-derive a BatchedGrid for a NEW device budget / lane width —
+    the serving layer's elastic resize (grow the batch rows when the
+    queue is deep, shrink when idle; docs/SERVING.md). The space problem
+    (global shape, lengths) stays fixed; everything derived from the
+    decomposition — shardings, local lane counts, compiled batched
+    programs — must be rebuilt, exactly as the elastic-recovery
+    contract demands for the space mesh (rebuild_for_mesh)."""
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if batch_dims is None:
+        batch_dims = bgrid.batch_dims
+    if batch is None:
+        batch = bgrid.batch
+    space_dims = plan_dims(
+        bgrid.space.global_shape, max(len(devices) // batch_dims, 1)
+    )
+    return init_batched_grid(
+        batch,
+        *bgrid.space.global_shape,
+        lengths=bgrid.space.lengths,
+        space_dims=space_dims,
+        batch_dims=batch_dims,
+        devices=devices,
     )

@@ -55,6 +55,15 @@ def main(argv=None) -> int:
     p.add_argument("--no-wire", action="store_true",
                    help="skip the wire-bytes ladder (docs/PERF.md 'Wire "
                    "precision'); the ladder runs by default")
+    p.add_argument("--include-batch-fixture", action="store_true",
+                   help="also audit the doctored over-padded batched row "
+                   "(a 4-wide batched program carrying one live lane; "
+                   "regression-tests the batched-step audit, docs/"
+                   "SERVING.md — EXPECTED to fail, so the exit code "
+                   "goes 1)")
+    p.add_argument("--no-batch", action="store_true",
+                   help="skip the batched-step audit (docs/SERVING.md); "
+                   "it runs by default")
     args = p.parse_args(argv)
 
     # CPU pinning BEFORE any backend use: the gate must neither need nor
@@ -89,6 +98,17 @@ def main(argv=None) -> int:
         local=local, dims=dims, deep_k=deep_k, budgets=budgets,
         include_waste_fixture=args.include_waste_fixture,
     )
+    if not args.no_batch:
+        # The multi-tenant batched-step audit (docs/SERVING.md): the
+        # B-lane program's bytes per invocation vs B × the single-lane
+        # ideal; rows render/gate alongside the per-variant audit.
+        serving_geo = budgets.get("serving", {})
+        rows += traffic.audit_batched(
+            local=local, dims=dims,
+            batch=int(serving_geo.get("batch", traffic.DEFAULT_BATCH)),
+            budgets=budgets,
+            include_batch_fixture=args.include_batch_fixture,
+        )
     wire_rows = []
     if not args.no_wire:
         wire_geo = budgets.get("wire", {})

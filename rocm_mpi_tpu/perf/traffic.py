@@ -438,6 +438,110 @@ def audit_variants(local: int = DEFAULT_LOCAL, dims=(2, 1),
 
 
 # ---------------------------------------------------------------------------
+# The batched-step audit (multi-tenant serving, docs/SERVING.md)
+# ---------------------------------------------------------------------------
+
+DEFAULT_BATCH = 2
+DEFAULT_BATCH_FIXTURE_WIDTH = 4
+
+
+def ideal_batched_step_bytes(local_shape, itemsize: int, lanes: int,
+                             width: int = 1) -> int:
+    """Per-shard ideal of ONE B-lane batched step: exactly `lanes` ×
+    the single-lane exchanged-step ideal — batching amortizes the
+    PROGRAM, not the bytes, so a batched program that moves more than
+    B× the single-lane bytes (per live lane) is shipping padding
+    (the bin scheduler's split rule exists to prevent exactly that)."""
+    return lanes * ideal_exchanged_step_bytes(local_shape, itemsize, width)
+
+
+def audit_batched(local: int = DEFAULT_LOCAL, dims=(2, 1),
+                  batch: int = DEFAULT_BATCH,
+                  budgets: dict | None = None,
+                  include_batch_fixture: bool = False) -> list[TrafficRow]:
+    """Compile + audit the B-lane batched diffusion step (the serving
+    layer's program class: shard_map over the space×batch mesh, the
+    per-lane body vmapped — models.diffusion.batched_step_fn) on the
+    current (CPU) backend: modeled bytes/invocation must stay within
+    BATCH_TOLERANCE × B × the single-lane ideal, and the collective
+    wire bytes must be EXACTLY B × the single-lane exchange (a batched
+    exchange that ships more is permuting padding).
+
+    `include_batch_fixture` appends the doctored over-padded row: a
+    width-{DEFAULT_BATCH_FIXTURE_WIDTH} program carrying ONE live lane,
+    audited against the single live lane's ideal — the padding-inflation
+    class the bin scheduler's occupancy floor exists to split away. It
+    must fail (the gate exits 1)."""
+    import jax
+    import numpy as np
+
+    from rocm_mpi_tpu import telemetry
+    from rocm_mpi_tpu.config import DiffusionConfig
+    from rocm_mpi_tpu.models import HeatDiffusion
+    from rocm_mpi_tpu.parallel.halo import exchange_nbytes
+
+    if budgets is None:
+        budgets = load_budgets()
+    serving = budgets.get("serving", {})
+    tolerance = serving.get("batch_tolerance")
+
+    dims = tuple(int(d) for d in dims)
+    cfg = DiffusionConfig(
+        global_shape=tuple(local * d for d in dims),
+        lengths=(10.0,) * len(dims),
+        nt=8, warmup=0, dtype="f64", dims=dims,
+    )
+    model = HeatDiffusion(cfg)
+    itemsize = jax.numpy.dtype(cfg.jax_dtype).itemsize
+    local_shape = model.grid.local_shape
+    wire1 = exchange_nbytes(local_shape, itemsize, 1)
+    T0, Cp = model.init_state()
+    T0n, Cpn = np.asarray(T0), np.asarray(Cp)
+
+    def measure(width: int):
+        bgrid = model.make_batched_grid(width, batch_dims=1)
+        step = model.batched_step_fn(bgrid, donate=True)
+        Tb = jax.device_put(np.stack([T0n] * width), bgrid.sharding)
+        Cpb = jax.device_put(Cpn, bgrid.aux_sharding)
+        return _modeled_bytes(step, Tb, Cpb)
+
+    rows: list[TrafficRow] = []
+    measured, wire, raw = measure(batch)
+    rows.append(TrafficRow(
+        variant=f"batched{batch}", steps=1,
+        measured_bytes=measured,
+        ideal_bytes=ideal_batched_step_bytes(local_shape, itemsize, batch),
+        wire_bytes=wire, wire_ideal=batch * wire1,
+        cost_analysis_bytes=raw, budget=tolerance,
+    ))
+
+    if include_batch_fixture:
+        # The doctored row: a 4-wide program with ONE live lane — the
+        # machine executes 4 lanes of bytes for 1 lane of work. Audited
+        # per LIVE lane it lands ~4× over; the gate must exit 1.
+        w = DEFAULT_BATCH_FIXTURE_WIDTH
+        measured, wire, raw = measure(w)
+        rows.append(TrafficRow(
+            variant=f"batched-pad{w}/1(fixture)", steps=1,
+            measured_bytes=measured,
+            ideal_bytes=ideal_batched_step_bytes(local_shape, itemsize, 1),
+            wire_bytes=wire, wire_ideal=w * wire1,
+            cost_analysis_bytes=raw, budget=tolerance,
+        ))
+
+    if telemetry.enabled():
+        for r in rows:
+            telemetry.annotate(
+                "step.traffic", variant=r.variant, steps=r.steps,
+                bytes=int(r.measured_bytes), ideal=int(r.ideal_bytes),
+                ratio=round(r.ratio, 4), wire=int(r.wire_bytes),
+                wire_ideal=int(r.wire_ideal),
+                budget=r.budget if r.budget is not None else -1.0,
+            )
+    return rows
+
+
+# ---------------------------------------------------------------------------
 # The wire-bytes ladder (per-mode reduced-precision exchange audit)
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,30 @@
+"""Multi-tenant batched simulation serving (docs/SERVING.md).
+
+The layer that turns the single-run platform into a service (ROADMAP
+item 1): an async request queue (`queue.py`), a bin scheduler that packs
+heterogeneous requests onto shared compiled programs (`bins.py` — since
+the persistent compile cache is unsound on this stack, bin-packed
+program reuse is the ONLY compile amortizer), and a service driver
+(`service.py`) that executes batches on a space×batch mesh
+(parallel.mesh.BatchedGrid), multiplexes per-session checkpoints,
+streams per-request telemetry, and consumes the resilience layer's
+ElasticPolicy (grow when the queue is deep, shrink when idle, requeue
+rc-75 preemptions).
+
+`queue` and `bins` are stdlib-at-import (the telemetry/regress schema
+side reads their formats without jax); `service` imports jax lazily.
+"""
+
+from rocm_mpi_tpu.serving.bins import (  # noqa: F401
+    BIN_MANIFEST_SCHEMA,
+    BinKey,
+    bin_key,
+    plan_batches,
+    steps_bucket,
+)
+from rocm_mpi_tpu.serving.queue import (  # noqa: F401
+    REQUEST_SCHEMA,
+    Request,
+    RequestQueue,
+    Ticket,
+)

@@ -1,0 +1,297 @@
+"""The bin scheduler: pack heterogeneous requests onto shared compiled
+programs (docs/SERVING.md "Bins").
+
+Since the persistent compile cache is unsound on this stack, bin-packed
+program reuse is the ONLY compile amortizer: a compiled batched advance
+is specialized on everything in the `BinKey` — workload, exact space
+shape class, dtype, physics constants, step variant, wire mode — plus
+the lane width W. Requests that agree on the key share programs;
+heterogeneity INSIDE a bin rides traced data instead of trace identity:
+
+  * per-lane step counts — the batch executes max(nt_i) steps and each
+    lane freezes bitwise at its own count (`lane_steps`, a traced
+    operand; models.*.batched_advance_fn), so mixed step counts never
+    split a program. The `steps_bucket` key field (next power of two)
+    only bounds the WASTE of that padding — lanes in one bucket differ
+    by at most 2× in length;
+  * lane-width padding — arrivals rarely match a power-of-two width, so
+    `plan_batches` packs pending requests into pow2 widths and pads the
+    tail batch with idle lanes (steps 0: frozen from step 0, pure
+    machine padding). The `occupancy_floor` (perf/budgets.json
+    "serving") is the traffic-gate feed: a batch whose idle-lane
+    padding would inflate bytes/useful-lane past budget is SPLIT into a
+    narrower width class (its own program) instead of shipped padded.
+
+Stdlib-at-import (the schema gate reads the bin-manifest format without
+jax). Everything here is deterministic — in a multi-controller service
+every rank must plan the identical batches, or the batched collectives
+diverge (graftlint GL08's whole hazard class).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+
+from rocm_mpi_tpu.serving.queue import Request
+
+BIN_MANIFEST_SCHEMA = "rmt-bin-manifest"
+BIN_MANIFEST_VERSION = 1
+
+DEFAULT_MAX_WIDTH = 8
+DEFAULT_OCCUPANCY_FLOOR = 0.5
+
+
+def steps_bucket(nt: int) -> int:
+    """Canonical step bucket: the next power of two >= nt. Lanes in one
+    bucket differ by at most 2x in length, bounding the padded-steps
+    waste of the batch's max(nt) execution."""
+    if nt < 1:
+        raise ValueError(f"nt must be >= 1, got {nt}")
+    b = 1
+    while b < nt:
+        b *= 2
+    return b
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class BinKey:
+    """Compile identity of a batched program, minus the lane width
+    (docs/SERVING.md has the field table). `key_str` round-trips
+    through `parse` — the spelling the manifest and telemetry use."""
+
+    workload: str
+    shape: tuple[int, ...]
+    dtype: str
+    physics: tuple[tuple[str, float], ...]
+    variant: str
+    wire_mode: str
+    steps_bucket: int
+
+    def key_str(self) -> str:
+        shape = "x".join(str(n) for n in self.shape)
+        phys = ",".join(f"{k}={v!r}" for k, v in self.physics) or "-"
+        return (
+            f"{self.workload}|{shape}|{self.dtype}|{phys}|"
+            f"{self.variant}|{self.wire_mode}|{self.steps_bucket}"
+        )
+
+    @classmethod
+    def parse(cls, s: str) -> "BinKey":
+        parts = s.split("|")
+        if len(parts) != 7:
+            raise ValueError(f"bad bin key {s!r} (want 7 '|' fields)")
+        wl, shape_s, dtype, phys_s, variant, wire, bucket = parts
+        shape = tuple(int(n) for n in shape_s.split("x"))
+        phys: tuple = ()
+        if phys_s != "-":
+            pairs = []
+            for item in phys_s.split(","):
+                k, _, v = item.partition("=")
+                if not _ or not k:
+                    raise ValueError(f"bad physics field {item!r} in {s!r}")
+                pairs.append((k, float(v)))
+            phys = tuple(pairs)
+        return cls(
+            workload=wl, shape=shape, dtype=dtype, physics=phys,
+            variant=variant, wire_mode=wire, steps_bucket=int(bucket),
+        )
+
+
+def bin_key(req: Request) -> BinKey:
+    """The request's bin: every trace-identity field, physics sorted so
+    spelling order can't split a bin."""
+    return BinKey(
+        workload=req.workload,
+        shape=tuple(req.global_shape),
+        dtype=req.dtype,
+        physics=tuple(sorted(req.physics)),
+        variant=req.variant,
+        wire_mode=req.wire_mode,
+        steps_bucket=steps_bucket(req.nt),
+    )
+
+
+def pow2_width(n: int, max_width: int) -> int:
+    """Smallest power of two >= n, capped at max_width."""
+    w = 1
+    while w < n and w < max_width:
+        w *= 2
+    return min(w, max_width)
+
+
+def pow2_floor(n: int) -> int:
+    """Largest power of two <= n (n >= 1) — the shared rounding the
+    width planner and the service's grow target both use."""
+    if n < 1:
+        raise ValueError(f"n must be >= 1, got {n}")
+    p = 1
+    while p * 2 <= n:
+        p *= 2
+    return p
+
+
+def plan_batches(n_pending: int, max_width: int = DEFAULT_MAX_WIDTH,
+                 occupancy_floor: float = DEFAULT_OCCUPANCY_FLOOR,
+                 ) -> list[int]:
+    """Deterministic width plan for `n_pending` same-key requests: a
+    list of batch widths (each a power of two <= max_width) covering all
+    requests in FIFO order. Greedy: take the widest batch whose
+    occupancy (live/width) clears the floor; the split rule is built in
+    — a remainder that would ride a wide batch under-occupied gets a
+    narrower width class of its own (its own program) instead
+    (docs/SERVING.md "Padding policy")."""
+    if max_width < 1:
+        raise ValueError(f"max_width must be >= 1, got {max_width}")
+    if not 0.0 < occupancy_floor <= 1.0:
+        raise ValueError(
+            f"occupancy_floor must be in (0, 1], got {occupancy_floor}"
+        )
+    cap = pow2_floor(max_width)
+    out: list[int] = []
+    n = int(n_pending)
+    while n > 0:
+        # The narrowest pow2 covering what's left (programs are the
+        # scarce resource — one wide batch beats two narrow ones), then
+        # the split rule: shrink while the batch would ride under the
+        # occupancy floor.
+        w = pow2_width(n, cap)
+        while w > 1 and (min(n, w) / w) < occupancy_floor:
+            w //= 2
+        out.append(w)
+        n -= min(n, w)
+    return out
+
+
+@dataclasses.dataclass
+class BinStats:
+    """One bin's serving accounting (the occupancy / padding-waste
+    gauges, docs/TELEMETRY.md "Serving"). `lanes` counts compiled lane
+    slots across executed batches; `live_lanes` the slots that carried a
+    request; `useful_steps` the sum of per-lane requested steps;
+    `machine_steps` width x executed-steps summed over batches — the
+    denominator padding waste is measured against."""
+
+    key: BinKey
+    requests: int = 0
+    batches: int = 0
+    widths: tuple[int, ...] = ()
+    lanes: int = 0
+    live_lanes: int = 0
+    useful_steps: int = 0
+    machine_steps: int = 0
+    splits: int = 0
+
+    @property
+    def occupancy(self) -> float:
+        return self.live_lanes / self.lanes if self.lanes else 0.0
+
+    @property
+    def padding_waste(self) -> float:
+        """1 − useful/machine steps: the fraction of executed lane-steps
+        that served no request (idle lanes + frozen tail steps)."""
+        if not self.machine_steps:
+            return 0.0
+        return 1.0 - self.useful_steps / self.machine_steps
+
+    def note_batch(self, width: int, lane_nts: list[int],
+                   executed_steps: int, split: bool = False) -> None:
+        self.batches += 1
+        self.widths = tuple(sorted(set(self.widths) | {width}))
+        self.lanes += width
+        self.live_lanes += len(lane_nts)
+        self.requests += len(lane_nts)
+        self.useful_steps += sum(lane_nts)
+        self.machine_steps += width * executed_steps
+        if split:
+            self.splits += 1
+
+
+def manifest_doc(stats: dict, programs: list[str],
+                 queue_counters: dict | None = None,
+                 extra: dict | None = None) -> dict:
+    """The bin manifest (`serve-manifest.json`, schema-checked by
+    `telemetry regress --check-schema`): one row per bin with its
+    occupancy/padding-waste accounting, plus the compiled program
+    classes — `len(programs)` IS the trace's compile count under the
+    steady-state contract."""
+    rows = []
+    for key, st in sorted(stats.items(), key=lambda kv: kv[0]):
+        rows.append({
+            "key": key.key_str() if isinstance(key, BinKey) else str(key),
+            "requests": st.requests,
+            "batches": st.batches,
+            "widths": list(st.widths),
+            "occupancy": round(st.occupancy, 4),
+            "padding_waste": round(st.padding_waste, 4),
+            "splits": st.splits,
+        })
+    doc = {
+        "schema": BIN_MANIFEST_SCHEMA,
+        "v": BIN_MANIFEST_VERSION,
+        # Record wall STAMP (the `t` field every telemetry record
+        # carries), not an interval measurement — nothing to sync.
+        # graftlint: disable-next=GL06
+        "t": time.time(),
+        "bins": rows,
+        "programs": sorted(programs),
+    }
+    if queue_counters:
+        doc["queue"] = dict(queue_counters)
+    if extra:
+        doc.update(extra)
+    return doc
+
+
+def validate_manifest_doc(doc: dict) -> list[str]:
+    """Problem strings for a bin manifest (stdlib; shared with
+    telemetry.regress --check-schema)."""
+    problems: list[str] = []
+    if doc.get("schema") != BIN_MANIFEST_SCHEMA:
+        problems.append(
+            f"schema {doc.get('schema')!r} != {BIN_MANIFEST_SCHEMA}"
+        )
+    if not isinstance(doc.get("v"), int):
+        problems.append("missing int v")
+    bins = doc.get("bins")
+    if not isinstance(bins, list):
+        return problems + ["missing bins list"]
+    for i, row in enumerate(bins):
+        if not isinstance(row, dict):
+            problems.append(f"bins[{i}] not an object")
+            continue
+        key = row.get("key")
+        if not isinstance(key, str):
+            problems.append(f"bins[{i}] missing key")
+        else:
+            try:
+                BinKey.parse(key)
+            except ValueError as e:
+                problems.append(f"bins[{i}].key: {e}")
+        for field in ("requests", "batches"):
+            if not isinstance(row.get(field), int) or row.get(field) < 0:
+                problems.append(f"bins[{i}].{field} not a count")
+        for field in ("occupancy", "padding_waste"):
+            v = row.get(field)
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or not 0.0 <= v <= 1.0:
+                problems.append(f"bins[{i}].{field} outside [0, 1]")
+    progs = doc.get("programs")
+    if not isinstance(progs, list) or not all(
+        isinstance(p, str) for p in progs
+    ):
+        problems.append("missing programs list")
+    return problems
+
+
+def write_manifest(path, doc: dict) -> None:
+    """Atomic tmp+rename write (GL09: this is a schema-versioned
+    sidecar; a torn manifest must never be readable)."""
+    path = os.fspath(path)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)

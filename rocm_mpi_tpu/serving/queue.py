@@ -1,0 +1,325 @@
+"""The serving request plane: requests, tickets, and the async queue
+(docs/SERVING.md "Request schema").
+
+Stdlib-at-import by design: the telemetry schema gate
+(`telemetry regress --check-schema`) validates archived request sidecars
+through `validate_request_record` without importing jax, exactly as
+`parallel/wire.py` keeps its mode registry importable for the read side.
+
+A `Request` is everything needed to reproduce one simulation
+standalone — workload, exact space shape, dtype, physics constants,
+step count, variant/wire knobs — plus the serving-only fields: a
+request id, an IC scale (the per-lane variation knob: lane state is
+``ic_scale ×`` the workload's standard initial condition), and an
+optional `session` id for checkpoint multiplexing (the service saves
+the final state under ``sessions/<session>/`` through the PR-6 manifest
+machinery; a later request with `resume=True` continues from the latest
+valid saved step). Everything that affects the COMPILED program is a
+bin-key field (serving/bins.py); everything per-lane is traced data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+
+REQUEST_SCHEMA = "rmt-serve-request"
+REQUEST_VERSION = 1
+
+WORKLOADS = ("diffusion", "wave", "swe")
+REQUEST_DTYPES = ("f32", "f64", "bf16")
+
+# Queued -> running -> done|failed; requeued is the preemption exit
+# (docs/SERVING.md "Preemption"): the request never started, the ticket
+# is parked for the next service instance.
+TICKET_STATES = ("queued", "running", "done", "failed", "requeued")
+
+
+@dataclasses.dataclass(frozen=True)
+class Request:
+    """One simulation request (docs/SERVING.md has the field table)."""
+
+    request_id: str
+    workload: str = "diffusion"
+    global_shape: tuple[int, ...] = (64, 64)
+    dtype: str = "f32"
+    nt: int = 64
+    physics: tuple[tuple[str, float], ...] = ()
+    variant: str = "shard"
+    wire_mode: str = "f32"
+    ic_scale: float = 1.0
+    session: str | None = None
+    resume: bool = False
+
+    def __post_init__(self):
+        if not self.request_id or not isinstance(self.request_id, str):
+            raise ValueError("request_id must be a non-empty string")
+        if self.workload not in WORKLOADS:
+            raise ValueError(
+                f"workload must be one of {WORKLOADS}, got {self.workload!r}"
+            )
+        shape = tuple(int(n) for n in self.global_shape)
+        if len(shape) < 1 or any(n < 4 for n in shape):
+            raise ValueError(
+                f"global_shape must have every axis >= 4, got {shape}"
+            )
+        object.__setattr__(self, "global_shape", shape)
+        if self.dtype not in REQUEST_DTYPES:
+            raise ValueError(
+                f"dtype must be one of {REQUEST_DTYPES}, got {self.dtype!r}"
+            )
+        if int(self.nt) < 1:
+            raise ValueError(f"nt must be >= 1, got {self.nt}")
+        object.__setattr__(self, "nt", int(self.nt))
+        phys = tuple(
+            (str(k), float(v)) for k, v in tuple(self.physics)
+        )
+        object.__setattr__(self, "physics", phys)
+        if self.resume and not self.session:
+            raise ValueError("resume=True needs a session id")
+
+    @property
+    def physics_dict(self) -> dict:
+        return dict(self.physics)
+
+
+def request_to_record(req: Request) -> dict:
+    """The sidecar line (`serve-requests.jsonl`): schema-stamped, every
+    field JSON-plain — `telemetry regress --check-schema` validates the
+    archived trace with `validate_request_record`."""
+    return {
+        "schema": REQUEST_SCHEMA,
+        "kind": "serve-request",
+        "v": REQUEST_VERSION,
+        # Record wall STAMP (the `t` field every telemetry record
+        # carries), not an interval measurement — nothing to sync.
+        # graftlint: disable-next=GL06
+        "t": time.time(),
+        "request_id": req.request_id,
+        "workload": req.workload,
+        "global_shape": list(req.global_shape),
+        "dtype": req.dtype,
+        "nt": req.nt,
+        "physics": {k: v for k, v in req.physics},
+        "variant": req.variant,
+        "wire_mode": req.wire_mode,
+        "ic_scale": req.ic_scale,
+        "session": req.session,
+        "resume": bool(req.resume),
+    }
+
+
+def request_from_record(doc: dict) -> Request:
+    problems = validate_request_record(doc)
+    if problems:
+        raise ValueError(
+            "bad serve-request record: " + "; ".join(problems)
+        )
+    return Request(
+        request_id=doc["request_id"],
+        workload=doc["workload"],
+        global_shape=tuple(doc["global_shape"]),
+        dtype=doc["dtype"],
+        nt=doc["nt"],
+        physics=tuple(sorted(doc.get("physics", {}).items())),
+        variant=doc.get("variant", "shard"),
+        wire_mode=doc.get("wire_mode", "f32"),
+        ic_scale=float(doc.get("ic_scale", 1.0)),
+        session=doc.get("session"),
+        resume=bool(doc.get("resume", False)),
+    )
+
+
+def validate_request_record(doc: dict) -> list[str]:
+    """Problem strings for a serve-request sidecar record (stdlib —
+    shared with telemetry.regress `--check-schema`)."""
+    problems: list[str] = []
+    if doc.get("schema") != REQUEST_SCHEMA:
+        problems.append(f"schema {doc.get('schema')!r} != {REQUEST_SCHEMA}")
+    if not isinstance(doc.get("request_id"), str) or not doc.get("request_id"):
+        problems.append("missing request_id")
+    if doc.get("workload") not in WORKLOADS:
+        problems.append(f"unknown workload {doc.get('workload')!r}")
+    shape = doc.get("global_shape")
+    if not isinstance(shape, list) or not shape or not all(
+        isinstance(n, int) and n >= 4 for n in shape
+    ):
+        problems.append(f"bad global_shape {shape!r}")
+    if doc.get("dtype") not in REQUEST_DTYPES:
+        problems.append(f"unknown dtype {doc.get('dtype')!r}")
+    nt = doc.get("nt")
+    if not isinstance(nt, int) or nt < 1:
+        problems.append(f"bad nt {nt!r}")
+    phys = doc.get("physics", {})
+    if not isinstance(phys, dict) or not all(
+        isinstance(k, str) and isinstance(v, (int, float))
+        and not isinstance(v, bool) for k, v in phys.items()
+    ):
+        problems.append("physics must be {name: number}")
+    if doc.get("resume") and not doc.get("session"):
+        problems.append("resume without a session id")
+    return problems
+
+
+def load_trace(path) -> list[Request]:
+    """Parse a serve-requests.jsonl trace file into Requests (blank
+    lines skipped; a malformed line raises — a trace is an input, not a
+    telemetry stream tolerating torn tails)."""
+    out: list[Request] = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for i, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+            except ValueError as e:
+                raise ValueError(f"{path}:{i}: bad JSON ({e})") from None
+            out.append(request_from_record(doc))
+    return out
+
+
+class Ticket:
+    """One queued request's handle: thread-safe state + a waitable
+    result. The service resolves it (`_resolve`/`_fail`) when the
+    request's batch completes; `result(timeout)` blocks the submitter."""
+
+    def __init__(self, request: Request):
+        self.request = request
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._state = "queued"
+        self._result = None
+        self._error: str | None = None
+        self.steps_run = 0  # actually-advanced steps (resume-aware)
+        self.start_step = 0  # resume start (session restore)
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def _mark(self, state: str) -> None:
+        if state not in TICKET_STATES:
+            raise ValueError(f"unknown ticket state {state!r}")
+        with self._lock:
+            self._state = state
+        if state == "requeued":
+            # Wake waiters promptly: a preempted request must not block
+            # its submitter until timeout (result() returns None).
+            self._event.set()
+        elif state == "running":
+            # A requeued ticket re-popped by the next drain is live
+            # again — re-arm the wait for its real resolution.
+            self._event.clear()
+
+    def _resolve(self, result) -> None:
+        with self._lock:
+            self._state = "done"
+            self._result = result
+        self._event.set()
+
+    def _fail(self, error: str) -> None:
+        with self._lock:
+            self._state = "failed"
+            self._error = error
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    @property
+    def error(self) -> str | None:
+        with self._lock:
+            return self._error
+
+    def result(self, timeout: float | None = None):
+        """Block until resolved; raises RuntimeError on a failed
+        request, TimeoutError when the wait expires, and returns None
+        promptly for a requeued (preempted) request — the caller
+        re-submits (or waits for the next service to drain it)."""
+        if not self._event.wait(timeout):
+            if self.state == "requeued":
+                return None
+            raise TimeoutError(
+                f"request {self.request.request_id} not served in "
+                f"{timeout}s (state {self.state})"
+            )
+        with self._lock:
+            if self._state == "failed":
+                raise RuntimeError(
+                    f"request {self.request.request_id} failed: "
+                    f"{self._error}"
+                )
+            if self._state == "requeued":
+                return None
+            return self._result
+
+
+class RequestQueue:
+    """Thread-safe FIFO of tickets with counters for the telemetry
+    plane (submitted/completed/requeued feed the monitor's SERVE badge,
+    docs/TELEMETRY.md). `submit` is the producer side; the service's
+    drain loop is the consumer (`pop_pending`); `requeue` parks tickets
+    back at the FRONT (preempted work outranks new arrivals)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending: list[Ticket] = []
+        self._closed = False
+        self.submitted = 0
+        self.completed = 0
+        self.failed = 0
+        self.requeued = 0
+
+    def submit(self, request: Request) -> Ticket:
+        t = Ticket(request)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("queue is closed")
+            self._pending.append(t)
+            self.submitted += 1
+        return t
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def pop_pending(self, max_n: int | None = None) -> list[Ticket]:
+        with self._lock:
+            n = len(self._pending) if max_n is None else min(
+                max_n, len(self._pending)
+            )
+            out, self._pending = self._pending[:n], self._pending[n:]
+        for t in out:
+            t._mark("running")
+        return out
+
+    def requeue(self, tickets) -> None:
+        ts = list(tickets)
+        for t in ts:
+            t._mark("requeued")
+        with self._lock:
+            self._pending = ts + self._pending
+            self.requeued += len(ts)
+
+    def note_completed(self, n: int = 1, failed: int = 0) -> None:
+        with self._lock:
+            self.completed += n
+            self.failed += failed
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {
+                "submitted": self.submitted,
+                "completed": self.completed,
+                "failed": self.failed,
+                "requeued": self.requeued,
+                "depth": len(self._pending),
+            }

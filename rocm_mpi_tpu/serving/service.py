@@ -1,0 +1,896 @@
+"""The service driver: batches queued requests onto the space×batch
+mesh (docs/SERVING.md).
+
+One `SimulationService` owns a `RequestQueue`, a per-bin model/program
+cache, and the serving accounting. The drain loop pops pending tickets,
+groups them by `bins.bin_key`, packs each group into power-of-two lane
+widths (`bins.plan_batches` — the occupancy floor splits an
+under-occupied wide batch into a narrower program class instead of
+shipping padding), and executes every batch through the workload's
+`batched_advance_fn`. Compiled programs are cached by
+(bin key | width | batch rows): since the persistent compile cache is
+unsound on this stack, this cache IS the compile amortizer, and the
+PR-5 `compiles.steady_state == 0` gate is the steady-state contract —
+once a drain pass needs no new program, the service marks steady and
+any further recompile is a gated regression.
+
+Resilience integration: requests with a `session` id get their final
+state saved through the PR-6 manifest machinery
+(``sessions/<id>/`` — `resume=True` continues from the latest valid
+step, restored template-less across whatever mesh the service now
+runs); a SIGTERM preemption notice (resilience.preempt, rc 75) stops
+dispatch at the next batch boundary and requeues every unserved ticket;
+and the service is the first real `ElasticPolicy` consumer — the queue
+depth drives batch-row growth within the device budget (policy
+hysteresis included), idle drains shrink back.
+
+Determinism: every scheduling decision (grouping, widths, lane order)
+is a pure function of the submitted trace — in a multi-controller
+service every rank plans identical batches, so the batched collectives
+can never diverge (the GL08 hazard class). Sessions and result
+fetching are single-controller (the drill pins program counts).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import pathlib
+import time
+from typing import Callable
+
+from rocm_mpi_tpu.serving import bins as _bins
+from rocm_mpi_tpu.serving.bins import BinKey, BinStats
+from rocm_mpi_tpu.serving.queue import Request, RequestQueue, Ticket
+
+# Physics fields each workload's config accepts from a request (anything
+# else fails the request loudly — a typo'd constant must not silently
+# serve default physics).
+PHYSICS_FIELDS = {
+    "diffusion": ("lam", "cp0"),
+    "wave": ("c0", "cfl"),
+    "swe": ("H0", "g", "cfl"),
+}
+
+
+def load_serving_budgets(path=None) -> dict:
+    """The committed serving row (perf/budgets.json "serving"):
+    occupancy floor + batch tolerance the scheduler and the traffic
+    audit share. Absent block falls back to the bins defaults."""
+    from rocm_mpi_tpu.perf.traffic import load_budgets
+
+    try:
+        doc = load_budgets(path)
+    except (OSError, ValueError):
+        return {}
+    serving = doc.get("serving")
+    return serving if isinstance(serving, dict) else {}
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Service knobs (docs/SERVING.md "Service driver")."""
+
+    max_width: int = _bins.DEFAULT_MAX_WIDTH
+    occupancy_floor: float | None = None  # None -> budgets "serving" row
+    batch_dims: int = 1  # device rows along the lane axis
+    sessions_dir: str | None = None  # checkpoint multiplex root
+    fetch_results: bool | None = None  # None: auto (off multi-controller)
+    # Elasticity (the ElasticPolicy consumer): policy=None disables.
+    policy: object | None = None  # resilience.policy.ElasticPolicy
+    # Lane-ROW budget: how many device rows the batch axis may spread
+    # over (each row carries one space mesh). Default: all devices.
+    device_budget: Callable[[], int] | None = None
+    grow_queue_depth: int = 8  # depth that makes the policy consider a grow
+    idle_shrink_drains: int = 3  # empty drains before shrinking back
+
+    def resolved_floor(self) -> float:
+        if self.occupancy_floor is not None:
+            return float(self.occupancy_floor)
+        row = load_serving_budgets().get("occupancy_floor")
+        return float(row) if row else _bins.DEFAULT_OCCUPANCY_FLOOR
+
+
+@dataclasses.dataclass
+class ServeReport:
+    """One trace/drain session's outcome."""
+
+    served: int = 0
+    failed: int = 0
+    requeued: int = 0
+    preempted: bool = False
+    bins: dict = dataclasses.field(default_factory=dict)
+    programs: list = dataclasses.field(default_factory=list)
+    compiles: dict = dataclasses.field(default_factory=dict)
+    elastic: list = dataclasses.field(default_factory=list)
+
+    @property
+    def n_bins(self) -> int:
+        return len(self.bins)
+
+    @property
+    def n_programs(self) -> int:
+        return len(self.programs)
+
+    def manifest_doc(self, queue_counters=None) -> dict:
+        return _bins.manifest_doc(
+            self.bins, list(self.programs),
+            queue_counters=queue_counters,
+            extra={
+                "served": self.served,
+                "failed": self.failed,
+                "requeued": self.requeued,
+                "preempted": self.preempted,
+                "elastic": list(self.elastic),
+                "compiles": dict(self.compiles),
+            },
+        )
+
+
+def _reshard(x, sharding):
+    """Device array -> the batched mesh's aux sharding (a tiny jitted
+    transfer, one per program class — compiled inside the class's own
+    compile window, reused every batch). When the batched mesh spans
+    MORE devices than the source's space mesh (an elastic grow added
+    batch rows), XLA cannot jit across the device sets — stage through
+    the host instead (single-controller by construction: multi-
+    controller services never resize)."""
+    import jax
+    import numpy as np
+
+    if set(sharding.device_set) == set(x.sharding.device_set):
+        return jax.jit(lambda v: v, out_shardings=sharding)(x)
+    if x.is_fully_addressable:
+        return _to_global(np.asarray(x), sharding)
+    raise ValueError(
+        "cannot reshard a non-addressable array onto a different "
+        "device set (multi-controller services must keep batch_dims × "
+        "space within the space mesh's device set)"
+    )
+
+
+def _to_global(np_arr, sharding):
+    """Host array -> global device array under `sharding` — works in
+    multi-controller processes too (every rank holds the SAME full host
+    array by the determinism contract; each contributes its addressable
+    shards)."""
+    import jax
+
+    return jax.make_array_from_callback(
+        np_arr.shape, sharding, lambda idx: np_arr[idx]
+    )
+
+
+class _Program:
+    """One compiled program class: the batched advance bound to its
+    space×batch grid, plus the cached base state the lanes scale.
+    `base_dev` are the workload's standard-IC state leaves ON DEVICE
+    (space-sharded); `base_np` their host copies (single-controller
+    only — the lane-assembly fast path); `init` the lazily-jitted
+    device-side lane initializer (scales → batched leaves) the
+    multi-controller path uses instead."""
+
+    def __init__(self, advance, bgrid, aux, base_dev, adapter):
+        self.advance = advance
+        self.bgrid = bgrid
+        self.aux = aux  # device aux operand(s), lane-shared
+        self.base_dev = tuple(base_dev)
+        self.adapter = adapter
+        self._base_np = None
+        self._init = None
+
+    @property
+    def base_np(self):
+        import numpy as np
+
+        if self._base_np is None:
+            self._base_np = tuple(np.asarray(l) for l in self.base_dev)
+        return self._base_np
+
+    @property
+    def n_leaves(self) -> int:
+        return len(self.base_dev)
+
+    @property
+    def base_np_dtype(self):
+        import numpy as np
+
+        return np.dtype(self.base_dev[0].dtype)
+
+    def init_batched(self, scales_dev):
+        """Batched state from per-lane scales, entirely on device (the
+        multi-controller lane assembly; one tiny program per class,
+        compiled inside the class's own compile window)."""
+        import functools
+
+        import jax
+
+        if self._init is None:
+            shardings = (self.bgrid.sharding,) * self.n_leaves
+
+            @functools.partial(jax.jit, out_shardings=shardings)
+            def init(scales, *base):
+                return tuple(
+                    jax.vmap(lambda s, l=leaf: s * l)(scales)
+                    for leaf in base
+                )
+
+            self._init = init
+        return self._init(scales_dev, *self.base_dev)
+
+
+class _Adapter:
+    """Per-workload glue: config/model construction, the batched
+    advance's calling convention, and the state-leaf layout (the
+    session-checkpoint pytree is exactly `leaves`)."""
+
+    name: str = ""
+
+    def make_config(self, key: BinKey, space_dims):
+        raise NotImplementedError
+
+    def make_model(self, cfg):
+        raise NotImplementedError
+
+    def build(self, model, width, batch_dims, bgrid=None):
+        """-> (advance, bgrid, aux_device, base_leaves_numpy)."""
+        raise NotImplementedError
+
+    def run(self, prog: _Program, leaves_dev, lane_steps_dev, n):
+        """-> tuple of advanced state leaves (device)."""
+        raise NotImplementedError
+
+
+class _DiffusionAdapter(_Adapter):
+    name = "diffusion"
+
+    def make_config(self, key, space_dims):
+        from rocm_mpi_tpu.config import DiffusionConfig
+
+        phys = dict(key.physics)
+        return DiffusionConfig(
+            global_shape=key.shape,
+            lengths=(10.0,) * len(key.shape),
+            dtype=key.dtype,
+            dims=space_dims,
+            wire_mode=key.wire_mode,
+            lam=phys.get("lam", 1.0),
+            cp0=phys.get("cp0", 1.0),
+        )
+
+    def make_model(self, cfg):
+        from rocm_mpi_tpu.models import HeatDiffusion
+
+        return HeatDiffusion(cfg)
+
+    def build(self, model, width, batch_dims, variant="shard"):
+        bgrid = model.make_batched_grid(width, batch_dims)
+        advance, _ = model.batched_advance_fn(bgrid=bgrid, variant=variant)
+        T0, Cp = model.init_state()
+        aux = (_reshard(Cp, bgrid.aux_sharding),)
+        return advance, bgrid, aux, (T0,)
+
+    def run(self, prog, leaves_dev, lane_steps_dev, n):
+        out = prog.advance(
+            leaves_dev[0], prog.aux[0], lane_steps_dev, n
+        )
+        return (out,)
+
+
+class _WaveAdapter(_Adapter):
+    name = "wave"
+
+    def make_config(self, key, space_dims):
+        from rocm_mpi_tpu.models.wave import WaveConfig
+
+        phys = dict(key.physics)
+        return WaveConfig(
+            global_shape=key.shape,
+            lengths=(10.0,) * len(key.shape),
+            dtype=key.dtype,
+            dims=space_dims,
+            wire_mode=key.wire_mode,
+            c0=phys.get("c0", 1.0),
+            cfl=phys.get("cfl", 0.5),
+        )
+
+    def make_model(self, cfg):
+        from rocm_mpi_tpu.models.wave import AcousticWave
+
+        return AcousticWave(cfg)
+
+    def build(self, model, width, batch_dims, variant="shard"):
+        bgrid = model.make_batched_grid(width, batch_dims)
+        advance, _ = model.batched_advance_fn(bgrid=bgrid, variant=variant)
+        U0, Up0, C2 = model.init_state()
+        aux = (_reshard(C2, bgrid.aux_sharding),)
+        return advance, bgrid, aux, (U0, Up0)
+
+    def run(self, prog, leaves_dev, lane_steps_dev, n):
+        U, Up = prog.advance(
+            leaves_dev[0], leaves_dev[1], prog.aux[0], lane_steps_dev, n
+        )
+        return (U, Up)
+
+
+class _SWEAdapter(_Adapter):
+    name = "swe"
+
+    def make_config(self, key, space_dims):
+        from rocm_mpi_tpu.models.swe import SWEConfig
+
+        phys = dict(key.physics)
+        return SWEConfig(
+            global_shape=key.shape,
+            lengths=(10.0,) * len(key.shape),
+            dtype=key.dtype,
+            dims=space_dims,
+            wire_mode=key.wire_mode,
+            H0=phys.get("H0", 1.0),
+            g=phys.get("g", 1.0),
+            cfl=phys.get("cfl", 0.5),
+        )
+
+    def make_model(self, cfg):
+        from rocm_mpi_tpu.models.swe import ShallowWater
+
+        return ShallowWater(cfg)
+
+    def build(self, model, width, batch_dims, variant="shard"):
+        bgrid = model.make_batched_grid(width, batch_dims)
+        advance, _ = model.batched_advance_fn(bgrid=bgrid, variant=variant)
+        h0, us0 = model.init_state()
+        Mus = model.face_masks()
+        aux = tuple(_reshard(M, bgrid.aux_sharding) for M in Mus)
+        return advance, bgrid, aux, (h0,) + tuple(us0)
+
+    def run(self, prog, leaves_dev, lane_steps_dev, n):
+        h, us = prog.advance(
+            leaves_dev[0], tuple(leaves_dev[1:]), prog.aux,
+            lane_steps_dev, n,
+        )
+        return (h,) + tuple(us)
+
+
+_ADAPTERS = {
+    a.name: a for a in (_DiffusionAdapter(), _WaveAdapter(), _SWEAdapter())
+}
+
+
+class SimulationService:
+    """Multi-tenant batched simulation service (module docstring; the
+    CLI driver is apps/serve.py)."""
+
+    def __init__(self, queue: RequestQueue | None = None,
+                 config: ServeConfig | None = None):
+        self.queue = queue if queue is not None else RequestQueue()
+        self.config = config if config is not None else ServeConfig()
+        self._floor = self.config.resolved_floor()
+        self._batch_dims = int(self.config.batch_dims)
+        self._models: dict = {}
+        self._programs: dict[str, _Program] = {}
+        self._stats: dict[BinKey, BinStats] = {}
+        self._elastic: list[dict] = []
+        self._drains = 0
+        self._idle_drains = 0
+        self._last_resize_drain: int | None = None
+        self._compiled_this_drain = False
+
+    # ---- model / program caches ----------------------------------------
+
+    def _space_dims(self, key: BinKey):
+        import jax
+
+        from rocm_mpi_tpu.parallel.mesh import plan_dims
+
+        avail = max(len(jax.devices()) // self._batch_dims, 1)
+        return plan_dims(key.shape, avail)
+
+    def _model_for(self, key: BinKey):
+        mkey = (key.workload, key.shape, key.dtype, key.physics,
+                key.wire_mode, self._batch_dims)
+        model = self._models.get(mkey)
+        if model is None:
+            adapter = _ADAPTERS[key.workload]
+            unknown = [
+                k for k, _ in key.physics
+                if k not in PHYSICS_FIELDS[key.workload]
+            ]
+            if unknown:
+                raise ValueError(
+                    f"unknown physics field(s) {unknown} for workload "
+                    f"{key.workload!r} (accepted: "
+                    f"{PHYSICS_FIELDS[key.workload]})"
+                )
+            cfg = adapter.make_config(key, self._space_dims(key))
+            model = adapter.make_model(cfg)
+            self._models[mkey] = model
+        return model
+
+    def program_key(self, key: BinKey, width: int) -> str:
+        return f"{key.key_str()}|w{width}|bd{self._batch_dims}"
+
+    def _program_for(self, key: BinKey, width: int) -> _Program:
+        pkey = self.program_key(key, width)
+        prog = self._programs.get(pkey)
+        if prog is None:
+            from rocm_mpi_tpu import telemetry
+            from rocm_mpi_tpu.telemetry import compiles
+
+            # A NEW program class is a legitimate compile, not a
+            # steady-state regression: open the window, compile, and let
+            # the drain loop re-mark steady once every class it needs
+            # exists.
+            compiles.unmark_steady()
+            self._compiled_this_drain = True
+            adapter = _ADAPTERS[key.workload]
+            model = self._model_for(key)
+            # The batch rows must DIVIDE the (pow2) lane width — a
+            # non-pow2 batch_dims rounds down, it never bricks a batch.
+            bd = _bins.pow2_floor(min(width, self._batch_dims))
+            with telemetry.span("serve.compile", phase="serve",
+                                bin=key.key_str(), width=width):
+                advance, bgrid, aux, base = adapter.build(
+                    model, width, bd, variant=key.variant
+                )
+            prog = _Program(advance, bgrid, aux, base, adapter)
+            self._programs[pkey] = prog
+        return prog
+
+    # ---- lane assembly --------------------------------------------------
+
+    def _session_dir(self, session: str) -> pathlib.Path:
+        root = self.config.sessions_dir
+        if not root:
+            raise ValueError(
+                "request carries a session id but the service has no "
+                "sessions_dir configured"
+            )
+        return pathlib.Path(root) / session
+
+    def _resume_step(self, req: Request, prog: _Program) -> int:
+        """The lane's resume point: the session's latest VALID saved
+        step, 0 when nothing durable exists yet. A session already PAST
+        the requested nt fails loudly — there is no checkpoint at nt to
+        hand back, and restoring the later state would answer a
+        different question than the request asked."""
+        import jax
+
+        if jax.process_count() > 1:
+            raise ValueError("session resume is single-controller only")
+        from rocm_mpi_tpu.utils import checkpoint as ckpt
+
+        step = ckpt.latest_valid_step(self._session_dir(req.session))
+        if step is None:
+            return 0
+        if int(step) > req.nt:
+            raise ValueError(
+                f"session {req.session!r} is already at step {step} > "
+                f"requested nt {req.nt}; re-submit with nt >= {step}"
+            )
+        return int(step)
+
+    def _lane_start_state(self, req: Request, prog: _Program,
+                          start: int):
+        """(leaves numpy tuple, start_step) for one lane: the session's
+        checkpoint at `start` when resuming (template-less restore —
+        the PR-6 cross-mesh path), else ic_scale × the workload's
+        standard IC."""
+        import numpy as np
+
+        if req.resume and start > 0:
+            from rocm_mpi_tpu.utils import checkpoint as ckpt
+
+            sdir = self._session_dir(req.session)
+            leaves = ckpt.restore_state(sdir, start, like=None)
+            leaves = tuple(np.asarray(l) for l in leaves)
+            if len(leaves) != prog.n_leaves:
+                raise ValueError(
+                    f"session {req.session}: checkpoint has "
+                    f"{len(leaves)} leaves, workload {req.workload!r} "
+                    f"carries {prog.n_leaves}"
+                )
+            return leaves, start
+        return tuple(l * req.ic_scale for l in prog.base_np), 0
+
+    def _save_session(self, ticket: Ticket, leaves,
+                      prog: _Program) -> None:
+        """Multiplex the lane's final state through the PR-6 manifest
+        machinery: sessions/<id>/ gets a step-nt checkpoint whose
+        manifest meta carries the request id."""
+        import jax
+
+        from rocm_mpi_tpu.utils import checkpoint as ckpt
+
+        req = ticket.request
+        sdir = self._session_dir(req.session)
+        # Space-sharded leaves: the manifest's topology metadata (the
+        # PR-6 cross-mesh restore contract) describes a mesh, so the
+        # saved state must carry one — the bin's own space grid.
+        space = prog.bgrid.space
+        state = tuple(
+            jax.device_put(l, space.sharding) for l in leaves
+        )
+        ckpt.save_state(sdir, req.nt, state)
+        # Re-write the manifest with the serving meta riding along —
+        # write_manifest recomputes the inventory, so this is the same
+        # document plus the request attribution.
+        ckpt.write_manifest(
+            sdir, req.nt, state,
+            extra_meta={"serving": {
+                "request_id": req.request_id, "session": req.session,
+            }},
+        )
+
+    # ---- execution ------------------------------------------------------
+
+    def _execute_batch(self, key: BinKey, tickets: list[Ticket],
+                       width: int, split: bool) -> None:
+        import jax
+        import numpy as np
+
+        from rocm_mpi_tpu import telemetry
+        from rocm_mpi_tpu.telemetry import flight
+
+        prog = self._program_for(key, width)
+        bgrid = prog.bgrid
+        multi = jax.process_count() > 1
+
+        # Per-lane assembly, per-lane failure isolation: one tenant's
+        # bad session (corrupt checkpoint, wrong workload's leaves,
+        # nt behind the saved step) fails ITS ticket only — the
+        # co-batched neighbors keep their lanes; the failed lane stays
+        # idle padding.
+        live: list[Ticket] = []
+        starts: list[int] = []
+        lanes: list[tuple] = []
+        scales = np.zeros(width, dtype=prog.base_np_dtype)
+        lane_steps = np.zeros(width, dtype=np.int32)
+        for t in tickets:
+            try:
+                if multi and (t.request.resume or t.request.session):
+                    raise ValueError(
+                        "session checkpoints are single-controller only"
+                    )
+                start = (
+                    self._resume_step(t.request, prog)
+                    if t.request.resume else 0
+                )
+                if not multi:
+                    leaves, _ = self._lane_start_state(
+                        t.request, prog, start
+                    )
+            except Exception as e:  # noqa: BLE001 — tenant isolation
+                self._fail_ticket(t, str(e))
+                continue
+            j = len(live)
+            live.append(t)
+            starts.append(start)
+            lane_steps[j] = t.request.nt - start
+            scales[j] = t.request.ic_scale
+            if not multi:
+                lanes.append(leaves)
+            t.start_step = start
+        if not live:
+            return
+        n = int(lane_steps.max())
+
+        if multi:
+            # Multi-controller lane assembly is entirely on device (a
+            # host-assembled batch cannot be placed onto a sharding
+            # spanning other processes).
+            leaves_dev = prog.init_batched(
+                _to_global(scales, bgrid.batch_sharding)
+            )
+        else:
+            # Idle pad lanes: zero state, zero steps (frozen from step
+            # 0 — pure machine padding, the waste the occupancy floor
+            # bounds).
+            zero = tuple(np.zeros_like(l) for l in prog.base_np)
+            while len(lanes) < width:
+                lanes.append(zero)
+            leaves_dev = tuple(
+                _to_global(
+                    np.stack([lanes[i][leaf] for i in range(width)]),
+                    bgrid.sharding,
+                )
+                for leaf in range(prog.n_leaves)
+            )
+        steps_dev = _to_global(lane_steps, bgrid.batch_sharding)
+
+        with telemetry.span(
+            "serve.batch", phase="serve",
+            bin=key.key_str(), width=width, live=len(live),
+            steps=n,
+        ):
+            out = prog.adapter.run(prog, leaves_dev, steps_dev, n)
+            for leaf in out:
+                leaf.block_until_ready()
+
+        fetch = self.config.fetch_results
+        if fetch is None:
+            fetch = not multi
+        # Session persistence is independent of result fetching: a
+        # fetch_results=False service must still honor the durable-
+        # session contract (both need the host copy).
+        need_host = fetch or any(t.request.session for t in live)
+        host = None
+        if need_host and all(leaf.is_fully_addressable for leaf in out):
+            host = tuple(np.asarray(leaf) for leaf in out)
+        done = 0
+        for j, t in enumerate(live):
+            # Lane-isolated resolution: one tenant's failing session
+            # save (unwritable dir, disk full) must not fail its
+            # co-batched neighbors or skew the completion accounting.
+            try:
+                lane = (
+                    tuple(leaf[j] for leaf in host)
+                    if host is not None else None
+                )
+                if t.request.session and lane is not None:
+                    self._save_session(t, lane, prog)
+            except Exception as e:  # noqa: BLE001 — tenant isolation
+                self._fail_ticket(t, str(e))
+                continue
+            t.steps_run = int(lane_steps[j])
+            t._resolve(lane if fetch else None)
+            done += 1
+            telemetry.record_event(
+                "serve.request.done",
+                request_id=t.request.request_id,
+                bin=key.key_str(), width=width,
+                steps=int(lane_steps[j]), start=starts[j],
+            )
+        self.queue.note_completed(done)
+        flight.progress(serve_completed=done)
+
+        st = self._stats.get(key)
+        if st is None:
+            st = self._stats[key] = BinStats(key=key)
+        st.note_batch(width, [int(s) for s in lane_steps[:len(live)]],
+                      n, split=split)
+
+    def _fail_ticket(self, t: Ticket, error: str) -> None:
+        """The one failure chokepoint: ticket, queue counter, AND the
+        serve_failed flight counter — the monitor's depth formula
+        (submitted − completed − requeued − failed) must see every
+        outcome, or a failed request reads as backlog forever."""
+        from rocm_mpi_tpu.telemetry import flight
+
+        t._fail(error)
+        self.queue.note_completed(0, failed=1)
+        flight.progress(serve_failed=1)
+
+    def _preempt_requested(self) -> bool:
+        from rocm_mpi_tpu.resilience import preempt
+
+        return preempt.requested()
+
+    def drain_once(self) -> tuple[int, bool]:
+        """One drain pass: pop everything pending, pack, execute.
+        Returns (served_count, preempted) — on preemption the unserved
+        tickets are requeued and dispatch stops at the batch boundary
+        (the scheduler's rc-75 requeue signal, docs/SERVING.md)."""
+        from rocm_mpi_tpu import telemetry
+        from rocm_mpi_tpu.telemetry import compiles, flight
+
+        self._drains += 1
+        tickets = self.queue.pop_pending()
+        telemetry.gauge("serve.queue_depth", float(len(tickets)))
+        if not tickets:
+            self._idle_drains += 1
+            return 0, False
+        self._idle_drains = 0
+        flight.progress(serve_submitted=len(tickets))
+        self._compiled_this_drain = False
+
+        groups: dict[BinKey, list[Ticket]] = {}
+        bad: list[tuple[Ticket, str]] = []
+        for t in tickets:
+            try:
+                groups.setdefault(_bins.bin_key(t.request), []).append(t)
+            except ValueError as e:
+                bad.append((t, str(e)))
+        for t, msg in bad:
+            self._fail_ticket(t, msg)
+
+        served = 0
+        pending: list[tuple[BinKey, list[Ticket], int, bool]] = []
+        for key in sorted(groups):
+            ts = groups[key]
+            widths = _bins.plan_batches(
+                len(ts), self.config.max_width, self._floor
+            )
+            canonical = widths[0]
+            i = 0
+            for w in widths:
+                take = min(w, len(ts) - i)
+                pending.append((key, ts[i:i + take], w, w != canonical))
+                i += take
+
+        preempted = False
+        for bi, (key, batch_ts, w, split) in enumerate(pending):
+            if self._preempt_requested():
+                preempted = True
+                rest = [t for _, ts2, _, _ in pending[bi:] for t in ts2]
+                self.queue.requeue(rest)
+                flight.progress(serve_requeued=len(rest))
+                break
+            try:
+                self._execute_batch(key, batch_ts, w, split)
+                served += sum(1 for t in batch_ts if t.state == "done")
+            except Exception as e:  # noqa: BLE001 — tenant isolation:
+                # a batch-level failure (compile error, bad physics,
+                # device mismatch) must fail ITS tickets loudly and let
+                # the other bins' batches keep serving — an unhandled
+                # escape here would strand every later popped ticket in
+                # 'running' forever and kill the daemon without the
+                # rc-75 requeue path.
+                telemetry.record_event(
+                    "serve.batch.error", bin=key.key_str(), width=w,
+                    error=str(e),
+                )
+                for t in batch_ts:
+                    if not t.done():
+                        self._fail_ticket(t, str(e))
+
+        if not preempted and not self._compiled_this_drain \
+                and self._programs:
+            # Every program class the live traffic needs exists: any
+            # recompile from here is a steady-state regression the
+            # compiles.* zero-pin gates.
+            compiles.mark_steady()
+        return served, preempted
+
+    # ---- elasticity (the ElasticPolicy consumer) ------------------------
+
+    def maybe_resize(self) -> bool:
+        """Queue-driven elasticity: grow the batch rows when the queue
+        is deep and the policy + device budget agree; shrink when idle.
+        Resize drops every compiled program/model (they are bound to the
+        old mesh — the PR-6 rebuild discipline) and reopens the compile
+        window (a resize compile is elastic, not a steady regression)."""
+        import jax
+
+        policy = self.config.policy
+        if policy is None or jax.process_count() > 1:
+            return False
+        budget_fn = self.config.device_budget
+        budget = int(budget_fn() if budget_fn else len(jax.devices()))
+        depth = self.queue.depth()
+        bd = self._batch_dims
+        target = None
+        kind = None
+        if depth >= self.config.grow_queue_depth and policy.wants_grow(
+            bd, budget,
+            step=self._drains,
+            last_change_step=self._last_resize_drain,
+        ):
+            grown = policy.grow_target(bd, budget, _bins.pow2_floor)
+            if grown > bd:
+                target, kind = grown, "grow"
+        elif (
+            depth == 0
+            and self._idle_drains >= self.config.idle_shrink_drains
+            and bd > max(1, int(getattr(policy, "min_ranks", 1)))
+        ):
+            target, kind = max(bd // 2,
+                               int(getattr(policy, "min_ranks", 1))), \
+                "shrink"
+        if target is None or target == bd:
+            return False
+        self._resize(target, kind, depth=depth, budget=budget)
+        return True
+
+    def _resize(self, new_bd: int, kind: str, **attrs) -> None:
+        from rocm_mpi_tpu import telemetry
+        from rocm_mpi_tpu.telemetry import compiles, flight
+
+        old = self._batch_dims
+        self._batch_dims = int(new_bd)
+        self._models.clear()
+        self._programs.clear()
+        compiles.unmark_steady()
+        self._last_resize_drain = self._drains
+        event = {
+            "event": f"serve.{kind}", "old_batch_dims": old,
+            "new_batch_dims": int(new_bd), "drain": self._drains,
+            **attrs,
+        }
+        self._elastic.append(event)
+        telemetry.record_event(f"serve.{kind}", **event)
+        flight.progress(serve_resizes=1)
+
+    # ---- drivers --------------------------------------------------------
+
+    def run_trace(self, requests) -> ServeReport:
+        """Serve a request list to completion (the acceptance driver):
+        submit everything, drain until the queue is empty (or a
+        preemption notice stops dispatch), return the report."""
+        tickets = [self.queue.submit(r) for r in requests]
+        report = self._drain_all()
+        del tickets
+        return report
+
+    def _drain_all(self) -> ServeReport:
+        report = ServeReport()
+        while True:
+            # Resize BEFORE draining: the decision input is the backlog,
+            # and drain_once pops the whole queue.
+            self.maybe_resize()
+            served, preempted = self.drain_once()
+            report.served += served
+            if preempted:
+                report.preempted = True
+                break
+            if self.queue.depth() == 0:
+                break
+        self._finish_report(report)
+        return report
+
+    def serve_forever(self, poll_s: float = 0.05,
+                      idle_exit_s: float | None = None) -> ServeReport:
+        """Daemon drain loop: serve until idle for `idle_exit_s`
+        (None = only a preemption notice stops it)."""
+        report = ServeReport()
+        idle_since = None
+        while True:
+            self.maybe_resize()
+            served, preempted = self.drain_once()
+            report.served += served
+            if preempted:
+                report.preempted = True
+                break
+            if self.queue.depth() == 0:
+                now = time.monotonic()
+                if idle_since is None:
+                    idle_since = now
+                elif idle_exit_s is not None \
+                        and now - idle_since >= idle_exit_s:
+                    break
+                time.sleep(poll_s)
+            else:
+                idle_since = None
+        self._finish_report(report)
+        return report
+
+    def _finish_report(self, report: ServeReport) -> None:
+        from rocm_mpi_tpu import telemetry
+        from rocm_mpi_tpu.telemetry import compiles
+
+        counters = self.queue.counters()
+        report.failed = counters["failed"]
+        report.requeued = counters["requeued"]
+        report.bins = dict(self._stats)
+        report.programs = sorted(self._programs)
+        report.elastic = list(self._elastic)
+        snap = compiles.snapshot()
+        report.compiles = {
+            "total": snap["totals"]["backend_compiles"],
+            "steady_state": snap["steady_recompiles"],
+        }
+        if telemetry.enabled():
+            telemetry.gauge("serve.bins", float(len(report.bins)))
+            telemetry.gauge("serve.programs", float(report.n_programs))
+            if report.bins:
+                telemetry.gauge(
+                    "serve.occupancy",
+                    min(st.occupancy for st in report.bins.values()),
+                )
+                telemetry.gauge(
+                    "serve.padding_waste",
+                    max(st.padding_waste for st in report.bins.values()),
+                )
+            compiles.emit_gauges()
+
+    def write_manifest(self, path) -> dict:
+        """Bank the bin manifest sidecar (atomic; schema-checked by
+        lint.sh / `telemetry regress --check-schema`)."""
+        report = ServeReport()
+        self._finish_report(report)
+        # The manifest's lifetime view: everything this service has
+        # completed (report.served is per-drain-session).
+        report.served = self.queue.counters()["completed"]
+        doc = report.manifest_doc(queue_counters=self.queue.counters())
+        _bins.write_manifest(path, doc)
+        return doc

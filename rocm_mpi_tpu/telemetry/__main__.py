@@ -210,6 +210,14 @@ def _cmd_monitor(args) -> int:
             )
             if storage_line:
                 print(storage_line)
+            # Serving runs (docs/SERVING.md): queue depth + served /
+            # requeued counts from the serve_* heartbeat counters — the
+            # operator's at-a-glance backlog view.
+            serve_line = health.format_serve_status(
+                health.serve_status(beats)
+            )
+            if serve_line:
+                print(serve_line)
             if wire_line:
                 print(wire_line)
             print(health.format_monitor(rows, skipped))

@@ -378,6 +378,58 @@ def format_storage_status(status: dict | None) -> str | None:
     return f"storage recovered ({status['skipped']} skipped save(s))"
 
 
+def serve_status(beats: dict[int, dict]) -> dict | None:
+    """The serving-plane view next to the elastic/storage badges
+    (docs/SERVING.md; docs/TELEMETRY.md "Serving"), computed from the
+    heartbeat progress counters the service's drain loop bumps
+    (serve_submitted / serve_completed / serve_requeued /
+    serve_resizes are ADDITIVE counters — depth is their difference).
+    None when no rank ever served (the common case: no badge)."""
+    submitted = completed = requeued = resizes = failed = 0
+    seen = False
+    for _rank, doc in sorted(beats.items()):
+        counters = doc.get("counters") or {}
+        if not any(k.startswith("serve_") for k in counters):
+            continue
+        seen = True
+        submitted += int(counters.get("serve_submitted", 0) or 0)
+        completed += int(counters.get("serve_completed", 0) or 0)
+        requeued += int(counters.get("serve_requeued", 0) or 0)
+        resizes += int(counters.get("serve_resizes", 0) or 0)
+        failed += int(counters.get("serve_failed", 0) or 0)
+    if not seen:
+        return None
+    return {
+        # Every outcome leaves the backlog — a failed request must not
+        # read as depth forever.
+        "depth": max(submitted - completed - requeued - failed, 0),
+        "submitted": submitted,
+        "completed": completed,
+        "requeued": requeued,
+        "resizes": resizes,
+        "failed": failed,
+    }
+
+
+def format_serve_status(status: dict | None) -> str | None:
+    """`[SERVE depth=3 — 17 done]` while requests are in flight; the
+    quieter `serve idle (17 done)` once drained; requeued work
+    (preemption) and elastic resizes ride along. None when the run
+    never served."""
+    if not status:
+        return None
+    tail = f"{status['completed']} done"
+    if status.get("failed"):
+        tail += f", {status['failed']} failed"
+    if status["requeued"]:
+        tail += f", {status['requeued']} requeued"
+    if status["resizes"]:
+        tail += f", {status['resizes']} resize(s)"
+    if status["depth"]:
+        return f"[SERVE depth={status['depth']} — {tail}]"
+    return f"serve idle ({tail})"
+
+
 def wire_status(directory) -> list[str]:
     """The run's active wire-precision mode(s) (docs/PERF.md "Wire
     precision"), annotation-sourced from the telemetry rank streams in

@@ -174,6 +174,8 @@ def _classify_json(doc: dict) -> str | None:
         POSTMORTEM_SCHEMA,
     )
 
+    from rocm_mpi_tpu.serving.bins import BIN_MANIFEST_SCHEMA
+
     named = {
         SUMMARY_SCHEMA: "telemetry summary",
         HEARTBEAT_SCHEMA: "health heartbeat sidecar",
@@ -181,6 +183,7 @@ def _classify_json(doc: dict) -> str | None:
         BUNDLE_SCHEMA: "health post-mortem bundle",
         FINDINGS_SCHEMA: "graftlint findings artifact",
         BASELINE_SCHEMA: "graftlint baseline",
+        BIN_MANIFEST_SCHEMA: "serving bin manifest",
     }
     if doc.get("schema") in named:
         return named[doc["schema"]]
@@ -220,6 +223,10 @@ def _validate_classified(doc: dict, kind: str) -> list[str]:
         return validate_baseline_doc(doc)
     if kind == "perf budgets":
         return _validate_perf_budgets(doc)
+    if kind == "serving bin manifest":
+        from rocm_mpi_tpu.serving.bins import validate_manifest_doc
+
+        return validate_manifest_doc(doc)
     return []
 
 
@@ -228,6 +235,11 @@ def _validate_classified(doc: dict, kind: str) -> list[str]:
 # parallel package's jax-importing __init__). tests/test_wire.py pins
 # this tuple equal to parallel.wire.WIRE_MODES — drift fails loudly.
 _WIRE_MODES = ("f32", "bf16", "int8", "int8_delta")
+
+# Serving sidecar schema markers (rocm_mpi_tpu/serving/{queue,bins}.py
+# are stdlib-at-import on purpose — the validators import directly).
+# tests/test_serving.py pins this spelling against serving.queue.
+_SERVE_REQUEST_SCHEMA = "rmt-serve-request"
 
 
 def _validate_perf_budgets(doc: dict) -> list[str]:
@@ -239,6 +251,25 @@ def _validate_perf_budgets(doc: dict) -> list[str]:
     for name, v in doc["budgets"].items():
         if not isinstance(v, (int, float)) or isinstance(v, bool) or v <= 0:
             problems.append(f"budget {name!r} is not a positive number")
+    serving = doc.get("serving")
+    if serving is not None:
+        if not isinstance(serving, dict):
+            problems.append("'serving' block is not an object")
+        else:
+            tol = serving.get("batch_tolerance")
+            if not isinstance(tol, (int, float)) or isinstance(tol, bool) \
+                    or tol < 1.0:
+                problems.append(
+                    f"serving batch_tolerance {tol!r} must be >= 1.0 "
+                    "(a B-lane program can never move fewer bytes than "
+                    "B x one lane)"
+                )
+            floor = serving.get("occupancy_floor")
+            if not isinstance(floor, (int, float)) \
+                    or isinstance(floor, bool) or not 0.0 < floor <= 1.0:
+                problems.append(
+                    f"serving occupancy_floor {floor!r} outside (0, 1]"
+                )
     wire = doc.get("wire")
     if wire is None:
         return problems
@@ -343,6 +374,13 @@ def check_schema(paths) -> list[str]:
                     continue
                 if doc.get("schema") == ELASTIC_SCHEMA:
                     for p in _validate_elastic_record(doc):
+                        problems.append(f"{raw}:{i}: {p}")
+                elif doc.get("schema") == _SERVE_REQUEST_SCHEMA:
+                    from rocm_mpi_tpu.serving.queue import (
+                        validate_request_record,
+                    )
+
+                    for p in validate_request_record(doc):
                         problems.append(f"{raw}:{i}: {p}")
                 elif doc.get("kind") == "event":
                     for p in _validate_event_record(doc):

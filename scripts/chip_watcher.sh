@@ -133,6 +133,18 @@ archive_telemetry() {
   # telemetry sink — archive them under per-drill names so the shrink→
   # grow and preempted-eviction decision trails survive a flap, and so
   # lint.sh's schema glob (docs/telemetry_r*/elastic*.jsonl) gates them.
+  # Serving sidecars (docs/SERVING.md): the serve smoke's bin manifest
+  # and request trace — the compile-amortization evidence (programs ==
+  # bins, steady_state == 0) for this burst's backend. Archived under
+  # docs/telemetry_r5/ where lint.sh's serve-manifest*/serve-requests*
+  # schema globs gate them.
+  local s
+  for s in output/serve_smoke/serve-manifest.json \
+           output/serve_smoke/serve-requests.jsonl; do
+    [ -s "$s" ] || continue
+    mkdir -p docs/telemetry_r5
+    cp -p "$s" docs/telemetry_r5/ && found=$((found + 1))
+  done
   local e ename
   for e in output/*/elastic.jsonl; do
     [ -s "$e" ] || continue
@@ -188,6 +200,21 @@ run_tuning_search() {
   timeout -k 15 900 python -m rocm_mpi_tpu.tuning search \
     --shape 252x252 --cache output/tuning/cache.json \
     || echo "[watcher] tuning search rc=$? (continuing; cache keeps prior winners)"
+}
+
+run_serve_smoke() {
+  # Bounded multi-tenant serve smoke (docs/SERVING.md): a deterministic
+  # heterogeneous synthetic trace through apps/serve.py on the real
+  # backend — proves the batched program classes compile and the
+  # steady-state contract holds on-chip, and banks the bin manifest +
+  # request trace (archive_telemetry copies them; lint.sh schema-checks
+  # the archived copies). Small trace + timeout so a wedged backend
+  # cannot eat the window.
+  echo "[watcher] serve smoke (batched multi-tenant trace)"
+  timeout -k 15 600 python apps/serve.py \
+    --synthetic 12 --seed 7 --nt-max 64 --max-width 4 \
+    --out output/serve_smoke \
+    || echo "[watcher] serve smoke rc=$? (continuing)"
 }
 
 group_log() { echo "docs/tpu_tier_${1}_r5.txt"; }
@@ -277,6 +304,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     bash scripts/run_chip_queue.sh
     queue_rc=$?
     run_tuning_search
+    run_serve_smoke
     run_tier_groups
     archive_telemetry
     if headline_done && [ "$queue_rc" -eq 0 ] && tier_done; then

@@ -74,6 +74,17 @@ health_records+=(
   docs/telemetry_r*/manifest-*.json
   docs/telemetry_r*/telemetry-rank*.jsonl
 )
+# Serving sidecars (docs/SERVING.md): the bin manifest + request trace
+# apps/serve.py banks per run (and chip_watcher archives per burst).
+# A drifted writer bricks the schema-checked serving accounting the
+# next time anyone audits a trace's compile count — catch it here.
+# (wildcard-bearing paths only, same nullglob discipline as above)
+health_records+=(
+  output/*/serve-manifest*.json
+  output/*/serve-requests*.jsonl
+  docs/telemetry_r*/serve-manifest*.json
+  docs/telemetry_r*/serve-requests*.jsonl
+)
 # The graftlint artifacts: the findings document stage 1 just banked
 # (plus any chip_watcher-archived copies) and the committed baseline.
 # A drifted reporter or a hand-mangled baseline must fail HERE, not

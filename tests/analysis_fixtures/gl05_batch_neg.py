@@ -1,0 +1,24 @@
+"""GL05 true negatives (batch-axis vocabulary, docs/SERVING.md):
+reductions over the 'batch' lane axis are legitimate cross-lane
+diagnostics, and permutes over a SPACE axis are the halo exchange
+working as designed."""
+
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from rocm_mpi_tpu.utils.compat import shard_map
+
+
+def build(devices, x):
+    mesh = Mesh(np.array(devices).reshape(2, -1), ("batch", "gx"))
+
+    def body(block):
+        lane_sum = lax.psum(block, "batch")  # cross-lane reduction: fine
+        ghost = lax.ppermute(block, "gx", [(0, 1)])  # space halo: fine
+        return lane_sum + ghost
+
+    return shard_map(
+        body, mesh, in_specs=(P("batch", "gx"),),
+        out_specs=P("batch", "gx"), check_vma=False,
+    )(x)

@@ -1,0 +1,24 @@
+"""GL05 true positive (batch-axis vocabulary, docs/SERVING.md): a
+halo/permutation collective issued over the multi-tenant 'batch' lane
+axis — the axis IS in the mesh vocabulary, but permuting over it moves
+one tenant's state into another's lane."""
+
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from rocm_mpi_tpu.utils.compat import shard_map
+
+
+def build(devices, x):
+    mesh = Mesh(np.array(devices).reshape(2, -1), ("batch", "gx"))
+
+    def body(block):
+        # GL05: ppermute over the lane axis = cross-tenant leak.
+        leaked = lax.ppermute(block, "batch", [(0, 1)])
+        return leaked
+
+    return shard_map(
+        body, mesh, in_specs=(P("batch", "gx"),),
+        out_specs=P("batch", "gx"), check_vma=False,
+    )(x)

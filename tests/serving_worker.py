@@ -1,0 +1,97 @@
+"""Gloo-real rank worker for the multi-tenant serving drill
+(tests/test_serving.py drives it via parallel.launcher.spawn_ranks).
+
+Each rank joins the jax.distributed cluster (one virtual CPU device per
+rank — the space mesh spans the ranks), builds the IDENTICAL
+deterministic heterogeneous request trace, and runs it through
+SimulationService. Scheduling is a pure function of the trace
+(serving/bins.py's determinism contract), so every rank plans the same
+batches and the batched collectives never diverge (the GL08 hazard
+class). The drill's pins: the trace compiles exactly len(bins)
+programs, and `compiles.steady_state == 0` after the program classes
+exist (a second identical trace compiles NOTHING).
+"""
+
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+from rocm_mpi_tpu.utils.backend import set_cpu_device_count
+
+jax.config.update("jax_platforms", "cpu")
+set_cpu_device_count(1)  # one device per rank: the space mesh IS the ranks
+jax.config.update("jax_enable_x64", True)
+
+
+def trace(seed_tag: str):
+    from rocm_mpi_tpu.serving.queue import Request
+
+    reqs = []
+    # >= 3 bins: two diffusion shape classes + one wave class; shapes
+    # divide the 2-rank (2, 1) space mesh. Mixed step counts exercise
+    # the per-lane masking inside shared batches.
+    mix = [
+        ("diffusion", (16, 16), 5), ("diffusion", (16, 16), 7),
+        ("diffusion", (24, 24), 6), ("wave", (16, 16), 5),
+        ("diffusion", (16, 16), 3), ("wave", (16, 16), 6),
+    ]
+    for i, (wl, shape, nt) in enumerate(mix):
+        reqs.append(Request(
+            request_id=f"{seed_tag}-{i:03d}", workload=wl,
+            global_shape=shape, dtype="f64", nt=nt,
+            ic_scale=1.0 + 0.05 * i,
+        ))
+    return reqs
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.parse_args()
+
+    from rocm_mpi_tpu.parallel.distributed import (
+        maybe_initialize_distributed,
+        process_id,
+    )
+
+    maybe_initialize_distributed()
+    from rocm_mpi_tpu.utils.backend import enable_persistent_cache
+
+    enable_persistent_cache()
+
+    from rocm_mpi_tpu.telemetry import compiles
+
+    compiles.install()
+
+    from rocm_mpi_tpu.serving.service import ServeConfig, SimulationService
+
+    svc = SimulationService(config=ServeConfig(max_width=4))
+    report = svc.run_trace(trace("gloo"))
+    assert report.served == 6, report.served
+    assert report.failed == 0, report.failed
+    # exactly len(bins) program classes compiled for the trace
+    n_bins = report.n_bins
+    n_programs = report.n_programs
+
+    # Steady state: the identical mix again (fresh ids) compiles ZERO
+    # new programs — the bin cache is the compile amortizer.
+    before = compiles.snapshot()["totals"]["backend_compiles"]
+    report2 = svc.run_trace(trace("gloo2"))
+    after = compiles.snapshot()["totals"]["backend_compiles"]
+    assert report2.served == 6, report2.served
+    steady = report2.compiles["steady_state"]
+
+    print(
+        f"SERVING_WORKER_DONE rank={process_id()} bins={n_bins} "
+        f"programs={n_programs} steady={steady} "
+        f"second_trace_compiles={after - before}",
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

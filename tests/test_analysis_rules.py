@@ -604,6 +604,40 @@ def test_gl04_wire_seam_repo_clean():
     assert [f for f in findings if f.rule == "GL04"] == []
 
 
+def test_gl05_batch_axis_true_positive():
+    """A halo/permutation collective over the multi-tenant 'batch' lane
+    axis fires even though 'batch' is in the mesh vocabulary — lanes
+    are independent tenants (docs/SERVING.md)."""
+    findings = lint_fixture("gl05_batch_pos.py")
+    live = [f for f in findings if not f.suppressed]
+    assert live and all(f.rule == "GL05" for f in live)
+    assert all("lane axis" in f.message for f in live)
+
+
+def test_gl05_batch_axis_true_negative():
+    """psum reductions over 'batch' (cross-lane diagnostics) and
+    ppermute over a SPACE axis stay clean."""
+    assert lint_fixture("gl05_batch_neg.py") == []
+
+
+def test_gl05_batch_axis_repo_clean():
+    """The shipped batched machinery (mesh/halo/serving) never permutes
+    over the lane axis — the batch rule stays zero-findings on it."""
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    for rel in (
+        "rocm_mpi_tpu/parallel/mesh.py",
+        "rocm_mpi_tpu/parallel/halo.py",
+        "rocm_mpi_tpu/parallel/deep_halo.py",
+        "rocm_mpi_tpu/serving/service.py",
+        "rocm_mpi_tpu/models/diffusion.py",
+        "rocm_mpi_tpu/models/wave.py",
+        "rocm_mpi_tpu/models/swe.py",
+    ):
+        path = repo / rel
+        findings = lint_source(path.read_text(), str(path))
+        assert [f for f in findings if f.rule == "GL05"] == [], rel
+
+
 def test_lint_file_cache_returns_fresh_copies(tmp_path):
     """Mutating a returned Finding must not poison later cache hits, and
     display_path must not be served from another label's entry."""

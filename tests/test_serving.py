@@ -1,0 +1,820 @@
+"""Multi-tenant batched serving (docs/SERVING.md; ROADMAP item 1).
+
+Covers the space×batch mesh layer (parallel.mesh.BatchedGrid +
+exchange_halo_batched + the batched deep sweep), the per-lane bitwise
+parity contract of every model's batched_advance_fn, the bin
+scheduler's key/packing determinism, the service driver end to end
+(program count == len(bins), compiles.steady_state == 0, session
+checkpoint multiplexing, preemption requeue, queue-driven elasticity),
+the batched traffic audit + its doctored over-padded fixture, the
+serve-request/bin-manifest schema gate, and the monitor's SERVE badge.
+
+The acceptance drill: a heterogeneous 50-request trace through
+apps/serve.py compiles exactly len(bins) programs with
+`compiles.steady_state == 0`, every request's result bitwise-equal to
+its standalone single-run twin. The gloo-real 2-rank edition drives
+tests/serving_worker.py via spawn_ranks.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+from rocm_mpi_tpu.config import DiffusionConfig  # noqa: E402
+from rocm_mpi_tpu.models import HeatDiffusion  # noqa: E402
+from rocm_mpi_tpu.models.swe import SWEConfig, ShallowWater  # noqa: E402
+from rocm_mpi_tpu.models.wave import AcousticWave, WaveConfig  # noqa: E402
+from rocm_mpi_tpu.parallel import mesh as pmesh  # noqa: E402
+from rocm_mpi_tpu.serving import bins as sbins  # noqa: E402
+from rocm_mpi_tpu.serving.queue import (  # noqa: E402
+    Request,
+    RequestQueue,
+    load_trace,
+    request_from_record,
+    request_to_record,
+    validate_request_record,
+)
+from rocm_mpi_tpu.serving.service import (  # noqa: E402
+    ServeConfig,
+    SimulationService,
+)
+from rocm_mpi_tpu.telemetry import compiles  # noqa: E402
+
+
+def _put(arr, sharding):
+    return jax.device_put(np.asarray(arr), sharding)
+
+
+# ---------------------------------------------------------------------------
+# The space×batch mesh layer
+# ---------------------------------------------------------------------------
+
+
+def test_batched_grid_shapes_and_specs():
+    bg = pmesh.init_batched_grid(
+        6, 16, 16, space_dims=(1, 2), batch_dims=2,
+        devices=jax.devices()[:4],
+    )
+    assert bg.axis_names == ("batch", "gx", "gy")
+    assert bg.batch == 6 and bg.batch_dims == 2 and bg.local_batch == 3
+    assert bg.global_shape == (6, 16, 16)
+    assert bg.local_shape == (3, 16, 8)
+    assert tuple(bg.spec) == ("batch", "gx", "gy")
+    assert tuple(bg.aux_spec) == ("gx", "gy")
+    assert bg.space.dims == (1, 2)
+
+
+def test_batched_grid_validation():
+    with pytest.raises(ValueError, match="not divisible"):
+        pmesh.init_batched_grid(3, 16, 16, space_dims=(1, 1),
+                                batch_dims=2, devices=jax.devices()[:2])
+    with pytest.raises(ValueError, match="devices"):
+        pmesh.init_batched_grid(4, 16, 16, space_dims=(2, 2),
+                                batch_dims=4, devices=jax.devices())
+
+
+def test_rebuild_batched_for_mesh_grows_rows():
+    bg = pmesh.init_batched_grid(4, 16, 16, space_dims=(1, 1),
+                                 batch_dims=1, devices=jax.devices()[:1])
+    grown = pmesh.rebuild_batched_for_mesh(
+        bg, batch_dims=2, devices=jax.devices()[:2]
+    )
+    assert grown.batch_dims == 2 and grown.batch == 4
+    assert grown.space.global_shape == bg.space.global_shape
+
+
+def test_exchange_halo_batched_rejects_stateful_wire():
+    from rocm_mpi_tpu.parallel.halo import exchange_halo_batched
+
+    bg = pmesh.init_batched_grid(2, 16, 16, space_dims=(1, 1),
+                                 batch_dims=1, devices=jax.devices()[:1])
+    with pytest.raises(ValueError, match="stateful"):
+        exchange_halo_batched(jnp.zeros((2, 16, 16)), bg,
+                              wire_mode="int8")
+
+
+# ---------------------------------------------------------------------------
+# Per-lane bitwise parity: batched advance == N standalone runs
+# ---------------------------------------------------------------------------
+
+
+LANE_STEPS = [5, 3, 5, 1]
+
+
+def test_diffusion_batched_parity_heterogeneous_steps():
+    """The serving contract: every lane of a (space-sharded, lane-
+    sharded) batched advance is bitwise-equal to a standalone run of
+    its own length — the per-lane freeze select is exact."""
+    B, n = 4, max(LANE_STEPS)
+    cfg = DiffusionConfig(global_shape=(16, 16), nt=8, warmup=0,
+                          dtype="f64", dims=(1, 2))
+    m = HeatDiffusion(cfg, devices=jax.devices()[:2])
+    adv_b, bg = m.batched_advance_fn(batch=B, batch_dims=2)
+    T0, Cp = m.init_state()
+    lanes = np.stack(
+        [np.asarray(T0) * (1 + 0.1 * i) for i in range(B)]
+    )
+    out = np.asarray(adv_b(
+        _put(lanes, bg.sharding),
+        _put(Cp, bg.aux_sharding),
+        _put(np.array(LANE_STEPS, np.int32), bg.batch_sharding),
+        n,
+    ))
+    adv1 = m.advance_fn("shard")
+    for i in range(B):
+        ref = np.asarray(adv1(
+            _put(lanes[i], m.grid.sharding), Cp, LANE_STEPS[i]
+        ))
+        assert np.array_equal(out[i], ref), f"lane {i}"
+
+
+def test_wave_batched_parity():
+    B, n = 4, max(LANE_STEPS)
+    cfg = WaveConfig(global_shape=(16, 16), nt=8, warmup=0,
+                     dtype="f64", dims=(1, 2))
+    w = AcousticWave(cfg, devices=jax.devices()[:2])
+    adv_b, bg = w.batched_advance_fn(batch=B, batch_dims=2)
+    U0, _, C2 = w.init_state()
+    ul = np.stack([np.asarray(U0) * (1 + 0.1 * i) for i in range(B)])
+    oU, oUp = adv_b(
+        _put(ul, bg.sharding), _put(ul.copy(), bg.sharding),
+        _put(C2, bg.aux_sharding),
+        _put(np.array(LANE_STEPS, np.int32), bg.batch_sharding), n,
+    )
+    oU, oUp = np.asarray(oU), np.asarray(oUp)
+    adv1 = w.advance_fn("shard")
+    for i in range(B):
+        rU, rUp = adv1(
+            _put(ul[i], w.grid.sharding),
+            _put(ul[i].copy(), w.grid.sharding), C2, LANE_STEPS[i],
+        )
+        assert np.array_equal(oU[i], np.asarray(rU)), f"lane {i} U"
+        assert np.array_equal(oUp[i], np.asarray(rUp)), f"lane {i} U⁻"
+
+
+def test_swe_batched_parity():
+    B, n = 4, max(LANE_STEPS)
+    cfg = SWEConfig(global_shape=(16, 16), nt=8, warmup=0,
+                    dtype="f64", dims=(1, 2))
+    s = ShallowWater(cfg, devices=jax.devices()[:2])
+    adv_b, bg = s.batched_advance_fn(batch=B, batch_dims=2)
+    h0, _ = s.init_state()
+    Mus = s.face_masks()
+    hl = np.stack([np.asarray(h0) * (1 + 0.1 * i) for i in range(B)])
+    zeros_b = np.zeros((B,) + cfg.global_shape)
+    oh, ous = adv_b(
+        _put(hl, bg.sharding),
+        tuple(_put(zeros_b, bg.sharding) for _ in range(2)),
+        tuple(_put(M, bg.aux_sharding) for M in Mus),
+        _put(np.array(LANE_STEPS, np.int32), bg.batch_sharding), n,
+    )
+    oh = np.asarray(oh)
+    adv1 = s.advance_fn("shard")
+    for i in range(B):
+        rh, rus = adv1(
+            _put(hl[i], s.grid.sharding),
+            tuple(_put(np.zeros(cfg.global_shape), s.grid.sharding)
+                  for _ in range(2)),
+            Mus, LANE_STEPS[i],
+        )
+        assert np.array_equal(oh[i], np.asarray(rh)), f"lane {i} h"
+        for a in range(2):
+            assert np.array_equal(
+                np.asarray(ous[a])[i], np.asarray(rus[a])
+            ), f"lane {i} u{a}"
+
+
+def test_diffusion_batched_deep_parity():
+    """The batched deep sweep (make_deep_sweep on a BatchedGrid, jnp
+    local form) matches the standalone jnp deep schedule per lane."""
+    import functools
+
+    from jax import lax
+
+    from rocm_mpi_tpu.parallel.deep_halo import make_deep_sweep
+
+    B = 4
+    cfg = DiffusionConfig(global_shape=(16, 16), nt=8, warmup=0,
+                          dtype="f64", dims=(1, 2))
+    m = HeatDiffusion(cfg, devices=jax.devices()[:2])
+    adv_b, bg, k = m.batched_deep_advance_fn(batch=B, batch_dims=2,
+                                             block_steps=4)
+    assert k == 4
+    T0, Cp = m.init_state()
+    lanes = np.stack(
+        [np.asarray(T0) * (1 + 0.1 * i) for i in range(B)]
+    )
+    out = np.asarray(adv_b(
+        _put(lanes, bg.sharding), _put(Cp, bg.aux_sharding), 8
+    ))
+
+    sched = make_deep_sweep(m.grid, 4, cfg.lam, cfg.jax_dtype(cfg.dt),
+                            cfg.spacing, local_form="jnp")
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def adv1(T, Cp_, ns):
+        Cm = sched.prepare(Cp_)
+        return lax.fori_loop(
+            0, ns // 4, lambda _, x: sched.sweep(x, Cm), T
+        )
+
+    for i in range(B):
+        ref = np.asarray(adv1(_put(lanes[i], m.grid.sharding), Cp, 8))
+        assert np.array_equal(out[i], ref), f"deep lane {i}"
+
+
+def test_batched_deep_rejects_stateful_wire():
+    from rocm_mpi_tpu.parallel.deep_halo import make_deep_sweep
+
+    bg = pmesh.init_batched_grid(2, 16, 16, space_dims=(1, 1),
+                                 batch_dims=1, devices=jax.devices()[:1])
+    with pytest.raises(ValueError, match="stateful"):
+        make_deep_sweep(bg, 4, 1.0, 0.1, (0.5, 0.5), wire_mode="int8")
+
+
+def test_batched_advance_rejects_pallas_variants():
+    cfg = DiffusionConfig(global_shape=(16, 16), nt=8, warmup=0,
+                          dtype="f64", dims=(1, 1))
+    m = HeatDiffusion(cfg, devices=jax.devices()[:1])
+    with pytest.raises(ValueError, match="single-lane"):
+        m.batched_advance_fn(batch=2, variant="perf")
+
+
+# ---------------------------------------------------------------------------
+# Bin keys, buckets, packing
+# ---------------------------------------------------------------------------
+
+
+def test_bin_key_round_trip():
+    req = Request(request_id="r1", workload="swe",
+                  global_shape=(24, 48), dtype="f32", nt=37,
+                  physics=(("g", 9.81), ("H0", 2.0)),
+                  wire_mode="bf16")
+    key = sbins.bin_key(req)
+    assert key.steps_bucket == 64
+    assert key.physics == (("H0", 2.0), ("g", 9.81))  # sorted
+    parsed = sbins.BinKey.parse(key.key_str())
+    assert parsed == key
+
+
+def test_bin_key_physics_order_cannot_split_a_bin():
+    a = Request(request_id="a", physics=(("lam", 2.0), ("cp0", 3.0)))
+    b = Request(request_id="b", physics=(("cp0", 3.0), ("lam", 2.0)))
+    assert sbins.bin_key(a) == sbins.bin_key(b)
+
+
+def test_steps_bucket():
+    assert [sbins.steps_bucket(n) for n in (1, 2, 3, 8, 9, 64, 65)] == \
+        [1, 2, 4, 8, 16, 64, 128]
+    with pytest.raises(ValueError):
+        sbins.steps_bucket(0)
+
+
+@pytest.mark.parametrize("n,max_w,floor,want", [
+    (1, 8, 0.5, [1]),
+    (2, 8, 0.5, [2]),
+    (3, 8, 0.5, [4]),
+    (5, 8, 0.5, [8]),
+    (9, 8, 0.5, [8, 1]),
+    (5, 8, 0.8, [4, 1]),  # the split rule: 5/8 < 0.8 -> narrower class
+    (13, 4, 0.5, [4, 4, 4, 1]),
+])
+def test_plan_batches(n, max_w, floor, want):
+    assert sbins.plan_batches(n, max_w, floor) == want
+    # determinism: same inputs, same plan
+    assert sbins.plan_batches(n, max_w, floor) == want
+
+
+def test_bin_stats_waste_accounting():
+    st = sbins.BinStats(key=sbins.bin_key(Request(request_id="x")))
+    st.note_batch(4, [6, 3, 6], 6)  # one idle lane + one short lane
+    assert st.occupancy == 0.75
+    assert st.padding_waste == pytest.approx(1 - 15 / 24)
+    st.note_batch(1, [6], 6, split=True)
+    assert st.splits == 1
+
+
+# ---------------------------------------------------------------------------
+# Request schema + queue
+# ---------------------------------------------------------------------------
+
+
+def test_request_record_round_trip(tmp_path):
+    req = Request(request_id="rt-1", workload="wave",
+                  global_shape=(16, 16), dtype="f64", nt=9,
+                  physics=(("c0", 2.0),), ic_scale=1.25,
+                  session="s1")
+    rec = request_to_record(req)
+    assert validate_request_record(rec) == []
+    assert request_from_record(rec) == req
+    path = tmp_path / "trace.jsonl"
+    path.write_text(json.dumps(rec) + "\n\n" + json.dumps(rec) + "\n")
+    assert load_trace(path) == [req, req]
+
+
+def test_request_validation():
+    with pytest.raises(ValueError, match="workload"):
+        Request(request_id="x", workload="plasma")
+    with pytest.raises(ValueError, match="nt"):
+        Request(request_id="x", nt=0)
+    with pytest.raises(ValueError, match="session"):
+        Request(request_id="x", resume=True)
+    bad = request_to_record(Request(request_id="ok"))
+    bad["nt"] = -2
+    assert any("nt" in p for p in validate_request_record(bad))
+
+
+def test_queue_fifo_requeue_front():
+    q = RequestQueue()
+    t1 = q.submit(Request(request_id="a"))
+    t2 = q.submit(Request(request_id="b"))
+    got = q.pop_pending()
+    assert [t.request.request_id for t in got] == ["a", "b"]
+    q.requeue([t1])
+    t3 = q.submit(Request(request_id="c"))
+    got2 = q.pop_pending()
+    assert [t.request.request_id for t in got2] == ["a", "c"]
+    assert q.counters()["requeued"] == 1
+    del t2, t3
+
+
+# ---------------------------------------------------------------------------
+# The service driver
+# ---------------------------------------------------------------------------
+
+
+def _mixed_trace(tag: str, scale0: float = 1.0):
+    mix = [
+        ("diffusion", (16, 16), 5), ("diffusion", (16, 16), 7),
+        ("diffusion", (24, 24), 6), ("wave", (16, 16), 5),
+        ("swe", (16, 16), 4), ("diffusion", (16, 16), 3),
+    ]
+    return [
+        Request(request_id=f"{tag}-{i}", workload=wl, global_shape=sh,
+                dtype="f64", nt=nt, ic_scale=scale0 + 0.05 * i)
+        for i, (wl, sh, nt) in enumerate(mix)
+    ]
+
+
+def test_service_trace_bins_programs_steady_and_parity():
+    """The acceptance shape, in-process: a heterogeneous trace (3 shape
+    classes, mixed physics/steps) compiles exactly len(bins) programs,
+    compiles.steady_state == 0, a repeat trace compiles NOTHING, and
+    every result is bitwise-equal to its standalone twin."""
+    compiles.install()
+    svc = SimulationService(config=ServeConfig(max_width=4))
+    trace = _mixed_trace("e2e")
+    tickets = [svc.queue.submit(r) for r in trace]
+    report = svc._drain_all()
+    assert report.served == len(trace) and report.failed == 0
+    assert report.n_programs == len(report.programs)
+    assert report.n_programs == report.n_bins + sum(
+        max(len(st.widths) - 1, 0) for st in report.bins.values()
+    )
+    assert report.compiles["steady_state"] == 0
+
+    # steady state: the identical mix again compiles zero new programs
+    before = compiles.snapshot()["totals"]["backend_compiles"]
+    report2 = svc.run_trace(_mixed_trace("e2e2"))
+    after = compiles.snapshot()["totals"]["backend_compiles"]
+    assert after == before, "steady-state recompile"
+    assert report2.compiles["steady_state"] == 0
+
+    # bitwise parity vs standalone twins (lane 0 and lane 1 share a bin)
+    r0 = tickets[0].result(timeout=5)
+    cfg = DiffusionConfig(global_shape=(16, 16), nt=8, warmup=0,
+                          dtype="f64", dims=(1, 1))
+    m = HeatDiffusion(cfg, devices=jax.devices()[:1])
+    T0, Cp = m.init_state()
+    adv = m.advance_fn("shard")
+    ref = np.asarray(adv(
+        jnp.asarray(np.asarray(T0) * trace[0].ic_scale), Cp,
+        trace[0].nt,
+    ))
+    assert np.array_equal(r0[0], ref)
+
+    wv = tickets[3].result(timeout=5)
+    wcfg = WaveConfig(global_shape=(16, 16), nt=8, warmup=0,
+                      dtype="f64", dims=(1, 1))
+    w = AcousticWave(wcfg, devices=jax.devices()[:1])
+    U0, _, C2 = w.init_state()
+    U0s = np.asarray(U0) * trace[3].ic_scale
+    wadv = w.advance_fn("shard")
+    rU, rUp = wadv(jnp.asarray(U0s), jnp.asarray(U0s.copy()), C2,
+                   trace[3].nt)
+    assert np.array_equal(wv[0], np.asarray(rU))
+    assert np.array_equal(wv[1], np.asarray(rUp))
+
+
+def test_service_manifest_schema_and_cli_gate(tmp_path):
+    svc = SimulationService(config=ServeConfig(max_width=4))
+    svc.run_trace(_mixed_trace("man"))
+    path = tmp_path / "serve-manifest.json"
+    doc = svc.write_manifest(path)
+    assert sbins.validate_manifest_doc(doc) == []
+    trace_path = tmp_path / "serve-requests.jsonl"
+    with open(trace_path, "w") as fh:
+        for r in _mixed_trace("man"):
+            fh.write(json.dumps(request_to_record(r)) + "\n")
+
+    from rocm_mpi_tpu.telemetry.regress import check_schema
+
+    assert check_schema([path, trace_path]) == []
+    # doctored manifest: occupancy outside [0,1] must fail the gate
+    doc["bins"][0]["occupancy"] = 1.7
+    bad = tmp_path / "bad-manifest.json"
+    bad.write_text(json.dumps(doc))
+    assert any("occupancy" in p for p in check_schema([bad]))
+
+
+def test_service_unknown_physics_fails_request_loudly():
+    svc = SimulationService(config=ServeConfig(max_width=2))
+    t = svc.queue.submit(Request(
+        request_id="bad-phys", workload="diffusion",
+        global_shape=(16, 16), dtype="f64", nt=2,
+        physics=(("viscosity", 1.0),),
+    ))
+    report = svc._drain_all()
+    assert report.failed == 1 and report.served == 0
+    with pytest.raises(RuntimeError, match="physics"):
+        t.result(timeout=5)
+
+
+def test_service_session_checkpoint_multiplex_and_resume(tmp_path):
+    """Per-session checkpoints ride the PR-6 manifest machinery: a
+    served session banks a step-nt checkpoint whose manifest meta
+    carries the request id; a resume request continues from it and the
+    two-leg result is bitwise-equal to one uninterrupted run."""
+    from rocm_mpi_tpu.utils import checkpoint as ckpt
+
+    sessions = tmp_path / "sessions"
+    svc = SimulationService(config=ServeConfig(
+        max_width=2, sessions_dir=str(sessions),
+    ))
+    leg1 = Request(request_id="leg1", workload="diffusion",
+                   global_shape=(16, 16), dtype="f64", nt=4,
+                   ic_scale=1.1, session="sess-a")
+    t1 = svc.queue.submit(leg1)
+    svc._drain_all()
+    assert t1.result(timeout=5) is not None
+    sdir = sessions / "sess-a"
+    assert ckpt.latest_valid_step(sdir) == 4
+    manifest = ckpt.read_manifest(sdir, 4)
+    assert manifest["meta"]["extra"]["serving"]["request_id"] == "leg1"
+
+    # leg 2: resume to nt=9 (5 more steps)
+    leg2 = Request(request_id="leg2", workload="diffusion",
+                   global_shape=(16, 16), dtype="f64", nt=9,
+                   ic_scale=1.1, session="sess-a", resume=True)
+    t2 = svc.queue.submit(leg2)
+    svc._drain_all()
+    out = t2.result(timeout=5)
+    assert t2.start_step == 4 and t2.steps_run == 5
+
+    cfg = DiffusionConfig(global_shape=(16, 16), nt=16, warmup=0,
+                          dtype="f64", dims=(1, 1))
+    m = HeatDiffusion(cfg, devices=jax.devices()[:1])
+    T0, Cp = m.init_state()
+    adv = m.advance_fn("shard")
+    ref = np.asarray(adv(jnp.asarray(np.asarray(T0) * 1.1), Cp, 9))
+    assert np.array_equal(out[0], ref)
+
+
+def test_resume_past_nt_fails_that_lane_only(tmp_path):
+    """A session already past the requested nt has no checkpoint to
+    hand back: the lane fails loudly — and ONLY that lane; a valid
+    co-batched neighbor still gets served (tenant isolation)."""
+    sessions = tmp_path / "sessions"
+    svc = SimulationService(config=ServeConfig(
+        max_width=2, sessions_dir=str(sessions),
+    ))
+    svc.run_trace([Request(
+        request_id="seed", workload="diffusion", global_shape=(16, 16),
+        dtype="f64", nt=4, session="sess-b",
+    )])
+    bad = svc.queue.submit(Request(
+        request_id="past", workload="diffusion", global_shape=(16, 16),
+        dtype="f64", nt=2, session="sess-b", resume=True,
+    ))
+    good = svc.queue.submit(Request(
+        request_id="fresh", workload="diffusion", global_shape=(16, 16),
+        dtype="f64", nt=2,
+    ))
+    report = svc._drain_all()
+    assert report.failed == 1
+    with pytest.raises(RuntimeError, match="already at step"):
+        bad.result(timeout=5)
+    assert good.result(timeout=5) is not None
+
+
+def test_requeued_ticket_result_returns_none_promptly():
+    q = RequestQueue()
+    t = q.submit(Request(request_id="r"))
+    q.pop_pending()
+    q.requeue([t])
+    # No timeout burn: the requeue wakes waiters immediately.
+    assert t.result(timeout=5) is None
+    assert t.state == "requeued"
+    # Re-popped by the next drain: the wait re-arms for the real result.
+    q.pop_pending()
+    assert t.state == "running" and not t.done()
+
+
+def test_drain_survives_non_value_batch_errors(monkeypatch):
+    """A non-ValueError batch failure (e.g. checkpoint corruption is a
+    RuntimeError) fails ITS tickets and lets later batches serve —
+    never strands popped tickets in 'running' or kills the drain."""
+    svc = SimulationService(config=ServeConfig(max_width=1))
+    orig = svc._execute_batch
+    calls = {"n": 0}
+
+    def flaky(key, tickets, width, split):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("storage bit rot")
+        return orig(key, tickets, width, split)
+
+    monkeypatch.setattr(svc, "_execute_batch", flaky)
+    t1 = svc.queue.submit(Request(
+        request_id="x1", workload="diffusion", global_shape=(16, 16),
+        dtype="f64", nt=2,
+    ))
+    t2 = svc.queue.submit(Request(
+        request_id="x2", workload="diffusion", global_shape=(16, 16),
+        dtype="f64", nt=3,
+    ))
+    report = svc._drain_all()
+    assert report.failed == 1 and report.served == 1
+    with pytest.raises(RuntimeError, match="bit rot"):
+        t1.result(timeout=5)
+    assert t2.result(timeout=5) is not None
+
+
+def test_service_preemption_requeues_and_reports(monkeypatch):
+    """A preemption notice at a batch boundary stops dispatch; the
+    unserved tickets are requeued (the scheduler's rc-75 signal)."""
+    svc = SimulationService(config=ServeConfig(max_width=1))
+    calls = {"n": 0}
+
+    def notice_after_first():
+        calls["n"] += 1
+        return calls["n"] > 1  # first batch runs, then the notice lands
+
+    monkeypatch.setattr(svc, "_preempt_requested", notice_after_first)
+    trace = [
+        Request(request_id=f"p{i}", workload="diffusion",
+                global_shape=(16, 16), dtype="f64", nt=2 + i)
+        for i in range(3)
+    ]
+    report = svc.run_trace(trace)
+    assert report.preempted
+    assert report.served == 1
+    assert report.requeued == 2
+    assert svc.queue.depth() == 2  # parked for the next service
+
+
+def test_service_elastic_grow_and_shrink():
+    """The first real ElasticPolicy consumer: a deep queue grows the
+    batch rows within the device budget (programs dropped, compile
+    window reopened); idle drains shrink back to min_ranks."""
+    from rocm_mpi_tpu.resilience.policy import ElasticPolicy
+
+    svc = SimulationService(config=ServeConfig(
+        max_width=4,
+        policy=ElasticPolicy(min_grow_interval_steps=0),
+        device_budget=lambda: 2,
+        grow_queue_depth=4,
+        idle_shrink_drains=2,
+    ))
+    for i in range(4):
+        svc.queue.submit(Request(
+            request_id=f"g{i}", workload="diffusion",
+            global_shape=(16, 16), dtype="f64", nt=3,
+        ))
+    assert svc.maybe_resize()
+    assert svc._batch_dims == 2
+    report = svc._drain_all()
+    assert report.served == 4
+    assert [e["event"] for e in svc._elastic] == ["serve.grow"]
+    # idle shrink: empty drains past the threshold fold the rows back
+    svc.drain_once()
+    svc.drain_once()
+    assert svc.maybe_resize()
+    assert svc._batch_dims == 1
+    assert [e["event"] for e in svc._elastic] == \
+        ["serve.grow", "serve.shrink"]
+
+
+def test_serve_status_badge():
+    from rocm_mpi_tpu.telemetry import health
+
+    beats = {
+        0: {"counters": {"serve_submitted": 20, "serve_completed": 17,
+                         "serve_requeued": 0}},
+    }
+    st = health.serve_status(beats)
+    assert st["depth"] == 3
+    assert health.format_serve_status(st) == "[SERVE depth=3 — 17 done]"
+    beats[0]["counters"]["serve_completed"] = 20
+    beats[0]["counters"]["serve_resizes"] = 1
+    assert health.format_serve_status(health.serve_status(beats)) == \
+        "serve idle (20 done, 1 resize(s))"
+    assert health.serve_status({0: {"counters": {"step": 3}}}) is None
+    assert health.format_serve_status(None) is None
+    # A FAILED request leaves the backlog too — it must not read as
+    # depth forever.
+    beats = {
+        0: {"counters": {"serve_submitted": 5, "serve_completed": 4,
+                         "serve_requeued": 0, "serve_failed": 1}},
+    }
+    st = health.serve_status(beats)
+    assert st["depth"] == 0
+    assert health.format_serve_status(st) == \
+        "serve idle (4 done, 1 failed)"
+
+
+def test_session_save_failure_is_lane_isolated():
+    """A lane whose session save cannot run (no sessions_dir) fails
+    ONLY its ticket; the co-batched neighbor still resolves and the
+    completion accounting stays exact."""
+    svc = SimulationService(config=ServeConfig(max_width=2))
+    bad = svc.queue.submit(Request(
+        request_id="sv-bad", workload="diffusion",
+        global_shape=(16, 16), dtype="f64", nt=2, session="s-x",
+    ))
+    good = svc.queue.submit(Request(
+        request_id="sv-good", workload="diffusion",
+        global_shape=(16, 16), dtype="f64", nt=2,
+    ))
+    report = svc._drain_all()
+    assert report.failed == 1 and report.served == 1
+    with pytest.raises(RuntimeError, match="sessions_dir"):
+        bad.result(timeout=5)
+    assert good.result(timeout=5) is not None
+
+
+def test_non_pow2_batch_dims_rounds_down_instead_of_bricking():
+    """--batch-dims 3 must never brick a pow2-width batch: the rows
+    round down to a dividing power of two."""
+    svc = SimulationService(config=ServeConfig(
+        max_width=4, batch_dims=3,
+    ))
+    trace = [
+        Request(request_id=f"bd{i}", workload="diffusion",
+                global_shape=(16, 16), dtype="f64", nt=3)
+        for i in range(4)
+    ]
+    report = svc.run_trace(trace)
+    assert report.served == 4 and report.failed == 0
+    assert all(p.endswith("|bd3") for p in report.programs)
+
+
+def test_serve_app_trace_mode_honors_f64(tmp_path):
+    """A RECORDED f64 trace enables x64 regardless of the synthetic
+    --dtype knob: the session checkpoint's manifest must record
+    float64 leaves, not silently-canonicalized float32."""
+    import os
+
+    trace_path = tmp_path / "trace.jsonl"
+    req = Request(request_id="f64-1", workload="diffusion",
+                  global_shape=(16, 16), dtype="f64", nt=4,
+                  session="s64")
+    trace_path.write_text(json.dumps(request_to_record(req)) + "\n")
+    sessions = tmp_path / "sessions"
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "apps" / "serve.py"),
+         "--trace", str(trace_path), "--cpu-devices", "1",
+         "--sessions", str(sessions)],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    manifest = json.loads(
+        (sessions / "s64" / "manifest-4.json").read_text()
+    )
+    assert manifest["leaves"][0]["dtype"] == "float64"
+
+
+# ---------------------------------------------------------------------------
+# The batched traffic audit
+# ---------------------------------------------------------------------------
+
+
+def test_batched_traffic_audit_within_budget():
+    from rocm_mpi_tpu.perf import traffic
+
+    rows = traffic.audit_batched(local=16, dims=(2, 1), batch=2)
+    assert len(rows) == 1
+    row = rows[0]
+    assert row.variant == "batched2"
+    assert row.wire_bytes == row.wire_ideal, \
+        "a batched exchange must ship EXACTLY B x the single-lane wire"
+    assert row.ok, f"batched ratio {row.ratio:.2f} over budget"
+
+
+def test_batched_traffic_fixture_fails():
+    """The doctored over-padded row (4 lanes compiled, 1 live) must
+    fail — proof the audit catches the padding-inflation class the
+    occupancy floor exists to split away."""
+    from rocm_mpi_tpu.perf import traffic
+
+    rows = traffic.audit_batched(local=16, dims=(2, 1), batch=2,
+                                 include_batch_fixture=True)
+    fixture = [r for r in rows if "fixture" in r.variant]
+    assert len(fixture) == 1
+    assert not fixture[0].ok
+    assert fixture[0].ratio > fixture[0].budget
+
+
+def test_perf_cli_batch_fixture_exits_1():
+    proc = subprocess.run(
+        [sys.executable, "-m", "rocm_mpi_tpu.perf",
+         "--include-batch-fixture", "--no-wire", "--local", "16"],
+        capture_output=True, text=True, cwd=REPO, timeout=600,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 1, proc.stderr
+    assert "TRAFFIC GATE FAILED" in proc.stderr
+
+
+def test_budgets_serving_block_schema_gate(tmp_path):
+    from rocm_mpi_tpu.perf.traffic import load_budgets
+    from rocm_mpi_tpu.telemetry.regress import check_schema
+
+    doc = load_budgets()
+    assert doc["serving"]["batch_tolerance"] >= 1.0
+    doc["serving"]["occupancy_floor"] = 1.7
+    bad = tmp_path / "budgets.json"
+    bad.write_text(json.dumps(doc))
+    assert any("occupancy_floor" in p for p in check_schema([bad]))
+
+
+# ---------------------------------------------------------------------------
+# Acceptance drills
+# ---------------------------------------------------------------------------
+
+
+def test_serve_app_50_request_acceptance(tmp_path):
+    """THE acceptance drill: a heterogeneous 50-request trace (3 shape
+    classes, mixed physics/workloads/steps) through apps/serve.py
+    compiles exactly len(bins) programs (manifest-pinned) with
+    compiles.steady_state == 0, and the banked sidecars clear the
+    schema gate."""
+    out = tmp_path / "out"
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "apps" / "serve.py"),
+         "--synthetic", "50", "--seed", "3", "--nt-max", "16",
+         "--max-width", "4", "--cpu-devices", "1",
+         "--out", str(out)],
+        capture_output=True, text=True, cwd=REPO, timeout=900,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "compiles.steady_state=0" in proc.stdout
+    doc = json.loads((out / "serve-manifest.json").read_text())
+    assert sbins.validate_manifest_doc(doc) == []
+    assert doc["served"] == 50 and doc["preempted"] is False
+    assert doc["compiles"]["steady_state"] == 0
+    assert len(doc["bins"]) >= 3
+    # exactly len(bins) programs: every program class belongs to a bin,
+    # and every bin's width classes are all present
+    widths = sum(len(row["widths"]) for row in doc["bins"])
+    assert len(doc["programs"]) == widths
+    shapes = {row["key"].split("|")[1] for row in doc["bins"]}
+    assert len(shapes) >= 3
+
+    from rocm_mpi_tpu.telemetry.regress import check_schema
+
+    assert check_schema([out / "serve-manifest.json",
+                         out / "serve-requests.jsonl"]) == []
+
+
+def test_serving_gloo_two_rank_drill(tmp_path):
+    """Gloo-real 2-rank drill: a heterogeneous queue served by a
+    2-rank space mesh compiles exactly len(bins) programs on every
+    rank, with compiles.steady_state == 0 and a second identical trace
+    compiling NOTHING (tests/serving_worker.py)."""
+    from rocm_mpi_tpu.parallel.launcher import spawn_ranks
+
+    results = spawn_ranks(
+        [REPO / "tests" / "serving_worker.py"], nprocs=2, timeout=420,
+    )
+    for rank, (proc, (out, err)) in enumerate(results):
+        assert proc.returncode == 0, (rank, out[-500:], err[-2000:])
+        done = [l for l in out.splitlines()
+                if "SERVING_WORKER_DONE" in l]
+        assert len(done) == 1, out
+        line = done[0]
+        assert f"rank={rank}" in line
+        assert "bins=4 programs=4" in line, line
+        assert "steady=0" in line and "second_trace_compiles=0" in line
