@@ -13,8 +13,10 @@ Daemon mode (--serve): drain the queue until idle for --idle-exit-s
 (a SIGTERM preemption notice requeues pending work and exits rc 75 —
 the scheduler's requeue signal, resilience/preempt.py).
 
-Exit codes: 0 served clean; 1 any request failed; 75 preempted
-(EX_TEMPFAIL, pending work requeued in the manifest); 2 usage.
+Exit codes: 0 served clean (rejected/expired are the SLO machinery
+working, not app failures); 1 any request failed or was quarantined;
+75 preempted (EX_TEMPFAIL, pending work requeued in the manifest);
+2 usage.
 """
 
 from __future__ import annotations
@@ -39,11 +41,13 @@ SYNTH_WORKLOADS = ("diffusion", "wave", "swe")
 
 
 def synthetic_trace(n: int, seed: int, nt_max: int = 64,
-                    dtype: str = "f32", sessions: bool = False):
+                    dtype: str = "f32", sessions: bool = False,
+                    deadline_s: float | None = None):
     """Deterministic heterogeneous request mix: >=3 shape classes,
     mixed workloads/physics/step counts — the acceptance-trace shape
     (ISSUE: 50 requests through apps/serve.py compile exactly
-    len(bins) programs)."""
+    len(bins) programs). `deadline_s` stamps every request with a TTL
+    (docs/SERVING.md "SLOs and admission")."""
     from rocm_mpi_tpu.serving.queue import Request
 
     rng = random.Random(seed)
@@ -64,6 +68,7 @@ def synthetic_trace(n: int, seed: int, nt_max: int = 64,
             physics=physics,
             ic_scale=1.0 + 0.01 * (i % 17),
             session=f"sess-{i:04d}" if sessions else None,
+            deadline_s=deadline_s,
         ))
     return reqs
 
@@ -115,6 +120,19 @@ def make_parser():
                    "--idle-exit-s")
     p.add_argument("--idle-exit-s", type=float, default=2.0,
                    help="daemon idle exit (seconds; --serve)")
+    p.add_argument("--max-depth", type=positive_int, default=None,
+                   help="admission bound: over-depth submits are "
+                   "rejected fast with a retry-after hint "
+                   "(default: unbounded)")
+    p.add_argument("--retry-budget", type=int, default=None,
+                   help="retries per request before quarantine "
+                   "(default: the RequestRetryPolicy default)")
+    p.add_argument("--deadline-s", type=float, default=None,
+                   help="stamp every synthetic request with this TTL "
+                   "(pending past it fails deadline-exceeded at pop)")
+    p.add_argument("--quarantine", default=None, metavar="FILE.jsonl",
+                   help="append-only poison-request ledger (default: "
+                   "<--out>/quarantine.jsonl when --out is given)")
     add_telemetry_flag(p)
     add_health_flag(p)
     return p
@@ -162,6 +180,7 @@ def main(argv=None) -> int:
         requests = synthetic_trace(
             n, args.seed, nt_max=args.nt_max, dtype=args.dtype,
             sessions=args.synthetic_sessions,
+            deadline_s=args.deadline_s,
         )
     if any(r.dtype == "f64" for r in requests):
         # x64 follows the TRACE, not just the synthetic --dtype knob: a
@@ -176,6 +195,17 @@ def main(argv=None) -> int:
 
         policy = ElasticPolicy()
 
+    retry = None
+    if args.retry_budget is not None:
+        from rocm_mpi_tpu.resilience.policy import RequestRetryPolicy
+
+        retry = RequestRetryPolicy(budget=max(args.retry_budget, 0))
+    quarantine = args.quarantine
+    if quarantine is None and args.out and jax.process_index() == 0:
+        out_dir = pathlib.Path(args.out)
+        out_dir.mkdir(parents=True, exist_ok=True)
+        quarantine = str(out_dir / "quarantine.jsonl")
+
     svc = SimulationService(config=ServeConfig(
         max_width=args.max_width,
         occupancy_floor=args.occupancy_floor,
@@ -183,21 +213,47 @@ def main(argv=None) -> int:
         sessions_dir=args.sessions,
         policy=policy,
         grow_queue_depth=args.grow_depth,
+        max_depth=args.max_depth,
+        retry=retry,
+        quarantine_path=quarantine,
     ))
 
     log0(f"serving {len(requests)} request(s) "
          f"(max_width={args.max_width}, batch_dims={args.batch_dims}, "
          f"devices={len(jax.devices())})")
-    if args.serve:
-        for r in requests:
+
+    pre_served = 0
+
+    def submit_paced(reqs):
+        # This driver is its own submitter: with --max-depth it paces
+        # submission against the backlog (drain, then submit) instead
+        # of bulk-submitting the whole fixed trace into its own
+        # admission bound — rejecting input we cannot re-submit would
+        # silently drop most of the trace while still exiting 0. The
+        # fast-reject path is for EXTERNAL submitters who can honor
+        # the retry-after hint.
+        nonlocal_served = 0
+        for r in reqs:
+            while svc.config.max_depth is not None \
+                    and svc.queue.depth() >= svc.config.max_depth:
+                served, _ = svc.drain_once()
+                nonlocal_served += served
             svc.queue.submit(r)
+        return nonlocal_served
+
+    if args.serve:
+        pre_served = submit_paced(requests)
         report = svc.serve_forever(idle_exit_s=args.idle_exit_s)
     else:
-        report = svc.run_trace(requests)
+        pre_served = submit_paced(requests)
+        report = svc._drain_all()
+    report.served += pre_served
 
     log0(
         f"served {report.served}/{len(requests)} "
-        f"({report.failed} failed, {report.requeued} requeued) — "
+        f"({report.failed} failed, {report.requeued} requeued, "
+        f"{report.rejected} rejected, {report.expired} expired, "
+        f"{report.quarantined} quarantined) — "
         f"{report.n_bins} bin(s), {report.n_programs} program(s), "
         f"compiles.steady_state={report.compiles.get('steady_state')}"
     )
@@ -227,7 +283,10 @@ def main(argv=None) -> int:
     if report.preempted:
         log0("preempted: pending work requeued; rc 75 (EX_TEMPFAIL)")
         return 75
-    return 1 if report.failed else 0
+    # Quarantined requests are failures the service survived — the run
+    # still reports them (a poisoned trace must not exit 0). Rejected/
+    # expired are the SLO machinery doing its job, not an app failure.
+    return 1 if (report.failed or report.quarantined) else 0
 
 
 if __name__ == "__main__":

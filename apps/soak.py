@@ -1,0 +1,545 @@
+"""The long-horizon chaos soak — ROADMAP item 5's driver
+(docs/RESILIENCE.md §8 "The soak").
+
+Nine PRs built fault machinery one plane at a time (supervised retries,
+elastic shrink/grow, preemption, storage faults, tenant isolation, the
+request-plane SLOs); this driver is where they COMPOSE: a live serving
+session runs under a deterministic rolling fault schedule that strikes
+all three layers —
+
+  * the queue (queue-flood admission storms, deadline expiry),
+  * the lanes (lane-nan numerical poison, batch-error/slow-batch,
+    the per-BinKey circuit breaker's open → half-open → recover arc),
+  * the infrastructure (SIGTERM eviction, injected storage outages
+    through the session-save path, and gloo-real ≥2-rank episodes where
+    a rank is killed / vanishes / stalls mid-batch and the launcher's
+    supervision — peer-grace kill, vanish detection, the progress
+    watchdog — must name the victim),
+
+with SLO accounting (request latency p50/p99 from real telemetry
+events, deadline-miss rate, rejected/expired/quarantined totals) banked
+in a schema-versioned, atomically-written `soak-report.json`
+(serving/slo.py) plus the append-only `quarantine.jsonl` poison ledger.
+
+`--bounded` is the chip_watcher.sh edition (minutes, not hours): one
+episode per fault family, the gloo kill drill included. The full
+schedule adds the die (vanish) and stall (watchdog) episodes. Exit 0
+iff every episode met its expectation AND the terminal accounting
+invariant held everywhere — a soak that "mostly worked" is a failed
+soak.
+
+    python apps/soak.py --bounded --out output/soak
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import signal
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from apps._common import positive_int  # noqa: E402
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+# Shapes small enough that every episode compiles in seconds on any
+# backend; two classes so the bin scheduler has real work.
+SHAPE_A = (16, 16)
+SHAPE_B = (24, 24)
+
+
+def _req(rid, shape=SHAPE_A, nt=4, workload="diffusion", dtype="f32",
+         **kw):
+    from rocm_mpi_tpu.serving.queue import Request
+
+    return Request(request_id=rid, workload=workload,
+                   global_shape=shape, dtype=dtype, nt=nt, **kw)
+
+
+def _drive(svc, flood_shape=SHAPE_A, max_drains=200):
+    """Drain the service to empty, consulting the `queue-flood` fault
+    at each drain boundary (the driver owns submission, so the flood
+    lives here, not in the service). Returns the number of flooded
+    submissions."""
+    from rocm_mpi_tpu.resilience import faults
+
+    flooded = 0
+    drain = 0
+    while True:
+        drain += 1
+        clause = faults.serving_fault("queue-flood", step=drain)
+        if clause is not None:
+            n = max(int(clause.delay_s), 1)
+            for i in range(n):
+                svc.queue.submit(_req(
+                    f"flood-{drain}-{i:03d}", shape=flood_shape, nt=2,
+                    ic_scale=1.0 + 0.001 * i,
+                ))
+            flooded += n
+        svc.maybe_resize()
+        _, preempted = svc.drain_once()
+        if preempted or svc.queue.depth() == 0:
+            return flooded, preempted
+        delay = svc.queue.next_ready_delay()
+        if delay:
+            time.sleep(min(delay, 0.25))
+        if drain >= max_drains:
+            raise RuntimeError(
+                f"soak drive did not drain in {max_drains} drains "
+                f"(depth {svc.queue.depth()})"
+            )
+
+
+def _episode(name, mode, fault_spec, fn):
+    """Run one episode; never let an exception escape the schedule —
+    a failed episode is a row with ok=False and the error, and the
+    soak exits 1 (a crashed soak banks no report at all)."""
+    from rocm_mpi_tpu.resilience import faults
+
+    t0 = time.monotonic()
+    row = {"name": name, "mode": mode, "faults": fault_spec or ""}
+    print(f"[soak] episode {name} ({mode})", flush=True)
+    try:
+        faults.install(fault_spec)
+        details = fn()
+        row.update(ok=True, **(details or {}))
+    except Exception as e:  # noqa: BLE001 — the report is the verdict
+        row.update(ok=False, error=f"{type(e).__name__}: {e}")
+    finally:
+        faults.install(None)
+    row["wall_s"] = round(time.monotonic() - t0, 3)
+    status = "ok" if row["ok"] else f"FAILED ({row.get('error')})"
+    print(f"[soak] episode {name}: {status} in {row['wall_s']}s",
+          flush=True)
+    return row
+
+
+class Soak:
+    def __init__(self, out: pathlib.Path, ranks: int, seed: int):
+        self.out = out
+        self.ranks = ranks
+        self.seed = seed
+        self.quarantine = out / "quarantine.jsonl"
+        self.counters: dict[str, int] = {}
+        self.stream_dirs = [out / "telemetry"]
+
+    # ---- shared plumbing ------------------------------------------------
+
+    def _service(self, **cfg):
+        from rocm_mpi_tpu.resilience.policy import RequestRetryPolicy
+        from rocm_mpi_tpu.serving.service import (
+            ServeConfig,
+            SimulationService,
+        )
+
+        cfg.setdefault("max_width", 4)
+        cfg.setdefault("quarantine_path", str(self.quarantine))
+        cfg.setdefault(
+            "retry", RequestRetryPolicy(budget=2, backoff_base_s=0.01)
+        )
+        return SimulationService(config=ServeConfig(**cfg))
+
+    def _bank(self, svc, name: str) -> dict:
+        """Close one in-process episode: accounting invariant asserted,
+        counters folded into the soak totals, manifest banked."""
+        svc._assert_accounting()
+        c = svc.queue.counters()
+        for k, v in c.items():
+            if k != "depth":
+                self.counters[k] = self.counters.get(k, 0) + int(v)
+        self.counters["retries"] = (
+            self.counters.get("retries", 0) + svc.retries_total
+        )
+        svc.write_manifest(self.out / f"serve-manifest-{name}.json")
+        return c
+
+    # ---- in-process episodes -------------------------------------------
+
+    def ep_serve_chaos(self):
+        """The request-plane storm: flood + deadline expiry + NaN
+        poison + a transient batch error + a slow batch, on an elastic
+        service — admission rejects the overflow fast, the poison lane
+        ends quarantined, everything else serves."""
+        import jax
+
+        from rocm_mpi_tpu.resilience.policy import ElasticPolicy
+
+        svc = self._service(
+            max_depth=8,
+            policy=ElasticPolicy(min_grow_interval_steps=0),
+            device_budget=lambda: len(jax.devices()),
+            grow_queue_depth=6,
+            idle_shrink_drains=2,
+        )
+        for i in range(8):
+            svc.queue.submit(_req(
+                f"chaos-{i:03d}",
+                shape=SHAPE_A if i % 3 else SHAPE_B,
+                nt=3 + (i % 4),
+                ic_scale=1.0 + 0.02 * i,
+                # Two tickets with an already-hopeless TTL: pinned
+                # deterministic deadline-exceeded at pop time.
+                deadline_s=1e-6 if i in (5, 6) else None,
+            ))
+        flooded, _ = _drive(svc)
+        c = self._bank(svc, "serve-chaos")
+        assert c["quarantined"] >= 1, f"no quarantine: {c}"
+        assert c["rejected"] >= 2, f"flood not rejected: {c}"
+        assert c["expired"] >= 2, f"deadlines not expired: {c}"
+        return {"counters": c, "flooded": flooded,
+                "grew": bool(svc._elastic)}
+
+    def ep_breaker(self):
+        """The circuit-breaker arc: three consecutive injected batch
+        errors open SHAPE_A's class (its pending requests reject fast
+        with circuit-open while SHAPE_B keeps serving), the cooled-down
+        breaker re-admits one half-open probe, and recovery closes it."""
+        from rocm_mpi_tpu.resilience.policy import (
+            CircuitPolicy,
+            RequestRetryPolicy,
+        )
+
+        svc = self._service(
+            max_width=2,
+            retry=RequestRetryPolicy(budget=1, backoff_base_s=0.0),
+            circuit=CircuitPolicy(k=3, cooldown_drains=2),
+        )
+        from rocm_mpi_tpu.resilience import faults
+
+        # Drain 1 executes SHAPE_A's three width-2 batches first
+        # (sorted bin keys), then SHAPE_B's: the three errors strike
+        # exactly class A.
+        faults.install(
+            "batch-error@step=1;batch-error@step=2;batch-error@step=3"
+        )
+        healthy = []
+        for i in range(6):
+            svc.queue.submit(_req(f"brk-a-{i}", shape=SHAPE_A, nt=3))
+        for i in range(2):
+            healthy.append(svc.queue.submit(
+                _req(f"brk-b-{i}", shape=SHAPE_B, nt=3)
+            ))
+        _drive(svc)
+        from rocm_mpi_tpu.serving.bins import bin_key
+
+        key_a = bin_key(_req("probe0", shape=SHAPE_A, nt=3))
+        br = svc._breakers[key_a]
+        assert br.state == "open", f"breaker never opened ({br.state})"
+        for t in healthy:
+            assert t.state == "done", (
+                "an open class starved a healthy tenant: "
+                f"{t.request.request_id} {t.state}"
+            )
+        # Cool down (empty drains), then the half-open probe recovers
+        # (the injected errors are exhausted by now).
+        svc.drain_once()
+        svc.drain_once()
+        probe = svc.queue.submit(_req("probe-recover", shape=SHAPE_A,
+                                      nt=3))
+        _drive(svc)
+        assert probe.state == "done", f"probe {probe.state}: {probe.error}"
+        assert br.state == "closed", f"breaker stuck {br.state}"
+        c = self._bank(svc, "breaker")
+        assert c["rejected"] >= 1, f"open breaker rejected nothing: {c}"
+        return {"counters": c}
+
+    def ep_storage(self):
+        """Storage outages strike the session-save path: an io-error
+        burst outlasting the checkpoint retry ladder fails the lane,
+        the request-plane retry re-runs it to a clean save; enospc and
+        io-slow are absorbed by the StoragePolicy ladder itself."""
+        sessions = self.out / "sessions"
+        svc = self._service(sessions_dir=str(sessions))
+        from rocm_mpi_tpu.resilience import faults
+
+        faults.install(
+            "io-error@step=6,times=3;io-slow=0.1@step=8;"
+            "enospc@step=10"
+        )
+        a = svc.queue.submit(_req("store-a", nt=6, session="soak-a"))
+        b = svc.queue.submit(_req("store-b", nt=8, session="soak-b"))
+        d = svc.queue.submit(_req("store-c", nt=10, session="soak-c"))
+        _drive(svc)
+        for t in (a, b, d):
+            assert t.state == "done", (t.request.request_id, t.error)
+        assert a.retries >= 1, "outage never forced a request retry"
+        from rocm_mpi_tpu.utils import checkpoint as ckpt
+
+        for sid, nt in (("soak-a", 6), ("soak-b", 8), ("soak-c", 10)):
+            step = ckpt.latest_valid_step(sessions / sid)
+            assert step == nt, f"session {sid}: {step} != {nt}"
+        c = self._bank(svc, "storage")
+        return {"counters": c, "request_retries": a.retries}
+
+    def ep_evict(self):
+        """A real SIGTERM eviction mid-trace: the notice stops dispatch
+        at the batch boundary, every unserved ticket is requeued (the
+        rc-75 contract), and the relaunched drain serves them all."""
+        from rocm_mpi_tpu.resilience import preempt
+
+        preempt.install(grace_s=30.0)
+        svc = self._service(max_width=1)
+        for i in range(6):
+            svc.queue.submit(_req(f"evict-{i}", nt=3,
+                                  ic_scale=1.0 + 0.01 * i))
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 5.0
+        while not preempt.requested() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert preempt.requested(), "SIGTERM notice never landed"
+        report = svc._drain_all()
+        assert report.preempted, "drain ignored the eviction notice"
+        requeued = svc.queue.depth()
+        assert requeued >= 1, "nothing requeued at the eviction"
+        # The next service instance (same queue here) drains the parked
+        # work after the eviction passes.
+        preempt.reset()
+        report2 = svc._drain_all()
+        assert not report2.preempted
+        assert svc.queue.depth() == 0
+        c = self._bank(svc, "evict")
+        assert c["completed"] == 6, c
+        return {"counters": c, "requeued_at_eviction": requeued}
+
+    # ---- gloo-real episodes --------------------------------------------
+
+    def _serve_argv(self, n: int, extra=()):
+        return [
+            str(REPO / "apps" / "serve.py"),
+            "--synthetic", str(n), "--seed", str(self.seed),
+            "--nt-max", "16", "--max-width", "4", "--cpu-devices", "1",
+            *extra,
+        ]
+
+    def ep_gloo_serve(self):
+        """The clean ≥2-rank serving session: a space mesh over gloo
+        ranks, every request served, per-request latency telemetry
+        banked (the SLO block's primary real-telemetry source)."""
+        from rocm_mpi_tpu.parallel.launcher import spawn_ranks
+
+        tdir = self.out / "telemetry-gloo"
+        out_dir = self.out / "gloo-serve"
+        results = spawn_ranks(
+            self._serve_argv(8, extra=["--out", str(out_dir)]),
+            nprocs=self.ranks, timeout=300, telemetry_dir=tdir,
+        )
+        self.stream_dirs.append(tdir)
+        for rank, (proc, (out, err)) in enumerate(results):
+            assert proc.returncode == 0, (
+                rank, out[-500:], err[-2000:]
+            )
+        manifest = json.loads(
+            (out_dir / "serve-manifest.json").read_text()
+        )
+        for k, v in manifest.get("queue", {}).items():
+            if k != "depth":
+                self.counters[k] = self.counters.get(k, 0) + int(v)
+        assert manifest["queue"]["completed"] == 8, manifest["queue"]
+        return {"ranks": self.ranks,
+                "programs": len(manifest["programs"])}
+
+    def ep_gloo_kill(self):
+        """Infrastructure kill mid-batch on a 2-rank serving session:
+        rank 1 exits rc 43 at the serve-batch fault site; the
+        launcher's first-failure scan names it and the peer-grace kill
+        reaps the wedged survivor."""
+        from rocm_mpi_tpu.parallel.launcher import spawn_ranks
+        from rocm_mpi_tpu.resilience.faults import RC_INJECTED_KILL
+
+        results = spawn_ranks(
+            self._serve_argv(8),
+            nprocs=self.ranks, timeout=240, peer_grace_s=5,
+            inject_fault="kill@step=2,rank=1,at=serve-batch",
+        )
+        ff = results.report.first_failure
+        assert ff is not None, "launcher saw no failure"
+        assert ff[0] == 1 and ff[1] == RC_INJECTED_KILL, ff
+        return {"first_failure": list(ff[:2])}
+
+    def ep_gloo_die(self):
+        """The vanished rank: rank 1 exits CLEAN (rc 0) mid-batch; only
+        vanish detection can tell the death from completion skew."""
+        from rocm_mpi_tpu.parallel.launcher import spawn_ranks
+
+        results = spawn_ranks(
+            self._serve_argv(8),
+            nprocs=self.ranks, timeout=240, peer_grace_s=5,
+            vanish_grace_s=4.0,
+            inject_fault="die@step=2,rank=1,at=serve-batch",
+        )
+        report = results.report
+        assert report.vanished == 1, (report.vanished, report.events)
+        return {"vanished": report.vanished}
+
+    def ep_gloo_stall(self):
+        """The wedged rank: rank 1 busy-waits forever BEFORE its batch
+        progress bump; its peer bumps past it into the batch collective
+        and the progress watchdog names the victim BY PROGRESS."""
+        from rocm_mpi_tpu.parallel.launcher import spawn_ranks
+
+        hdir = self.out / "health-stall"
+        results = spawn_ranks(
+            self._serve_argv(12),
+            nprocs=self.ranks, timeout=300, peer_grace_s=5,
+            health_dir=hdir, stall_grace_s=5.0,
+            inject_fault="stall@step=3,rank=1,at=serve-batch",
+        )
+        verdicts = results.report.watchdog_verdicts
+        assert verdicts and verdicts[0]["rank"] == 1, (
+            verdicts, results.report.events
+        )
+        return {"watchdog_rank": verdicts[0]["rank"]}
+
+    # ---- the schedule ---------------------------------------------------
+
+    def schedule(self, bounded: bool, gloo: bool):
+        eps = [
+            ("serve-chaos", "in-process",
+             "queue-flood=10@step=2;lane-nan@request=3,times=9;"
+             "slow-batch=0.05@step=3;batch-error@step=4",
+             self.ep_serve_chaos),
+            # breaker/storage install their own specs (multiple phases).
+            ("breaker", "in-process", None, self.ep_breaker),
+            ("storage", "in-process", None, self.ep_storage),
+            ("evict", "in-process", None, self.ep_evict),
+        ]
+        if gloo:
+            eps += [
+                ("gloo-serve", "gloo", None, self.ep_gloo_serve),
+                ("gloo-kill", "gloo",
+                 "kill@step=2,rank=1,at=serve-batch", self.ep_gloo_kill),
+            ]
+            if not bounded:
+                eps += [
+                    ("gloo-die", "gloo",
+                     "die@step=2,rank=1,at=serve-batch",
+                     self.ep_gloo_die),
+                    ("gloo-stall", "gloo",
+                     "stall@step=3,rank=1,at=serve-batch",
+                     self.ep_gloo_stall),
+                ]
+        return eps
+
+
+def fault_kinds_in(episodes) -> list[str]:
+    """The fault kinds this soak actually composed (report evidence)."""
+    kinds = set()
+    for ep in episodes:
+        for clause in (ep.get("faults") or "").split(";"):
+            head = clause.split("@")[0].split("=")[0].strip()
+            if head:
+                kinds.add(head)
+    # Episodes that install specs internally (breaker/storage) + the
+    # eviction's real SIGTERM:
+    names = {ep["name"] for ep in episodes}
+    if "breaker" in names:
+        kinds.add("batch-error")
+    if "storage" in names:
+        kinds.update({"io-error", "io-slow", "enospc"})
+    if "evict" in names:
+        kinds.add("sigterm")
+    return sorted(kinds)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="long-horizon chaos soak (docs/RESILIENCE.md §8)"
+    )
+    p.add_argument("--bounded", action="store_true",
+                   help="the chip_watcher edition: one episode per "
+                   "fault family, minutes not hours")
+    p.add_argument("--out", default="output/soak", metavar="DIR")
+    p.add_argument("--ranks", type=positive_int, default=2,
+                   help="ranks for the gloo episodes")
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--cpu-devices", type=int, default=0, metavar="N",
+                   help="simulate N virtual CPU devices for the "
+                   "in-process episodes")
+    p.add_argument("--no-gloo", action="store_true",
+                   help="skip the multi-rank episodes (debug only — "
+                   "the acceptance soak is gloo-real)")
+    args = p.parse_args(argv)
+
+    out = pathlib.Path(args.out)
+    out.mkdir(parents=True, exist_ok=True)
+    # A fresh soak owns its ledger: stale quarantine lines from a
+    # previous run must not inflate this run's poison count.
+    q = out / "quarantine.jsonl"
+    if q.exists():
+        q.unlink()
+
+    import jax
+
+    if args.cpu_devices:
+        from rocm_mpi_tpu.utils.backend import set_cpu_device_count
+
+        jax.config.update("jax_platforms", "cpu")
+        set_cpu_device_count(args.cpu_devices)
+
+    from rocm_mpi_tpu import telemetry
+    from rocm_mpi_tpu.serving import slo
+    from rocm_mpi_tpu.telemetry import compiles
+
+    tdir = out / "telemetry"
+    telemetry.configure(enabled=True, directory=str(tdir))
+    compiles.install()
+
+    soak = Soak(out, ranks=args.ranks, seed=args.seed)
+    episodes = []
+    for name, mode, spec, fn in soak.schedule(
+        bounded=args.bounded, gloo=not args.no_gloo
+    ):
+        episodes.append(_episode(name, mode, spec, fn))
+
+    # SLO block from REAL telemetry: every serve.request.done event's
+    # latency across the in-process stream and the gloo rank streams.
+    streams = []
+    for d in soak.stream_dirs:
+        streams += sorted(pathlib.Path(d).glob("telemetry-rank*.jsonl"))
+    counters = dict(soak.counters)
+    counters.setdefault("retries", 0)
+    # accounting_ok certifies ONLY the terminal-accounting invariant
+    # (every episode banks through _bank's _assert_accounting, whose
+    # violation surfaces in the episode error) — a failed SLO
+    # expectation must not read as a phantom ticket leak.
+    accounting_ok = not any(
+        "accounting invariant" in (ep.get("error") or "")
+        for ep in episodes
+    )
+    doc = slo.soak_report_doc(
+        episodes,
+        slo.slo_block(counters, streams),
+        bounded=args.bounded,
+        accounting_ok=accounting_ok,
+        fault_kinds=fault_kinds_in(episodes),
+    )
+    report_path = out / "soak-report.json"
+    try:
+        slo.write_soak_report(report_path, doc)
+    except ValueError as e:
+        # A soak whose serving episodes banked no telemetry cannot
+        # produce a valid (populated) report — say so and fail, don't
+        # crash without a verdict.
+        print(f"[soak] report not bankable: {e}", file=sys.stderr,
+              flush=True)
+        return 1
+    ok = all(ep["ok"] for ep in episodes)
+    print(
+        f"[soak] {'OK' if ok else 'FAILED'}: "
+        f"{sum(ep['ok'] for ep in episodes)}/{len(episodes)} episodes, "
+        f"slo p50={doc['slo']['latency_s']['p50']} "
+        f"p99={doc['slo']['latency_s']['p99']} "
+        f"miss_rate={doc['slo']['deadline_miss_rate']} "
+        f"quarantined={doc['slo']['quarantined']} — {report_path}",
+        flush=True,
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

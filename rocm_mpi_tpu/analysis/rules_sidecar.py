@@ -42,10 +42,12 @@ from rocm_mpi_tpu.analysis import astutil
 from rocm_mpi_tpu.analysis.core import ModuleContext, Rule
 
 # The committed artifact families (scripts/lint.sh schema-checks these
-# names; chip_watcher archives them).
+# names; chip_watcher archives them). `quarantine` and `soak-report`
+# joined with the request-plane hardening (docs/SERVING.md "SLOs and
+# admission"; docs/RESILIENCE.md §8).
 _ARTIFACT_NAME_RE = re.compile(
     r"(heartbeat|manifest|postmortem|bundle|elastic|cache|tuning|"
-    r"baseline|findings|summary)[-\w.]*\.jsonl?\b"
+    r"baseline|findings|summary|quarantine|soak)[-\w.]*\.jsonl?\b"
 )
 
 _SCHEMA_KEYS = {"schema", "kind"}

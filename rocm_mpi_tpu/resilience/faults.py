@@ -54,6 +54,39 @@ rank):
                             attempt — exercises the keep-list pruning
                             path before the save gives up
 
+Serving-plane kinds (docs/SERVING.md "SLOs and admission"; consumed by
+serving/service.py and apps/soak.py through `serving_fault`, never by
+the raising `fault_point` below — the caller interprets the clause):
+
+    lane-nan@request=N      poison the lane carrying the Nth SUBMITTED
+                            request (1-based ticket ordinal) with NaN
+                            initial state — the numerical-poison drill:
+                            the per-lane finiteness reduction must fail
+                            ONLY that ticket, and `times=` large enough
+                            to outlast the retry budget drives it into
+                            quarantine
+    batch-error@step=N      the Nth EXECUTED batch raises a transient
+                            batch-level error before dispatch — the
+                            retry-budget/backoff drill (times=1 makes
+                            the first retry succeed; consecutive clauses
+                            open the circuit breaker)
+    slow-batch=S@step=N     sleep S seconds inside the Nth executed
+                            batch (default 0.5 s) — the straggler-batch
+                            analog that makes co-batched tenants miss
+                            deadlines they'd otherwise clear
+    queue-flood=M@step=N    at the Nth DRAIN boundary the driver
+                            (apps/soak.py) submits M synthetic requests
+                            at once (default 16) — the admission-
+                            control drill: a bounded queue must reject
+                            the overflow fast with a retry-after hint
+
+The infrastructure kinds compose with serving through the opt-in
+`serve-batch` site: `kill@step=2,rank=1,at=serve-batch` kills rank 1
+before the 2nd batch's collectives (step = the service's global batch
+ordinal; the flight-recorder step bump happens AFTER this fault point,
+so a stalled rank is named BY PROGRESS exactly as in the segment-pre
+drill).
+
 Storage kinds re-fire per ATTEMPT: the save retry loop re-runs the
 "save" fault point, so a clause with `times=N` (see below) can defeat N
 attempts — `io-error@step=8,times=3` exhausts a 2-retry save and drives
@@ -121,6 +154,12 @@ Instrumented fault points:
     "restore"  — utils/checkpoint.restore_state, before each restore
                  attempt (step = the step being restored). OPT-IN for
                  the same reason
+    "serve-batch" — serving/service.SimulationService._execute_batch,
+                 before each batch's lane assembly, flight step bump,
+                 and collectives (step = the service's global batch
+                 ordinal). OPT-IN: its step numbering is batches, not
+                 simulation steps — an unscoped legacy clause must
+                 never fire here
 """
 
 from __future__ import annotations
@@ -136,12 +175,23 @@ ENV_VAR = "RMT_INJECT_FAULT"
 # Sites that only fire for clauses explicitly scoped there (at=SITE):
 # they share step numbering with an adjacent legacy site, and an
 # unscoped clause must keep firing at the legacy one.
-OPTIN_SITES = frozenset({"segment-pre", "save", "restore"})
+OPTIN_SITES = frozenset({"segment-pre", "save", "restore", "serve-batch"})
 
 # Storage-fault kinds: they only make sense at an IO attempt, so a
 # clause with no at= clause is pinned to the "save" site at parse time.
 IO_KINDS = frozenset({"io-error", "io-slow", "enospc"})
 IO_SLOW_DEFAULT_S = 2.0
+
+# Serving-plane kinds (module docstring): matched ONLY by
+# `serving_fault` — the raising `fault_point` below skips them, so a
+# `batch-error@step=2` can never collide with the halo "step" site's
+# step numbering. The caller interprets the returned clause (`delay_s`
+# carries the slow-batch seconds / queue-flood size).
+SERVING_KINDS = frozenset(
+    {"lane-nan", "batch-error", "queue-flood", "slow-batch"}
+)
+SLOW_BATCH_DEFAULT_S = 0.5
+QUEUE_FLOOD_DEFAULT_N = 16
 
 
 class InjectedCrash(RuntimeError):
@@ -150,10 +200,10 @@ class InjectedCrash(RuntimeError):
 
 class FaultClause:
     __slots__ = ("kind", "step", "segment", "rank", "delay_s", "site",
-                 "times", "fires")
+                 "times", "fires", "request")
 
     def __init__(self, kind, step=None, segment=None, rank=None,
-                 delay_s=0.0, site=None, times=None):
+                 delay_s=0.0, site=None, times=None, request=None):
         self.kind = kind
         self.step = step
         self.segment = segment
@@ -161,6 +211,7 @@ class FaultClause:
         self.delay_s = delay_s
         self.site = site
         self.times = times  # None = the plan's MAX_FIRES default
+        self.request = request  # lane-nan's ticket-ordinal trigger
         self.fires = 0
 
     def __repr__(self):
@@ -169,6 +220,8 @@ class FaultClause:
             parts.append(f"step={self.step}")
         if self.segment is not None:
             parts.append(f"segment={self.segment}")
+        if self.request is not None:
+            parts.append(f"request={self.request}")
         if self.rank is not None:
             parts.append(f"rank={self.rank}")
         if self.site is not None:
@@ -193,8 +246,21 @@ def _parse_clause(raw: str) -> FaultClause:
         kind = "io-slow"
     elif kind == "io-slow":
         delay_s = IO_SLOW_DEFAULT_S
+    elif kind.startswith("slow-batch="):
+        delay_s = float(kind[len("slow-batch="):])
+        kind = "slow-batch"
+    elif kind == "slow-batch":
+        delay_s = SLOW_BATCH_DEFAULT_S
+    elif kind.startswith("queue-flood="):
+        # delay_s doubles as the flood SIZE for queue-flood (the one
+        # value-bearing serving kind; apps/soak.py casts it back).
+        delay_s = float(kind[len("queue-flood="):])
+        kind = "queue-flood"
+    elif kind == "queue-flood":
+        delay_s = float(QUEUE_FLOOD_DEFAULT_N)
     if kind not in ("crash", "kill", "die", "truncate-latest", "delay",
-                    "stall") and kind not in IO_KINDS:
+                    "stall") and kind not in IO_KINDS \
+            and kind not in SERVING_KINDS:
         raise ValueError(f"unknown fault kind {kind!r} in {raw!r}")
     clause = FaultClause(kind, delay_s=delay_s)
     triggers = [t for t in [trigger.strip()] + mods if t]
@@ -207,6 +273,8 @@ def _parse_clause(raw: str) -> FaultClause:
             clause.segment = int(val)
         elif key == "rank":
             clause.rank = int(val)
+        elif key == "request":
+            clause.request = int(val)
         elif key == "at":
             clause.site = val.strip()
         elif key == "times":
@@ -215,6 +283,10 @@ def _parse_clause(raw: str) -> FaultClause:
                 raise ValueError(f"times must be >= 1 in {raw!r}")
         else:
             raise ValueError(f"unknown fault trigger {t!r} in {raw!r}")
+    if clause.request is not None and kind != "lane-nan":
+        raise ValueError(
+            f"request=N only triggers lane-nan clauses: {raw!r}"
+        )
     if kind in IO_KINDS and clause.site is None:
         # Storage faults strike IO attempts; without an explicit at=
         # they pin to the save site (the one every drill wants).
@@ -224,6 +296,17 @@ def _parse_clause(raw: str) -> FaultClause:
             and clause.step is None and clause.segment is None:
         raise ValueError(
             f"{kind} fault needs a step=K or segment=N trigger: {raw!r}"
+        )
+    if kind == "lane-nan" and clause.request is None:
+        raise ValueError(
+            f"lane-nan needs a request=N trigger (the 1-based ticket "
+            f"ordinal): {raw!r}"
+        )
+    if kind in ("batch-error", "slow-batch", "queue-flood") \
+            and clause.step is None:
+        raise ValueError(
+            f"{kind} needs a step=N trigger (batch/drain ordinal): "
+            f"{raw!r}"
         )
     return clause
 
@@ -319,6 +402,40 @@ def _truncate_latest(directory) -> None:
         fh.truncate(max(size // 2, 0))
 
 
+def serving_fault(kind: str, step=None, request=None):
+    """Match-and-consume for the serving-plane kinds (module
+    docstring): returns the firing `FaultClause` or None. The CALLER
+    interprets the clause — the service raises for batch-error, sleeps
+    `clause.delay_s` for slow-batch, poisons the lane for lane-nan;
+    apps/soak.py submits `int(clause.delay_s)` requests for
+    queue-flood. `step` is the batch/drain ordinal; `request` the
+    1-based ticket ordinal (lane-nan only). times=/rank= re-arm and
+    scope exactly like every other clause."""
+    if kind not in SERVING_KINDS:
+        raise ValueError(f"not a serving fault kind: {kind!r}")
+    plan = install_from_env()
+    if not plan:
+        return None
+    rank = _rank()
+    for clause in plan.clauses:
+        if clause.kind != kind:
+            continue
+        if clause.fires >= (clause.times or plan.MAX_FIRES):
+            continue
+        if clause.rank is not None and clause.rank != rank:
+            continue
+        if clause.request is not None:
+            hit = request is not None and int(request) == clause.request
+        else:
+            hit = step is not None and clause.step is not None \
+                and int(step) == clause.step
+        if not hit:
+            continue
+        clause.fires += 1
+        return clause
+    return None
+
+
 def fault_point(name: str, step=None, directory=None) -> None:
     """Instrumentation hook: a no-op without an installed/env plan.
 
@@ -333,6 +450,10 @@ def fault_point(name: str, step=None, directory=None) -> None:
         plan._segments_seen += 1
     rank = _rank()
     for clause in plan.clauses:
+        if clause.kind in SERVING_KINDS:
+            # Serving kinds are matched only by serving_fault(): their
+            # step numbering is batches/drains, not simulation steps.
+            continue
         if clause.fires >= (clause.times or plan.MAX_FIRES):
             continue
         if clause.rank is not None and clause.rank != rank:

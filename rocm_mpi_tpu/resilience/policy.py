@@ -93,3 +93,73 @@ class ElasticPolicy:
         within `budget`. May equal `nprocs` (budget grew but no bigger
         mesh tiles the grid) — the caller treats that as no grow."""
         return max(plan_ranks(max(budget, 1)), nprocs)
+
+
+@dataclasses.dataclass
+class RequestRetryPolicy:
+    """The request plane's retry decision table (docs/SERVING.md "SLOs
+    and admission"; consumed by serving.service).
+
+    A transient batch-level failure (compile hiccup, storage flap on a
+    session save, an injected `batch-error`) or a numerical failure
+    (NaN/Inf lane) requeues the request a BOUNDED number of times with
+    exponential backoff, instead of either dying on first fault or
+    looping forever; a request that exhausts `budget` is quarantined —
+    never requeued again — with its full record banked for offline
+    repro. Per-request validation errors (unknown physics, a session
+    past the requested nt) never retry: the request itself is wrong.
+
+    `budget` — retries per request (0 = quarantine on first fault).
+    `backoff_base_s` — first-retry delay; doubles per retry.
+    `backoff_cap_s` — backoff ceiling (an eviction storm must not push
+        a request's next try into next week).
+    """
+
+    budget: int = 2
+    backoff_base_s: float = 0.05
+    backoff_cap_s: float = 2.0
+
+    def __post_init__(self):
+        if self.budget < 0:
+            raise ValueError(f"budget must be >= 0, got {self.budget}")
+        if self.backoff_base_s < 0 or self.backoff_cap_s < 0:
+            raise ValueError("backoff must be >= 0 seconds")
+
+    def backoff_s(self, retries: int) -> float:
+        """Delay before retry number `retries` (1-based)."""
+        if retries < 1:
+            return 0.0
+        return min(
+            self.backoff_base_s * 2.0 ** (retries - 1),
+            self.backoff_cap_s,
+        )
+
+
+@dataclasses.dataclass
+class CircuitPolicy:
+    """Per-program-class (BinKey) circuit breaker thresholds
+    (docs/SERVING.md "SLOs and admission"; consumed by
+    serving.service).
+
+    `k` consecutive batch failures in ONE program class open the
+    breaker: requests in that class reject fast with `circuit-open`
+    instead of burning lanes, batch retries, and the retry budgets of
+    every co-batched tenant — one failing shape class can no longer
+    starve every other tenant's throughput. After `cooldown_drains`
+    drain passes the breaker goes half-open: exactly one probe request
+    is re-admitted; success closes the breaker, failure re-opens it.
+    `k <= 0` disables the breaker entirely.
+    """
+
+    k: int = 3
+    cooldown_drains: int = 2
+
+    def __post_init__(self):
+        if self.cooldown_drains < 1:
+            raise ValueError(
+                f"cooldown_drains must be >= 1, got {self.cooldown_drains}"
+            )
+
+    @property
+    def enabled(self) -> bool:
+        return self.k > 0

@@ -11,8 +11,19 @@ streams per-request telemetry, and consumes the resilience layer's
 ElasticPolicy (grow when the queue is deep, shrink when idle, requeue
 rc-75 preemptions).
 
-`queue` and `bins` are stdlib-at-import (the telemetry/regress schema
-side reads their formats without jax); `service` imports jax lazily.
+The request plane is hardened (docs/SERVING.md "SLOs and admission"):
+per-request deadlines expire stale pending tickets at pop time, a
+bounded queue rejects over-depth submits fast with a retry-after hint,
+transient batch/numerical failures ride a bounded exponential-backoff
+retry budget, poison requests are quarantined to an append-only
+`quarantine.jsonl` ledger, and a per-BinKey circuit breaker stops one
+failing shape class from starving every other tenant. `slo.py` carries
+the SLO accounting and the `soak-report.json` schema the chaos soak
+driver (apps/soak.py) banks.
+
+`queue`, `bins`, and `slo` are stdlib-at-import (the telemetry/regress
+schema side reads their formats without jax); `service` imports jax
+lazily.
 """
 
 from rocm_mpi_tpu.serving.bins import (  # noqa: F401
@@ -23,8 +34,14 @@ from rocm_mpi_tpu.serving.bins import (  # noqa: F401
     steps_bucket,
 )
 from rocm_mpi_tpu.serving.queue import (  # noqa: F401
+    QUARANTINE_SCHEMA,
     REQUEST_SCHEMA,
     Request,
     RequestQueue,
     Ticket,
+)
+from rocm_mpi_tpu.serving.slo import (  # noqa: F401
+    SOAK_SCHEMA,
+    validate_soak_report,
+    write_soak_report,
 )

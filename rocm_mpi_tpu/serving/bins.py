@@ -283,6 +283,35 @@ def validate_manifest_doc(doc: dict) -> list[str]:
         isinstance(p, str) for p in progs
     ):
         problems.append("missing programs list")
+    queue = doc.get("queue")
+    if queue is not None:
+        if not isinstance(queue, dict):
+            problems.append("'queue' block is not an object")
+        else:
+            for field, v in queue.items():
+                if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                    problems.append(
+                        f"queue.{field} {v!r} is not a count"
+                    )
+            # The terminal accounting invariant (docs/SERVING.md "SLOs
+            # and admission"), enforced on the ARCHIVED manifest too:
+            # manifests are written at drain boundaries (nothing in
+            # flight), so every submitted ticket must be terminally
+            # accounted or still queued — requeued is a cumulative
+            # event count, not an outcome, and stays out of the sum.
+            terminal = ("completed", "failed", "rejected", "expired",
+                        "quarantined", "depth")
+            if "submitted" in queue and all(
+                isinstance(queue.get(k), int) for k in terminal
+            ):
+                total = sum(queue[k] for k in terminal)
+                if total != queue["submitted"]:
+                    problems.append(
+                        f"queue counters do not sum to submissions "
+                        f"({total} != {queue['submitted']}): every "
+                        f"submitted ticket must end done/failed/"
+                        f"rejected/expired/quarantined or still queued"
+                    )
     return problems
 
 

@@ -39,9 +39,16 @@ import pathlib
 import time
 from typing import Callable
 
+from rocm_mpi_tpu.resilience.policy import CircuitPolicy, RequestRetryPolicy
 from rocm_mpi_tpu.serving import bins as _bins
 from rocm_mpi_tpu.serving.bins import BinKey, BinStats
-from rocm_mpi_tpu.serving.queue import Request, RequestQueue, Ticket
+from rocm_mpi_tpu.serving.queue import (
+    Request,
+    RequestQueue,
+    Ticket,
+    append_quarantine,
+    quarantine_record,
+)
 
 # Physics fields each workload's config accepts from a request (anything
 # else fails the request loudly — a typo'd constant must not silently
@@ -83,6 +90,15 @@ class ServeConfig:
     device_budget: Callable[[], int] | None = None
     grow_queue_depth: int = 8  # depth that makes the policy consider a grow
     idle_shrink_drains: int = 3  # empty drains before shrinking back
+    # The request-plane SLO knobs (docs/SERVING.md "SLOs and
+    # admission"): admission bound (None = unbounded, the PR-13
+    # behavior), the retry budget/backoff for transient batch-level and
+    # numerical failures, the per-BinKey circuit breaker, and the
+    # append-only poison ledger (None = records kept in-process only).
+    max_depth: int | None = None
+    retry: RequestRetryPolicy | None = None  # None -> defaults
+    circuit: CircuitPolicy | None = None  # None -> defaults
+    quarantine_path: str | None = None
 
     def resolved_floor(self) -> float:
         if self.occupancy_floor is not None:
@@ -98,6 +114,9 @@ class ServeReport:
     served: int = 0
     failed: int = 0
     requeued: int = 0
+    rejected: int = 0
+    expired: int = 0
+    quarantined: int = 0
     preempted: bool = False
     bins: dict = dataclasses.field(default_factory=dict)
     programs: list = dataclasses.field(default_factory=list)
@@ -120,6 +139,9 @@ class ServeReport:
                 "served": self.served,
                 "failed": self.failed,
                 "requeued": self.requeued,
+                "rejected": self.rejected,
+                "expired": self.expired,
+                "quarantined": self.quarantined,
                 "preempted": self.preempted,
                 "elastic": list(self.elastic),
                 "compiles": dict(self.compiles),
@@ -178,6 +200,7 @@ class _Program:
         self.adapter = adapter
         self._base_np = None
         self._init = None
+        self._finite = None
 
     @property
     def base_np(self):
@@ -217,6 +240,38 @@ class _Program:
 
             self._init = init
         return self._init(scales_dev, *self.base_dev)
+
+    def lane_finite(self, leaves):
+        """(width,) bool, lane j True iff every element of every state
+        leaf in lane j is finite — the cheap compiled per-lane
+        finiteness reduction that extends tenant isolation to
+        NUMERICAL failure (docs/SERVING.md "SLOs and admission"). The
+        result is REPLICATED so every controller reads the identical
+        verdict from its addressable shards (an all-reduce, never a
+        divergence hazard); compiled once per program class, inside the
+        class's own compile window."""
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        if self._finite is None:
+            rep = NamedSharding(self.bgrid.mesh, PartitionSpec())
+
+            @functools.partial(jax.jit, out_shardings=rep)
+            def finite(*ls):
+                ok = None
+                for leaf in ls:
+                    f = jnp.all(
+                        jnp.isfinite(leaf),
+                        axis=tuple(range(1, leaf.ndim)),
+                    )
+                    ok = f if ok is None else ok & f
+                return ok
+
+            self._finite = finite
+        return self._finite(*leaves)
 
 
 class _Adapter:
@@ -357,24 +412,98 @@ _ADAPTERS = {
 }
 
 
+class _Breaker:
+    """One BinKey's circuit state (docs/SERVING.md "SLOs and
+    admission"): closed → (K consecutive batch failures) → open →
+    (cooldown drains) → half-open probe → closed on success, re-open on
+    failure. Purely a function of batch outcomes and drain counts —
+    deterministic across controllers by construction."""
+
+    __slots__ = ("consecutive", "state", "opened_drain")
+
+    def __init__(self):
+        self.consecutive = 0
+        self.state = "closed"
+        self.opened_drain = 0
+
+    def note_failure(self, policy: CircuitPolicy, drain: int) -> bool:
+        """Record one batch failure; True when this one OPENED (or
+        re-opened, from half-open) the breaker."""
+        self.consecutive += 1
+        tripped = (
+            policy.enabled
+            and self.state != "open"
+            and (self.state == "half-open"
+                 or self.consecutive >= policy.k)
+        )
+        if tripped:
+            self.state = "open"
+            self.opened_drain = drain
+        return tripped
+
+    def note_success(self) -> bool:
+        """Record one served batch; True when it CLOSED a half-open
+        breaker (the probe proved recovery)."""
+        recovered = self.state == "half-open"
+        self.consecutive = 0
+        self.state = "closed"
+        return recovered
+
+    def admit(self, policy: CircuitPolicy, drain: int, n: int) -> int:
+        """How many of `n` popped tickets this class admits THIS drain:
+        all of them (closed), none (open, cooling down), or exactly one
+        probe (half-open)."""
+        if not policy.enabled or self.state == "closed":
+            return n
+        if self.state == "open" \
+                and drain - self.opened_drain >= policy.cooldown_drains:
+            self.state = "half-open"
+        return min(n, 1) if self.state == "half-open" else 0
+
+
 class SimulationService:
     """Multi-tenant batched simulation service (module docstring; the
     CLI driver is apps/serve.py)."""
 
     def __init__(self, queue: RequestQueue | None = None,
                  config: ServeConfig | None = None):
-        self.queue = queue if queue is not None else RequestQueue()
         self.config = config if config is not None else ServeConfig()
+        self.queue = queue if queue is not None else RequestQueue(
+            max_depth=self.config.max_depth
+        )
+        self._retry = self.config.retry if self.config.retry is not None \
+            else RequestRetryPolicy()
+        self._circuit = self.config.circuit \
+            if self.config.circuit is not None else CircuitPolicy()
         self._floor = self.config.resolved_floor()
         self._batch_dims = int(self.config.batch_dims)
         self._models: dict = {}
         self._programs: dict[str, _Program] = {}
         self._stats: dict[BinKey, BinStats] = {}
+        self._breakers: dict[BinKey, _Breaker] = {}
         self._elastic: list[dict] = []
+        self._quarantined: list[dict] = []
         self._drains = 0
         self._idle_drains = 0
         self._last_resize_drain: int | None = None
         self._compiled_this_drain = False
+        self._batch_seq = 0  # global executed-batch ordinal (fault site)
+        self.retries_total = 0  # lifetime retry-requeues (SLO block)
+        self._admission_sync = {"rejected": 0, "expired": 0}
+        self._multi: bool | None = None
+
+    def _is_multi(self) -> bool:
+        """Multi-controller? Resolved once; also flips the queue's
+        wall-clock SLO decisions off (deadline expiry and retry backoff
+        diverge with rank-local clocks — the GL08 class; depth-based
+        admission stays on everywhere)."""
+        if self._multi is None:
+            import jax
+
+            self._multi = jax.process_count() > 1
+            if self._multi:
+                self.queue.wall_slo = False
+        return self._multi
 
     # ---- model / program caches ----------------------------------------
 
@@ -526,15 +655,33 @@ class SimulationService:
 
     def _execute_batch(self, key: BinKey, tickets: list[Ticket],
                        width: int, split: bool) -> None:
-        import jax
         import numpy as np
 
         from rocm_mpi_tpu import telemetry
+        from rocm_mpi_tpu.resilience import faults
         from rocm_mpi_tpu.telemetry import flight
+
+        # The serve-batch fault site, BEFORE the flight step bump and
+        # any collective: an infrastructure clause pinned here
+        # (`kill@step=2,rank=1,at=serve-batch`) strikes a rank before
+        # it bumps, so its peers advance past it and the health
+        # watchdog names the victim BY PROGRESS — the same ordering
+        # contract as the segment-pre site. The step bump itself feeds
+        # the watchdog: one progress step per executed batch.
+        self._batch_seq += 1
+        seq = self._batch_seq
+        faults.fault_point("serve-batch", step=seq)
+        clause = faults.serving_fault("batch-error", step=seq)
+        if clause is not None:
+            raise RuntimeError(f"injected batch-error (batch {seq})")
+        flight.progress(step_inc=1)
+        slow = faults.serving_fault("slow-batch", step=seq)
+        if slow is not None:
+            time.sleep(slow.delay_s)
 
         prog = self._program_for(key, width)
         bgrid = prog.bgrid
-        multi = jax.process_count() > 1
+        multi = self._is_multi()
 
         # Per-lane assembly, per-lane failure isolation: one tenant's
         # bad session (corrupt checkpoint, wrong workload's leaves,
@@ -560,8 +707,16 @@ class SimulationService:
                     leaves, _ = self._lane_start_state(
                         t.request, prog, start
                     )
-            except Exception as e:  # noqa: BLE001 — tenant isolation
+            except ValueError as e:
+                # A per-request validation error (bad session, resume
+                # past nt): the request itself is wrong — terminal,
+                # never retried.
                 self._fail_ticket(t, str(e))
+                continue
+            except Exception as e:  # noqa: BLE001 — tenant isolation
+                # Transient lane-assembly failure (corrupt checkpoint,
+                # storage flap on restore): retry within budget.
+                self._retry_or_quarantine(t, str(e))
                 continue
             j = len(live)
             live.append(t)
@@ -570,6 +725,17 @@ class SimulationService:
             scales[j] = t.request.ic_scale
             if not multi:
                 lanes.append(leaves)
+            if faults.serving_fault("lane-nan", request=t.ordinal) \
+                    is not None:
+                # Poison THIS lane's initial state (the numerical-
+                # failure drill): the finiteness reduction below must
+                # fail only this ticket while its co-batched neighbors
+                # stay bitwise-equal to their standalone twins.
+                scales[j] = float("nan")
+                if not multi:
+                    lanes[j] = tuple(
+                        l * float("nan") for l in lanes[j]
+                    )
             t.start_step = start
         if not live:
             return
@@ -607,6 +773,12 @@ class SimulationService:
             for leaf in out:
                 leaf.block_until_ready()
 
+        # The per-lane finiteness reduction (tenant isolation extended
+        # to NUMERICAL failure): a NaN/Inf lane fails only its own
+        # ticket — through the retry budget, so a persistently-poison
+        # request ends quarantined, never re-batched forever.
+        finite = np.asarray(prog.lane_finite(out))
+
         fetch = self.config.fetch_results
         if fetch is None:
             fetch = not multi
@@ -619,6 +791,16 @@ class SimulationService:
             host = tuple(np.asarray(leaf) for leaf in out)
         done = 0
         for j, t in enumerate(live):
+            if not bool(finite[j]):
+                telemetry.record_event(
+                    "serve.lane.nan",
+                    request_id=t.request.request_id,
+                    bin=key.key_str(), width=width, lane=j,
+                )
+                self._retry_or_quarantine(
+                    t, "non-finite state (NaN/Inf) in lane"
+                )
+                continue
             # Lane-isolated resolution: one tenant's failing session
             # save (unwritable dir, disk full) must not fail its
             # co-batched neighbors or skew the completion accounting.
@@ -629,17 +811,26 @@ class SimulationService:
                 )
                 if t.request.session and lane is not None:
                     self._save_session(t, lane, prog)
-            except Exception as e:  # noqa: BLE001 — tenant isolation
+            except ValueError as e:
                 self._fail_ticket(t, str(e))
+                continue
+            except Exception as e:  # noqa: BLE001 — tenant isolation
+                self._retry_or_quarantine(t, str(e))
                 continue
             t.steps_run = int(lane_steps[j])
             t._resolve(lane if fetch else None)
             done += 1
+            latency = t.age_s()
             telemetry.record_event(
                 "serve.request.done",
                 request_id=t.request.request_id,
                 bin=key.key_str(), width=width,
                 steps=int(lane_steps[j]), start=starts[j],
+                latency_s=round(latency, 6),
+                deadline_miss=bool(
+                    t.request.deadline_s is not None
+                    and latency > t.request.deadline_s
+                ),
             )
         self.queue.note_completed(done)
         flight.progress(serve_completed=done)
@@ -651,15 +842,124 @@ class SimulationService:
                       n, split=split)
 
     def _fail_ticket(self, t: Ticket, error: str) -> None:
-        """The one failure chokepoint: ticket, queue counter, AND the
-        serve_failed flight counter — the monitor's depth formula
-        (submitted − completed − requeued − failed) must see every
-        outcome, or a failed request reads as backlog forever."""
+        """The per-request-error chokepoint: ticket, queue counter, AND
+        the serve_failed flight counter — the monitor's depth formula
+        must see every outcome, or a failed request reads as backlog
+        forever. Terminal: validation errors never retry."""
         from rocm_mpi_tpu.telemetry import flight
 
         t._fail(error)
         self.queue.note_completed(0, failed=1)
         flight.progress(serve_failed=1)
+
+    def _retry_or_quarantine(self, t: Ticket, error: str) -> None:
+        """The transient-failure chokepoint (docs/SERVING.md "SLOs and
+        admission"): requeue with exponential backoff while the retry
+        budget lasts; a request that exhausts it is quarantined —
+        terminally, with its full record banked — never requeued
+        again."""
+        from rocm_mpi_tpu import telemetry
+        from rocm_mpi_tpu.telemetry import flight
+
+        if t.retries < self._retry.budget:
+            t.retries += 1
+            self.retries_total += 1
+            if self.queue.wall_slo:
+                t.not_before = time.monotonic() \
+                    + self._retry.backoff_s(t.retries)
+            # wake=False: the submitter keeps waiting for the retried
+            # batch's real resolution (unlike a preemption park).
+            self.queue.requeue([t], wake=False)
+            flight.progress(serve_retries=1)
+            telemetry.record_event(
+                "serve.request.retry",
+                request_id=t.request.request_id,
+                retries=t.retries, budget=self._retry.budget,
+                error=error,
+            )
+            return
+        self._quarantine_ticket(t, error)
+
+    def _quarantine_ticket(self, t: Ticket, error: str) -> None:
+        """Expel a poison request: terminal `quarantined` state, the
+        full request record appended to the quarantine.jsonl ledger for
+        offline repro, counters bumped — and NEVER requeued."""
+        from rocm_mpi_tpu import telemetry
+        from rocm_mpi_tpu.telemetry import flight
+
+        record = quarantine_record(t.request, error, t.retries)
+        self._quarantined.append(record)
+        if self.config.quarantine_path and self._ledger_writer():
+            append_quarantine(self.config.quarantine_path, record)
+        t._terminal_fail(
+            "quarantined",
+            f"{error} (retry budget {self._retry.budget} exhausted)",
+        )
+        self.queue.note_quarantined(1)
+        flight.progress(serve_quarantined=1)
+        telemetry.record_event(
+            "serve.request.quarantined",
+            request_id=t.request.request_id,
+            retries=t.retries, error=error,
+        )
+
+    def _ledger_writer(self) -> bool:
+        """One writer per ledger: in a multi-controller service every
+        rank reaches the same deterministic quarantine decision, so
+        only rank 0 appends — N identical records from N concurrent
+        writers would both inflate the poison count and risk
+        interleaved lines."""
+        if not self._is_multi():
+            return True
+        import jax
+
+        return jax.process_index() == 0
+
+    def _reject_ticket(self, t: Ticket, error: str) -> None:
+        """Admission rejection of an already-popped ticket (the circuit
+        breaker's fast-fail): terminal `rejected`."""
+        from rocm_mpi_tpu import telemetry
+        from rocm_mpi_tpu.telemetry import flight
+
+        t._terminal_fail("rejected", error)
+        self.queue.note_rejected(1)
+        flight.progress(serve_rejected=1)
+        telemetry.record_event(
+            "serve.request.rejected",
+            request_id=t.request.request_id, error=error,
+        )
+
+    def _sync_admission_counters(self) -> None:
+        """Mirror queue-side admission outcomes (submit-time
+        rejections, pop-time expiries) into the flight counters and the
+        telemetry stream — the SERVE badge and the SLO accounting must
+        see every outcome the queue decided without the service's
+        help."""
+        from rocm_mpi_tpu import telemetry
+        from rocm_mpi_tpu.telemetry import flight
+
+        c = self.queue.counters()
+        d_rej = self.queue.rejected_at_submit \
+            - self._admission_sync["rejected"]
+        if d_rej > 0:
+            self._admission_sync["rejected"] = \
+                self.queue.rejected_at_submit
+            # serve_submitted rides along: the badge's depth formula
+            # subtracts every outcome from it, and these tickets were
+            # never popped into a drain's serve_submitted bump (the
+            # circuit-open rejections of POPPED tickets are counted by
+            # _reject_ticket itself).
+            flight.progress(serve_rejected=d_rej, serve_submitted=d_rej)
+        for t in self.queue.take_expired():
+            telemetry.record_event(
+                "serve.request.expired",
+                request_id=t.request.request_id,
+                deadline_s=t.request.deadline_s, error=t.error,
+            )
+        d_exp = c["expired"] - self._admission_sync["expired"]
+        if d_exp > 0:
+            self._admission_sync["expired"] = c["expired"]
+            flight.progress(serve_expired=d_exp, serve_submitted=d_exp)
 
     def _preempt_requested(self) -> bool:
         from rocm_mpi_tpu.resilience import preempt
@@ -675,10 +975,15 @@ class SimulationService:
         from rocm_mpi_tpu.telemetry import compiles, flight
 
         self._drains += 1
+        self._is_multi()
         tickets = self.queue.pop_pending()
+        self._sync_admission_counters()
         telemetry.gauge("serve.queue_depth", float(len(tickets)))
         if not tickets:
-            self._idle_drains += 1
+            # Backoff-parked tickets are pending-but-ineligible work,
+            # not idleness — they must not trigger the idle shrink.
+            if self.queue.depth() == 0:
+                self._idle_drains += 1
             return 0, False
         self._idle_drains = 0
         flight.progress(serve_submitted=len(tickets))
@@ -698,6 +1003,26 @@ class SimulationService:
         pending: list[tuple[BinKey, list[Ticket], int, bool]] = []
         for key in sorted(groups):
             ts = groups[key]
+            # The circuit breaker's admission gate: an OPEN class
+            # rejects fast with circuit-open (one failing shape class
+            # must not starve every other tenant's throughput); a
+            # cooled-down class re-admits exactly ONE half-open probe.
+            br = self._breakers.get(key)
+            if br is None:
+                br = self._breakers[key] = _Breaker()
+            admit = br.admit(self._circuit, self._drains, len(ts))
+            if admit < len(ts):
+                telemetry.record_event(
+                    "serve.circuit.reject", bin=key.key_str(),
+                    state=br.state, rejected=len(ts) - admit,
+                )
+                for t in ts[admit:]:
+                    self._reject_ticket(
+                        t, f"circuit-open ({key.key_str()})"
+                    )
+                ts = ts[:admit]
+            if not ts:
+                continue
             widths = _bins.plan_batches(
                 len(ts), self.config.max_width, self._floor
             )
@@ -716,23 +1041,44 @@ class SimulationService:
                 self.queue.requeue(rest)
                 flight.progress(serve_requeued=len(rest))
                 break
+            br = self._breakers[key]
             try:
                 self._execute_batch(key, batch_ts, w, split)
                 served += sum(1 for t in batch_ts if t.state == "done")
+                if br.note_success():
+                    telemetry.record_event(
+                        "serve.circuit.close", bin=key.key_str(),
+                    )
             except Exception as e:  # noqa: BLE001 — tenant isolation:
-                # a batch-level failure (compile error, bad physics,
-                # device mismatch) must fail ITS tickets loudly and let
-                # the other bins' batches keep serving — an unhandled
-                # escape here would strand every later popped ticket in
-                # 'running' forever and kill the daemon without the
-                # rc-75 requeue path.
+                # a batch-level failure (compile error, injected
+                # batch-error, device mismatch) must fail ITS tickets
+                # and let the other bins' batches keep serving — an
+                # unhandled escape here would strand every later popped
+                # ticket in 'running' forever and kill the daemon
+                # without the rc-75 requeue path. The tickets ride the
+                # retry budget (transient faults requeue bounded, then
+                # quarantine); K consecutive failures open the class's
+                # circuit breaker.
                 telemetry.record_event(
                     "serve.batch.error", bin=key.key_str(), width=w,
                     error=str(e),
                 )
+                if br.note_failure(self._circuit, self._drains):
+                    telemetry.record_event(
+                        "serve.circuit.open", bin=key.key_str(),
+                        consecutive=br.consecutive,
+                    )
                 for t in batch_ts:
-                    if not t.done():
-                        self._fail_ticket(t, str(e))
+                    if not t.done() and t.state == "running":
+                        # Same routing as the lane level: a ValueError
+                        # is a per-request/program-class validation
+                        # error (unknown physics) — terminal, never
+                        # retried; anything else is transient and rides
+                        # the retry budget.
+                        if isinstance(e, ValueError):
+                            self._fail_ticket(t, str(e))
+                        else:
+                            self._retry_or_quarantine(t, str(e))
 
         if not preempted and not self._compiled_this_drain \
                 and self._programs:
@@ -825,7 +1171,13 @@ class SimulationService:
                 break
             if self.queue.depth() == 0:
                 break
+            # Pending work may all be backoff-parked: wait out the
+            # earliest retry eligibility instead of spinning.
+            delay = self.queue.next_ready_delay()
+            if delay:
+                time.sleep(min(delay, 0.25))
         self._finish_report(report)
+        self._assert_accounting()
         return report
 
     def serve_forever(self, poll_s: float = 0.05,
@@ -851,8 +1203,26 @@ class SimulationService:
                 time.sleep(poll_s)
             else:
                 idle_since = None
+                delay = self.queue.next_ready_delay()
+                if delay:
+                    time.sleep(min(delay, poll_s))
         self._finish_report(report)
+        self._assert_accounting()
         return report
+
+    def _assert_accounting(self) -> None:
+        """The drain-time terminal-accounting invariant (docs/
+        SERVING.md "SLOs and admission"): at a drain boundary nothing
+        is in flight, so every submitted ticket must be terminally
+        accounted or still queued — a leak here means some ticket
+        vanished into 'running' forever, the exact bug class the
+        invariant exists to catch loudly."""
+        problems = self.queue.check_accounting(in_flight=0)
+        if problems:
+            raise RuntimeError(
+                "serve accounting invariant violated at drain: "
+                + "; ".join(problems)
+            )
 
     def _finish_report(self, report: ServeReport) -> None:
         from rocm_mpi_tpu import telemetry
@@ -861,6 +1231,9 @@ class SimulationService:
         counters = self.queue.counters()
         report.failed = counters["failed"]
         report.requeued = counters["requeued"]
+        report.rejected = counters["rejected"]
+        report.expired = counters["expired"]
+        report.quarantined = counters["quarantined"]
         report.bins = dict(self._stats)
         report.programs = sorted(self._programs)
         report.elastic = list(self._elastic)

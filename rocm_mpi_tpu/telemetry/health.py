@@ -383,9 +383,12 @@ def serve_status(beats: dict[int, dict]) -> dict | None:
     (docs/SERVING.md; docs/TELEMETRY.md "Serving"), computed from the
     heartbeat progress counters the service's drain loop bumps
     (serve_submitted / serve_completed / serve_requeued /
-    serve_resizes are ADDITIVE counters — depth is their difference).
+    serve_resizes / serve_rejected / serve_expired / serve_quarantined
+    are ADDITIVE counters — depth is their difference; serve_retries
+    rides for visibility but is an event count, not an outcome).
     None when no rank ever served (the common case: no badge)."""
     submitted = completed = requeued = resizes = failed = 0
+    rejected = expired = quarantined = retries = 0
     seen = False
     for _rank, doc in sorted(beats.items()):
         counters = doc.get("counters") or {}
@@ -397,30 +400,54 @@ def serve_status(beats: dict[int, dict]) -> dict | None:
         requeued += int(counters.get("serve_requeued", 0) or 0)
         resizes += int(counters.get("serve_resizes", 0) or 0)
         failed += int(counters.get("serve_failed", 0) or 0)
+        rejected += int(counters.get("serve_rejected", 0) or 0)
+        expired += int(counters.get("serve_expired", 0) or 0)
+        quarantined += int(counters.get("serve_quarantined", 0) or 0)
+        retries += int(counters.get("serve_retries", 0) or 0)
     if not seen:
         return None
     return {
-        # Every outcome leaves the backlog — a failed request must not
-        # read as depth forever.
-        "depth": max(submitted - completed - requeued - failed, 0),
+        # Every outcome leaves the backlog — a failed/rejected/expired/
+        # quarantined request must not read as depth forever, and a
+        # retry-requeue hands the ticket back to the queue (it will be
+        # re-counted when re-popped), so retries subtract too.
+        "depth": max(
+            submitted - completed - requeued - failed - rejected
+            - expired - quarantined - retries, 0
+        ),
         "submitted": submitted,
         "completed": completed,
         "requeued": requeued,
         "resizes": resizes,
         "failed": failed,
+        "rejected": rejected,
+        "expired": expired,
+        "quarantined": quarantined,
+        "retries": retries,
     }
 
 
 def format_serve_status(status: dict | None) -> str | None:
     """`[SERVE depth=3 — 17 done]` while requests are in flight; the
     quieter `serve idle (17 done)` once drained; requeued work
-    (preemption) and elastic resizes ride along. None when the run
-    never served."""
+    (preemption), elastic resizes, and the SLO outcomes — deadline
+    misses (expired), quarantined poison, admission rejections — ride
+    along, so a poisoned or overloaded service is visible from the
+    sidecar alone (docs/SERVING.md "SLOs and admission"). None when
+    the run never served."""
     if not status:
         return None
     tail = f"{status['completed']} done"
     if status.get("failed"):
         tail += f", {status['failed']} failed"
+    if status.get("expired"):
+        tail += f", {status['expired']} deadline-missed"
+    if status.get("quarantined"):
+        tail += f", {status['quarantined']} quarantined"
+    if status.get("rejected"):
+        tail += f", {status['rejected']} rejected"
+    if status.get("retries"):
+        tail += f", {status['retries']} retried"
     if status["requeued"]:
         tail += f", {status['requeued']} requeued"
     if status["resizes"]:

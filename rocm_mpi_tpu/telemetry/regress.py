@@ -175,6 +175,7 @@ def _classify_json(doc: dict) -> str | None:
     )
 
     from rocm_mpi_tpu.serving.bins import BIN_MANIFEST_SCHEMA
+    from rocm_mpi_tpu.serving.slo import SOAK_SCHEMA
 
     named = {
         SUMMARY_SCHEMA: "telemetry summary",
@@ -184,6 +185,7 @@ def _classify_json(doc: dict) -> str | None:
         FINDINGS_SCHEMA: "graftlint findings artifact",
         BASELINE_SCHEMA: "graftlint baseline",
         BIN_MANIFEST_SCHEMA: "serving bin manifest",
+        SOAK_SCHEMA: "soak report",
     }
     if doc.get("schema") in named:
         return named[doc["schema"]]
@@ -227,6 +229,10 @@ def _validate_classified(doc: dict, kind: str) -> list[str]:
         from rocm_mpi_tpu.serving.bins import validate_manifest_doc
 
         return validate_manifest_doc(doc)
+    if kind == "soak report":
+        from rocm_mpi_tpu.serving.slo import validate_soak_report
+
+        return validate_soak_report(doc)
     return []
 
 
@@ -238,8 +244,9 @@ _WIRE_MODES = ("f32", "bf16", "int8", "int8_delta")
 
 # Serving sidecar schema markers (rocm_mpi_tpu/serving/{queue,bins}.py
 # are stdlib-at-import on purpose — the validators import directly).
-# tests/test_serving.py pins this spelling against serving.queue.
+# tests/test_serving.py pins these spellings against serving.queue.
 _SERVE_REQUEST_SCHEMA = "rmt-serve-request"
+_QUARANTINE_SCHEMA = "rmt-serve-quarantine"
 
 
 def _validate_perf_budgets(doc: dict) -> list[str]:
@@ -381,6 +388,13 @@ def check_schema(paths) -> list[str]:
                     )
 
                     for p in validate_request_record(doc):
+                        problems.append(f"{raw}:{i}: {p}")
+                elif doc.get("schema") == _QUARANTINE_SCHEMA:
+                    from rocm_mpi_tpu.serving.queue import (
+                        validate_quarantine_record,
+                    )
+
+                    for p in validate_quarantine_record(doc):
                         problems.append(f"{raw}:{i}: {p}")
                 elif doc.get("kind") == "event":
                     for p in _validate_event_record(doc):

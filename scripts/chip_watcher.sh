@@ -133,14 +133,18 @@ archive_telemetry() {
   # telemetry sink — archive them under per-drill names so the shrink→
   # grow and preempted-eviction decision trails survive a flap, and so
   # lint.sh's schema glob (docs/telemetry_r*/elastic*.jsonl) gates them.
-  # Serving sidecars (docs/SERVING.md): the serve smoke's bin manifest
-  # and request trace — the compile-amortization evidence (programs ==
-  # bins, steady_state == 0) for this burst's backend. Archived under
-  # docs/telemetry_r5/ where lint.sh's serve-manifest*/serve-requests*
-  # schema globs gate them.
+  # Soak + serving sidecars (docs/SERVING.md; docs/RESILIENCE.md §8):
+  # the bounded soak's schema-versioned report (SLO block, episode
+  # verdicts), its append-only quarantine ledger, and the per-episode
+  # bin manifests — the burst's all-planes-compose evidence. Archived
+  # under docs/telemetry_r5/ where lint.sh's soak-report*/quarantine*/
+  # serve-manifest* schema globs gate them.
   local s
-  for s in output/serve_smoke/serve-manifest.json \
-           output/serve_smoke/serve-requests.jsonl; do
+  for s in output/soak/soak-report.json \
+           output/soak/quarantine.jsonl \
+           output/soak/serve-manifest-*.json \
+           output/soak/gloo-serve/serve-manifest.json \
+           output/soak/gloo-serve/serve-requests.jsonl; do
     [ -s "$s" ] || continue
     mkdir -p docs/telemetry_r5
     cp -p "$s" docs/telemetry_r5/ && found=$((found + 1))
@@ -202,19 +206,21 @@ run_tuning_search() {
     || echo "[watcher] tuning search rc=$? (continuing; cache keeps prior winners)"
 }
 
-run_serve_smoke() {
-  # Bounded multi-tenant serve smoke (docs/SERVING.md): a deterministic
-  # heterogeneous synthetic trace through apps/serve.py on the real
-  # backend — proves the batched program classes compile and the
-  # steady-state contract holds on-chip, and banks the bin manifest +
-  # request trace (archive_telemetry copies them; lint.sh schema-checks
-  # the archived copies). Small trace + timeout so a wedged backend
-  # cannot eat the window.
-  echo "[watcher] serve smoke (batched multi-tenant trace)"
-  timeout -k 15 600 python apps/serve.py \
-    --synthetic 12 --seed 7 --nt-max 64 --max-width 4 \
-    --out output/serve_smoke \
-    || echo "[watcher] serve smoke rc=$? (continuing)"
+run_soak() {
+  # The bounded chaos soak (docs/RESILIENCE.md §8, ROADMAP item 5) —
+  # the ad-hoc serve smoke, grown up: one episode per fault family
+  # (queue-flood admission storms, NaN-lane quarantine, circuit-breaker
+  # open→half-open→recover, session-save storage outages, a real
+  # SIGTERM eviction, and the 2-rank gloo serve + kill drills) under a
+  # deterministic rolling schedule, with SLO accounting (latency
+  # p50/p99 from real telemetry, deadline-miss rate, rejected/expired/
+  # quarantined totals) banked atomically in soak-report.json plus the
+  # append-only quarantine.jsonl poison ledger (archive_telemetry
+  # copies both; lint.sh schema-checks the archived copies). Bounded +
+  # timeout so a wedged backend cannot eat the window.
+  echo "[watcher] bounded chaos soak (all fault planes composed)"
+  timeout -k 15 900 python apps/soak.py --bounded --out output/soak \
+    || echo "[watcher] soak rc=$? (continuing; report still archived)"
 }
 
 group_log() { echo "docs/tpu_tier_${1}_r5.txt"; }
@@ -304,7 +310,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     bash scripts/run_chip_queue.sh
     queue_rc=$?
     run_tuning_search
-    run_serve_smoke
+    run_soak
     run_tier_groups
     archive_telemetry
     if headline_done && [ "$queue_rc" -eq 0 ] && tier_done; then

@@ -75,15 +75,22 @@ health_records+=(
   docs/telemetry_r*/telemetry-rank*.jsonl
 )
 # Serving sidecars (docs/SERVING.md): the bin manifest + request trace
-# apps/serve.py banks per run (and chip_watcher archives per burst).
-# A drifted writer bricks the schema-checked serving accounting the
-# next time anyone audits a trace's compile count — catch it here.
-# (wildcard-bearing paths only, same nullglob discipline as above)
+# apps/serve.py banks per run (and chip_watcher archives per burst),
+# plus the request-plane hardening artifacts — the append-only
+# quarantine.jsonl poison ledger and the chaos soak's soak-report.json
+# (docs/RESILIENCE.md §8). A drifted writer bricks the schema-checked
+# serving accounting the next time anyone audits a trace's compile
+# count — or reads a poisoned service's incident ledger — catch it
+# here. (wildcard-bearing paths only, same nullglob discipline)
 health_records+=(
   output/*/serve-manifest*.json
   output/*/serve-requests*.jsonl
+  output/*/quarantine*.jsonl
+  output/*/soak-report*.json
   docs/telemetry_r*/serve-manifest*.json
   docs/telemetry_r*/serve-requests*.jsonl
+  docs/telemetry_r*/quarantine*.jsonl
+  docs/telemetry_r*/soak-report*.json
 )
 # The graftlint artifacts: the findings document stage 1 just banked
 # (plus any chip_watcher-archived copies) and the committed baseline.

@@ -1,0 +1,28 @@
+"""GL09 true negatives for the request-plane sidecars (ISSUE 14): the
+two committed disciplines as the real writers spell them —
+serving/queue.append_quarantine (append-only JSONL) and
+serving/slo.write_soak_report (tmp+rename).
+
+Never imported — parsed only (tests/test_analysis_rules.py).
+"""
+
+import json
+import os
+
+
+def append_quarantine_record(path, doc):
+    # Append-only: the incident ledger's discipline — a torn final line
+    # is droppable, every complete line stays valid, nothing banked is
+    # ever rewritten.
+    record = {"schema": "rmt-serve-quarantine", "v": 1, **doc}
+    with open(path, "a") as fh:
+        fh.write(json.dumps(record) + "\n")
+
+
+def write_soak_report_atomic(path, doc):
+    # tmp + os.replace: the reference shape (serving/slo.py).
+    record = {"schema": "rmt-soak-report", "v": 1, **doc}
+    tmp = str(path) + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(record, fh)
+    os.replace(tmp, path)

@@ -1,0 +1,37 @@
+"""GL09 true positives for the request-plane sidecars (ISSUE 14): the
+doctored in-place twins of the REAL quarantine and soak-report writers
+(serving/queue.append_quarantine is append-only; serving/slo.
+write_soak_report is tmp+rename — these twins drop the discipline and
+must fire).
+
+Never imported — parsed only (tests/test_analysis_rules.py).
+"""
+
+import json
+
+
+def write_quarantine_in_place(directory, records):
+    # The doctored twin of append_quarantine: REWRITES the whole poison
+    # ledger in "w" mode — a reader tailing the incident trail mid-write
+    # sees a torn file, and every previously-banked line is at risk.
+    path = f"{directory}/quarantine.jsonl"
+    with open(path, "w") as fh:  # GL09
+        for doc in records:
+            fh.write(json.dumps(doc) + "\n")
+
+
+def write_soak_report_in_place(path, episodes, slo):
+    # The doctored twin of slo.write_soak_report: dumps the
+    # schema-versioned report straight onto the final path — the one
+    # artifact a multi-hour soak leaves behind, torn by a mid-write flap.
+    doc = {"schema": "rmt-soak-report", "v": 1, "episodes": episodes,
+           "slo": slo}
+    with open(path, "w") as fh:  # GL09
+        json.dump(doc, fh)
+
+
+def write_quarantine_by_name(directory, line):
+    # Even with an opaque payload, the path names the quarantine family:
+    # evidence enough (write_text form).
+    target = directory / "quarantine.jsonl"
+    target.write_text(json.dumps(line))  # GL09

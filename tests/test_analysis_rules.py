@@ -336,6 +336,77 @@ def test_gl09_accepts_both_disciplines():
     ]
 
 
+def test_gl09_serving_sidecar_twins():
+    """The request-plane hardening's sidecars (ISSUE 14): the REAL
+    writers lint clean — serving/queue.append_quarantine is
+    append-only, serving/slo.write_soak_report is tmp+rename — while
+    their doctored in-place twins fire (payload-schema evidence for
+    both, plus the quarantine family name alone as path evidence)."""
+    findings = [
+        f for f in lint_fixture("gl09_serving_pos.py")
+        if f.rule == "GL09" and not f.suppressed
+    ]
+    assert len(findings) == 3, [(f.line, f.message) for f in findings]
+    neg = lint_fixture("gl09_serving_neg.py")
+    assert "GL09" not in live_rules(neg), [
+        (f.line, f.message) for f in neg if f.rule == "GL09"
+    ]
+    repo = pathlib.Path(__file__).parent.parent
+    for mod in ("serving/queue.py", "serving/slo.py"):
+        real = (repo / "rocm_mpi_tpu" / mod).read_text()
+        real_findings = lint_source(real, f"rocm_mpi_tpu/{mod}")
+        assert "GL09" not in live_rules(real_findings), (
+            mod,
+            [(f.line, f.message) for f in real_findings
+             if f.rule == "GL09"],
+        )
+
+
+def test_serving_fault_kinds_parse_and_consume():
+    """The serving-plane fault grammar (docs/SERVING.md "SLOs and
+    admission"): the four kinds parse with their triggers, serving
+    clauses are invisible to the raising fault_point (their step
+    numbering is batches, not simulation steps), and serving_fault
+    consumes fires exactly like every other clause."""
+    from rocm_mpi_tpu.resilience import faults
+
+    plan = faults.FaultPlan.parse(
+        "lane-nan@request=3,times=2;batch-error@step=2;"
+        "slow-batch=0.25@step=4;queue-flood=20@step=1"
+    )
+    kinds = [c.kind for c in plan.clauses]
+    assert kinds == ["lane-nan", "batch-error", "slow-batch",
+                     "queue-flood"]
+    assert plan.clauses[0].request == 3 and plan.clauses[0].times == 2
+    assert plan.clauses[2].delay_s == 0.25
+    assert plan.clauses[3].delay_s == 20.0
+
+    faults.install(
+        "batch-error@step=2;lane-nan@request=1"
+    )
+    try:
+        # Invisible to the generic fault point — even at a matching
+        # step count on a legacy site.
+        faults.fault_point("step", step=2)
+        faults.fault_point("segment", step=2)
+        # serving_fault matches, consumes, and re-arms per times=.
+        assert faults.serving_fault("batch-error", step=1) is None
+        clause = faults.serving_fault("batch-error", step=2)
+        assert clause is not None and clause.kind == "batch-error"
+        assert faults.serving_fault("batch-error", step=2) is None
+        assert faults.serving_fault("lane-nan", request=2) is None
+        assert faults.serving_fault("lane-nan", request=1) is not None
+    finally:
+        faults.install(None)
+
+    with pytest.raises(ValueError, match="request=N"):
+        faults.FaultPlan.parse("lane-nan@step=3")
+    with pytest.raises(ValueError, match="step=N"):
+        faults.FaultPlan.parse("batch-error")
+    with pytest.raises(ValueError, match="request"):
+        faults.FaultPlan.parse("kill@request=3")
+
+
 def test_gl08_fires_inside_shadowed_defs():
     """index_functions' last-wins-by-bare-name dedup is a
     call-RESOLUTION heuristic only: every def body — shadowed defs and

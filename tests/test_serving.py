@@ -22,6 +22,7 @@ import json
 import pathlib
 import subprocess
 import sys
+import time
 
 import jax
 import jax.numpy as jnp
@@ -530,11 +531,17 @@ def test_requeued_ticket_result_returns_none_promptly():
     assert t.state == "running" and not t.done()
 
 
-def test_drain_survives_non_value_batch_errors(monkeypatch):
+def test_transient_batch_error_retries_then_serves(monkeypatch):
     """A non-ValueError batch failure (e.g. checkpoint corruption is a
-    RuntimeError) fails ITS tickets and lets later batches serve —
-    never strands popped tickets in 'running' or kills the drain."""
-    svc = SimulationService(config=ServeConfig(max_width=1))
+    RuntimeError) is TRANSIENT: the tickets ride the retry budget and
+    the retried batch serves them — never strands popped tickets in
+    'running', never kills the drain, and never dies on first fault."""
+    from rocm_mpi_tpu.resilience.policy import RequestRetryPolicy
+
+    svc = SimulationService(config=ServeConfig(
+        max_width=1, retry=RequestRetryPolicy(budget=2,
+                                              backoff_base_s=0.0),
+    ))
     orig = svc._execute_batch
     calls = {"n": 0}
 
@@ -554,10 +561,372 @@ def test_drain_survives_non_value_batch_errors(monkeypatch):
         dtype="f64", nt=3,
     ))
     report = svc._drain_all()
-    assert report.failed == 1 and report.served == 1
-    with pytest.raises(RuntimeError, match="bit rot"):
-        t1.result(timeout=5)
+    assert report.failed == 0 and report.served == 2
+    assert t1.retries == 1 and t1.state == "done"
+    assert t1.result(timeout=5) is not None
     assert t2.result(timeout=5) is not None
+
+
+def test_retry_budget_exhausted_quarantines(tmp_path, monkeypatch):
+    """A request that fails EVERY batch it joins must not be re-batched
+    forever: after the retry budget it is terminally quarantined, its
+    full record banked to the append-only ledger for offline repro —
+    and the accounting invariant still balances."""
+    from rocm_mpi_tpu.resilience.policy import (
+        CircuitPolicy,
+        RequestRetryPolicy,
+    )
+    from rocm_mpi_tpu.serving.queue import (
+        load_quarantine,
+        validate_quarantine_record,
+    )
+
+    qpath = tmp_path / "quarantine.jsonl"
+    svc = SimulationService(config=ServeConfig(
+        max_width=1,
+        retry=RequestRetryPolicy(budget=2, backoff_base_s=0.0),
+        # the breaker would otherwise open mid-drill and reject the
+        # retries before the budget empties
+        circuit=CircuitPolicy(k=0),
+        quarantine_path=str(qpath),
+    ))
+
+    def always_broken(key, tickets, width, split):
+        raise RuntimeError("poison program class")
+
+    monkeypatch.setattr(svc, "_execute_batch", always_broken)
+    t = svc.queue.submit(Request(
+        request_id="poison-1", workload="diffusion",
+        global_shape=(16, 16), dtype="f64", nt=2, ic_scale=1.5,
+    ))
+    report = svc._drain_all()
+    assert report.quarantined == 1 and report.failed == 0
+    assert t.state == "quarantined" and t.retries == 2
+    with pytest.raises(RuntimeError, match="quarantined"):
+        t.result(timeout=5)
+    assert svc.queue.check_accounting() == []
+
+    records = load_quarantine(qpath)
+    assert len(records) == 1
+    rec = records[0]
+    assert validate_quarantine_record(rec) == []
+    assert rec["request_id"] == "poison-1"
+    assert rec["retries"] == 2
+    # the FULL request record rides along for offline repro
+    from rocm_mpi_tpu.serving.queue import request_from_record
+
+    replay = request_from_record(rec["request"])
+    assert replay.ic_scale == 1.5 and replay.nt == 2
+
+    from rocm_mpi_tpu.telemetry.regress import check_schema
+
+    assert check_schema([qpath]) == []
+    # a doctored record (no error, negative retries) fails the gate
+    import copy
+
+    bad = copy.deepcopy(rec)
+    bad["retries"] = -1
+    del bad["error"]
+    bad_path = tmp_path / "bad-quarantine.jsonl"
+    bad_path.write_text(json.dumps(bad) + "\n")
+    assert check_schema([bad_path]) != []
+
+
+def test_deadline_expires_pending_at_pop():
+    """A pending ticket past its deadline fails with deadline-exceeded
+    AT POP TIME — it never occupies a lane; a ticket with headroom
+    serves normally (docs/SERVING.md "SLOs and admission")."""
+    q = RequestQueue()
+    stale = q.submit(Request(request_id="stale", deadline_s=1e-6))
+    fresh = q.submit(Request(request_id="fresh", deadline_s=3600.0))
+    popped = q.pop_pending()
+    assert [t.request.request_id for t in popped] == ["fresh"]
+    assert stale.state == "expired"
+    with pytest.raises(RuntimeError, match="deadline-exceeded"):
+        stale.result(timeout=5)
+    c = q.counters()
+    assert c["expired"] == 1
+    assert [t.request.request_id for t in q.take_expired()] == ["stale"]
+    assert q.check_accounting(in_flight=1) == []
+
+
+def test_deadline_validation():
+    with pytest.raises(ValueError, match="deadline_s"):
+        Request(request_id="x", deadline_s=-1.0)
+    rec = request_to_record(Request(request_id="ok", deadline_s=2.5))
+    assert rec["deadline_s"] == 2.5
+    assert request_from_record(rec).deadline_s == 2.5
+    rec["deadline_s"] = 0
+    assert any("deadline_s" in p for p in validate_request_record(rec))
+
+
+def test_queue_full_rejects_fast_with_retry_after():
+    """Admission control: an over-depth submit returns a terminally
+    rejected ticket carrying a retry-after hint — fast, never silently
+    dropped — and the books still balance."""
+    q = RequestQueue(max_depth=2)
+    a = q.submit(Request(request_id="a"))
+    b = q.submit(Request(request_id="b"))
+    c = q.submit(Request(request_id="c"))
+    assert c.state == "rejected" and c.done()
+    assert "queue-full" in c.error and "retry-after" in c.error
+    with pytest.raises(RuntimeError, match="queue-full"):
+        c.result(timeout=5)
+    assert q.depth() == 2
+    counters = q.counters()
+    assert counters["rejected"] == 1 and counters["submitted"] == 3
+    assert q.check_accounting() == []
+    assert q.retry_after_hint() > 0
+    del a, b
+
+
+def test_requeue_preserves_original_relative_order():
+    """Satellite: requeue-at-front is ORDER-PINNED by submission
+    ordinal — a 3-ticket preemption requeue (and any sequence of
+    single-ticket requeues) replays in original relative order, ahead
+    of new arrivals."""
+    q = RequestQueue()
+    t1 = q.submit(Request(request_id="r1"))
+    t2 = q.submit(Request(request_id="r2"))
+    t3 = q.submit(Request(request_id="r3"))
+    popped = q.pop_pending()
+    assert [t.request.request_id for t in popped] == ["r1", "r2", "r3"]
+    # the 3-ticket preemption requeue: one call, original order kept
+    q.requeue([t1, t2, t3])
+    q.submit(Request(request_id="r4"))
+    assert [t.request.request_id for t in q.pop_pending()] == \
+        ["r1", "r2", "r3", "r4"]
+    # the ADVERSARIAL shape: per-batch retry requeues land one at a
+    # time, out of submission order — the pop must still replay them
+    # in original relative order (the old front-prepend had no pin).
+    q.requeue([t3])
+    q.requeue([t1])
+    q.requeue([t2])
+    assert [t.request.request_id for t in q.pop_pending()] == \
+        ["r1", "r2", "r3"]
+
+
+def test_retry_park_timeout_raises_not_none():
+    """A RETRY-parked ticket is still owned by the live service: a
+    result() timeout during its backoff window raises TimeoutError —
+    the preemption None (an invitation to re-submit) would cause
+    duplicate execution of a request that is about to be retried."""
+    q = RequestQueue()
+    t = q.submit(Request(request_id="rp"))
+    q.pop_pending()
+    q.requeue([t], wake=False)
+    with pytest.raises(TimeoutError):
+        t.result(timeout=0.05)
+    # the preemption park keeps its prompt-None contract
+    q.pop_pending()
+    q.requeue([t], wake=True)
+    assert t.result(timeout=5) is None
+
+
+def test_retry_backoff_parks_until_eligible():
+    """A backoff-parked ticket stays in place at pop time (FIFO
+    position preserved) and becomes eligible once not_before passes."""
+    q = RequestQueue()
+    t1 = q.submit(Request(request_id="b1"))
+    t2 = q.submit(Request(request_id="b2"))
+    q.pop_pending()
+    t1.not_before = time.monotonic() + 30.0
+    q.requeue([t1, t2], wake=False)
+    popped = q.pop_pending()
+    assert [t.request.request_id for t in popped] == ["b2"]
+    assert q.depth() == 1
+    delay = q.next_ready_delay()
+    assert delay is not None and 25.0 < delay <= 30.0
+    t1.not_before = 0.0
+    assert [t.request.request_id for t in q.pop_pending()] == ["b1"]
+
+
+def test_circuit_breaker_opens_and_half_open_recovers():
+    """The breaker arc (docs/SERVING.md "SLOs and admission"): K=3
+    consecutive injected batch errors open one program class — its
+    pending requests reject fast with circuit-open while a healthy
+    class keeps serving — and after the cooldown a single half-open
+    probe recovers it."""
+    from rocm_mpi_tpu.resilience import faults
+    from rocm_mpi_tpu.resilience.policy import (
+        CircuitPolicy,
+        RequestRetryPolicy,
+    )
+
+    svc = SimulationService(config=ServeConfig(
+        max_width=2,
+        retry=RequestRetryPolicy(budget=1, backoff_base_s=0.0),
+        circuit=CircuitPolicy(k=3, cooldown_drains=2),
+    ))
+    # Drain 1 executes the (16,16) class's three width-2 batches first
+    # (sorted bin keys), then (24,24): the three errors strike exactly
+    # the first class.
+    faults.install(
+        "batch-error@step=1;batch-error@step=2;batch-error@step=3"
+    )
+    try:
+        sick, healthy = [], []
+        for i in range(6):
+            sick.append(svc.queue.submit(Request(
+                request_id=f"sick-{i}", workload="diffusion",
+                global_shape=(16, 16), dtype="f64", nt=3,
+            )))
+        for i in range(2):
+            healthy.append(svc.queue.submit(Request(
+                request_id=f"ok-{i}", workload="diffusion",
+                global_shape=(24, 24), dtype="f64", nt=3,
+            )))
+        svc._drain_all()
+        key = sbins.bin_key(sick[0].request)
+        br = svc._breakers[key]
+        assert br.state == "open"
+        for t in healthy:
+            assert t.state == "done", (t.request.request_id, t.error)
+        # the open class rejected its (retried) tickets fast
+        rejected = [t for t in sick if t.state == "rejected"]
+        assert rejected and all(
+            "circuit-open" in t.error for t in rejected
+        )
+        # cooldown passes as empty drains tick by
+        svc.drain_once()
+        svc.drain_once()
+        probe = svc.queue.submit(Request(
+            request_id="probe", workload="diffusion",
+            global_shape=(16, 16), dtype="f64", nt=3,
+        ))
+        svc._drain_all()
+        assert probe.state == "done", probe.error
+        assert br.state == "closed"
+        assert svc.queue.check_accounting() == []
+    finally:
+        faults.install(None)
+
+
+def test_combined_chaos_drill(tmp_path, monkeypatch):
+    """SATELLITE 3 — the combined chaos drill: one deterministic run
+    with faults across all three layers — a queue-flood admission storm
+    (grammar-driven), TWO NaN-poisoned lanes, a SIGTERM eviction at a
+    batch boundary, and an injected storage outage on a session save —
+    asserting the co-batched healthy tenants stay BITWISE-equal to
+    their standalone twins and every submitted ticket is terminally
+    accounted."""
+    from rocm_mpi_tpu.resilience import faults
+    from rocm_mpi_tpu.resilience.policy import RequestRetryPolicy
+    from rocm_mpi_tpu.serving.queue import load_quarantine
+    from rocm_mpi_tpu.utils import checkpoint as ckpt
+
+    sessions = tmp_path / "sessions"
+    qpath = tmp_path / "quarantine.jsonl"
+    svc = SimulationService(config=ServeConfig(
+        max_width=2, max_depth=8, sessions_dir=str(sessions),
+        retry=RequestRetryPolicy(budget=1, backoff_base_s=0.0),
+        quarantine_path=str(qpath),
+    ))
+    # Ordinals are 1-based submission numbers: 2 and 4 are the poison
+    # lanes (times=9 outlasts the budget so they quarantine); the
+    # session save at step 6 gets a 3-attempt io-error outage that
+    # exhausts the checkpoint retry ladder once.
+    faults.install(
+        "lane-nan@request=2,times=9;lane-nan@request=4,times=9;"
+        "io-error@step=6,times=3;queue-flood=8@step=2"
+    )
+    try:
+        h0 = svc.queue.submit(Request(
+            request_id="healthy-0", workload="diffusion",
+            global_shape=(16, 16), dtype="f64", nt=5, ic_scale=1.1,
+        ))
+        p_a = svc.queue.submit(Request(
+            request_id="poison-a", workload="diffusion",
+            global_shape=(16, 16), dtype="f64", nt=5, ic_scale=1.7,
+        ))
+        h1 = svc.queue.submit(Request(
+            request_id="healthy-1", workload="diffusion",
+            global_shape=(16, 16), dtype="f64", nt=5, ic_scale=1.2,
+        ))
+        p_b = svc.queue.submit(Request(
+            request_id="poison-b", workload="diffusion",
+            global_shape=(16, 16), dtype="f64", nt=5, ic_scale=1.9,
+        ))
+        store = svc.queue.submit(Request(
+            request_id="store", workload="diffusion",
+            global_shape=(16, 16), dtype="f64", nt=6,
+            session="chaos-s",
+        ))
+        h2 = svc.queue.submit(Request(
+            request_id="healthy-2", workload="diffusion",
+            global_shape=(16, 16), dtype="f64", nt=6, ic_scale=1.3,
+        ))
+
+        # The SIGTERM eviction lands at the SECOND batch boundary of
+        # drain 1: batch one executes, the rest requeues (rc-75 shape).
+        calls = {"n": 0}
+        orig_preempt = svc._preempt_requested
+
+        def evict_once():
+            calls["n"] += 1
+            return calls["n"] == 2
+
+        monkeypatch.setattr(svc, "_preempt_requested", evict_once)
+
+        flood_tickets = []
+        drain = 0
+        while True:
+            drain += 1
+            clause = faults.serving_fault("queue-flood", step=drain)
+            if clause is not None:
+                for i in range(int(clause.delay_s)):
+                    flood_tickets.append(svc.queue.submit(Request(
+                        request_id=f"flood-{i}", workload="diffusion",
+                        global_shape=(16, 16), dtype="f64", nt=2,
+                        ic_scale=1.0 + 0.01 * i,
+                    )))
+            _, preempted = svc.drain_once()
+            if preempted:
+                continue  # the eviction passed; next drain resumes
+            if svc.queue.depth() == 0:
+                break
+            delay = svc.queue.next_ready_delay()
+            if delay:
+                time.sleep(min(delay, 0.25))
+            assert drain < 60, "chaos drill did not converge"
+
+        # (1) terminal accounting: every submitted ticket ended in
+        # exactly one terminal state
+        assert svc.queue.check_accounting() == []
+        c = svc.queue.counters()
+        assert c["quarantined"] == 2, c
+        assert c["rejected"] >= 1, c  # the flood hit the depth bound
+        assert c["requeued"] >= 1, c  # the eviction parked work
+
+        # (2) the poison lanes — and ONLY they — were expelled
+        assert p_a.state == "quarantined" and p_b.state == "quarantined"
+        assert len(load_quarantine(qpath)) == 2
+
+        # (3) the storage outage cost one lane retry, then a durable save
+        assert store.state == "done" and store.retries >= 1
+        assert ckpt.latest_valid_step(sessions / "chaos-s") == 6
+
+        # (4) co-batched healthy tenants: bitwise-equal to standalone
+        # twins despite sharing batches with NaN lanes, an eviction,
+        # and a storage outage
+        cfg = DiffusionConfig(global_shape=(16, 16), nt=8, warmup=0,
+                              dtype="f64", dims=(1, 1))
+        m = HeatDiffusion(cfg, devices=jax.devices()[:1])
+        T0, Cp = m.init_state()
+        adv = m.advance_fn("shard")
+        for t in (h0, h1, h2):
+            out = t.result(timeout=5)
+            assert out is not None, (t.request.request_id, t.state)
+            ref = np.asarray(adv(
+                jnp.asarray(np.asarray(T0) * t.request.ic_scale), Cp,
+                t.request.nt,
+            ))
+            assert np.array_equal(out[0], ref), t.request.request_id
+        served_flood = [t for t in flood_tickets if t.state == "done"]
+        assert served_flood, "the admitted flood slice was never served"
+        monkeypatch.setattr(svc, "_preempt_requested", orig_preempt)
+    finally:
+        faults.install(None)
 
 
 def test_service_preemption_requeues_and_reports(monkeypatch):
@@ -641,6 +1010,63 @@ def test_serve_status_badge():
     assert st["depth"] == 0
     assert health.format_serve_status(st) == \
         "serve idle (4 done, 1 failed)"
+
+
+def test_serve_badge_shows_slo_outcomes():
+    """Satellite: a poisoned/overloaded service is visible from the
+    heartbeat sidecar alone — deadline misses (expired), quarantined
+    poison, rejections, and retries all ride the SERVE badge, and
+    every terminal outcome (plus retry hand-backs) leaves the depth
+    formula."""
+    from rocm_mpi_tpu.telemetry import health
+
+    beats = {
+        0: {"counters": {
+            "serve_submitted": 12, "serve_completed": 6,
+            "serve_requeued": 0, "serve_failed": 0,
+            "serve_expired": 2, "serve_quarantined": 1,
+            "serve_rejected": 2, "serve_retries": 1,
+        }},
+    }
+    st = health.serve_status(beats)
+    assert st["depth"] == 0
+    assert st["expired"] == 2 and st["quarantined"] == 1
+    line = health.format_serve_status(st)
+    assert line == ("serve idle (6 done, 2 deadline-missed, "
+                    "1 quarantined, 2 rejected, 1 retried)")
+
+
+def test_quarantine_schema_spelling_pinned_against_regress():
+    """telemetry.regress spells the serving schema markers locally
+    (stdlib read side) — drift from serving.queue must fail loudly."""
+    from rocm_mpi_tpu.serving import queue as squeue
+    from rocm_mpi_tpu.telemetry import regress
+
+    assert regress._SERVE_REQUEST_SCHEMA == squeue.REQUEST_SCHEMA
+    assert regress._QUARANTINE_SCHEMA == squeue.QUARANTINE_SCHEMA
+
+
+def test_manifest_queue_counters_sum_invariant_gated(tmp_path):
+    """Satellite: the archived manifest's queue block carries the
+    terminal counters and the schema gate enforces that they sum to
+    submissions — a leaked ticket fails the gate, not just the live
+    assert."""
+    from rocm_mpi_tpu.telemetry.regress import check_schema
+
+    svc = SimulationService(config=ServeConfig(max_width=4))
+    svc.run_trace(_mixed_trace("inv"))
+    path = tmp_path / "serve-manifest.json"
+    doc = svc.write_manifest(path)
+    q = doc["queue"]
+    for field in ("submitted", "completed", "failed", "rejected",
+                  "expired", "quarantined", "depth"):
+        assert isinstance(q[field], int), field
+    assert check_schema([path]) == []
+    # a leaked ticket (counters no longer sum) must fail the gate
+    doc["queue"]["completed"] -= 1
+    bad = tmp_path / "leaky-manifest.json"
+    bad.write_text(json.dumps(doc))
+    assert any("sum to submissions" in p for p in check_schema([bad]))
 
 
 def test_session_save_failure_is_lane_isolated():

@@ -1,0 +1,205 @@
+"""The chaos soak (docs/RESILIENCE.md §8; ROADMAP item 5).
+
+Covers the soak-report schema (rmt-soak-report v1: validator, atomic
+writer, regress --check-schema recognition, doctored gates), the SLO
+aggregation from real telemetry streams (latency dedup across ranks,
+deadline-miss accounting, interpolating percentiles), and THE
+acceptance drill: a bounded `apps/soak.py` run — the rolling fault
+schedule composing the queue, lane, and infrastructure planes,
+gloo-real on 2 ranks — exits 0 with a schema-valid report whose SLO
+block is populated from real telemetry.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+from rocm_mpi_tpu.serving import slo  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# SLO aggregation
+# ---------------------------------------------------------------------------
+
+
+def test_percentile_interpolates():
+    assert slo.percentile([], 50) is None
+    assert slo.percentile([3.0], 99) == 3.0
+    assert slo.percentile([1.0, 2.0, 3.0, 4.0], 50) == 2.5
+    assert slo.percentile([1.0, 2.0, 3.0, 4.0], 100) == 4.0
+    assert slo.percentile([1.0, 2.0, 3.0, 4.0], 0) == 1.0
+
+
+def _event_line(rid, latency, miss=False):
+    return json.dumps({
+        "kind": "event", "v": 2, "name": "serve.request.done",
+        "t": 1.0, "request_id": rid, "latency_s": latency,
+        "deadline_miss": miss,
+    })
+
+
+def test_latencies_dedupe_across_rank_streams(tmp_path):
+    """In a multi-controller service every rank emits the same done
+    event: one request is ONE observation, and torn tails are
+    tolerated (live JSONL streams)."""
+    r0 = tmp_path / "telemetry-rank0.jsonl"
+    r1 = tmp_path / "telemetry-rank1.jsonl"
+    r0.write_text(
+        _event_line("a", 0.5) + "\n" + _event_line("b", 1.5, miss=True)
+        + "\n"
+    )
+    r1.write_text(
+        _event_line("a", 0.5) + "\n" + _event_line("b", 1.5, miss=True)
+        + "\n" + '{"torn'
+    )
+    facts = slo.latencies_from_streams([r0, r1])
+    assert facts["latencies"] == {"a": 0.5, "b": 1.5}
+    assert facts["deadline_missed_done"] == ["b"]
+
+    block = slo.slo_block(
+        {"submitted": 4, "completed": 2, "failed": 0, "rejected": 1,
+         "expired": 1, "quarantined": 0, "retries": 0},
+        [r0, r1],
+    )
+    assert block["latency_s"]["n"] == 2
+    assert block["latency_s"]["p50"] == 1.0
+    # misses = 1 expired pending + 1 late completion, over 4 submitted
+    assert block["deadline_misses"] == 2
+    assert block["deadline_miss_rate"] == 0.5
+
+
+# ---------------------------------------------------------------------------
+# Report schema
+# ---------------------------------------------------------------------------
+
+
+def _valid_doc(tmp_path):
+    streams = tmp_path / "telemetry-rank0.jsonl"
+    streams.write_text(_event_line("a", 0.25) + "\n")
+    block = slo.slo_block(
+        {"submitted": 1, "completed": 1, "failed": 0, "rejected": 0,
+         "expired": 0, "quarantined": 0, "retries": 0},
+        [streams],
+    )
+    return slo.soak_report_doc(
+        [{"name": "serve-chaos", "mode": "in-process", "ok": True}],
+        block, bounded=True, accounting_ok=True,
+        fault_kinds=["lane-nan", "kill"],
+    )
+
+
+def test_soak_report_roundtrip_and_gate(tmp_path):
+    doc = _valid_doc(tmp_path)
+    assert slo.validate_soak_report(doc) == []
+    path = tmp_path / "soak-report.json"
+    slo.write_soak_report(path, doc)
+    assert path.is_file() and not (tmp_path / "soak-report.json.tmp").exists()
+
+    from rocm_mpi_tpu.telemetry.regress import check_schema
+
+    assert check_schema([path]) == []
+
+    # an UNPOPULATED SLO block (no latency observations) fails — a
+    # soak that banked no telemetry proves nothing
+    empty = _valid_doc(tmp_path)
+    empty["slo"]["latency_s"] = {"n": 0, "p50": None, "p99": None}
+    assert any("populated" in p for p in slo.validate_soak_report(empty))
+    with pytest.raises(ValueError, match="populated"):
+        slo.write_soak_report(tmp_path / "never.json", empty)
+
+    # doctored rate / missing episode verdict fail the gate
+    bad = _valid_doc(tmp_path)
+    bad["slo"]["deadline_miss_rate"] = 1.7
+    bad_path = tmp_path / "bad-soak-report.json"
+    bad_path.write_text(json.dumps(bad))
+    assert any("deadline_miss_rate" in p for p in check_schema([bad_path]))
+    bad2 = _valid_doc(tmp_path)
+    del bad2["episodes"][0]["ok"]
+    bad2_path = tmp_path / "bad2-soak-report.json"
+    bad2_path.write_text(json.dumps(bad2))
+    assert any("ok" in p for p in check_schema([bad2_path]))
+
+
+def test_slo_fields_pinned_against_queue_terminals():
+    """The SLO block's terminal outcomes are the queue's terminal
+    states (plus the submitted/retries bookkeeping) — spelled flat in
+    slo.py for the stdlib read side; drift fails here."""
+    from rocm_mpi_tpu.serving.queue import TERMINAL_STATES
+
+    # done <-> completed is the one deliberate rename
+    assert set(slo.SLO_COUNT_FIELDS) == {
+        "submitted", "retries", "done", "failed", "rejected", "expired",
+        "quarantined",
+    }
+    assert set(TERMINAL_STATES) == {
+        "done", "failed", "rejected", "expired", "quarantined",
+    }
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance drill
+# ---------------------------------------------------------------------------
+
+
+def test_bounded_soak_acceptance(tmp_path):
+    """THE ISSUE-14 acceptance: a bounded apps/soak.py run — the
+    rolling fault schedule composing the queue plane (flood, deadline
+    expiry, NaN quarantine, breaker recovery), the storage plane
+    (io-error/io-slow/enospc through session saves), a real SIGTERM
+    eviction, and gloo-real 2-rank serve + kill episodes — exits 0
+    with a schema-valid soak-report.json whose SLO block is populated
+    from real telemetry."""
+    out = tmp_path / "soak"
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "apps" / "soak.py"),
+         "--bounded", "--cpu-devices", "2", "--out", str(out)],
+        capture_output=True, text=True, cwd=REPO, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, (proc.stdout[-3000:],
+                                  proc.stderr[-3000:])
+    doc = json.loads((out / "soak-report.json").read_text())
+    assert slo.validate_soak_report(doc) == []
+
+    from rocm_mpi_tpu.telemetry.regress import check_schema
+
+    assert check_schema([out / "soak-report.json",
+                         out / "quarantine.jsonl"]) == []
+
+    names = {ep["name"]: ep for ep in doc["episodes"]}
+    assert set(names) == {"serve-chaos", "breaker", "storage", "evict",
+                          "gloo-serve", "gloo-kill"}
+    assert all(ep["ok"] for ep in doc["episodes"]), doc["episodes"]
+    assert doc["accounting_ok"] is True
+
+    # the SLO block is populated from REAL telemetry
+    assert doc["slo"]["latency_s"]["n"] >= 8
+    assert doc["slo"]["latency_s"]["p50"] > 0
+    assert doc["slo"]["quarantined"] >= 1
+    assert doc["slo"]["rejected"] >= 2
+    assert doc["slo"]["expired"] >= 2
+    assert doc["slo"]["retries"] >= 1
+    assert 0.0 < doc["slo"]["deadline_miss_rate"] < 1.0
+
+    # every plane actually composed
+    assert {"lane-nan", "batch-error", "slow-batch", "queue-flood",
+            "io-error", "io-slow", "enospc", "sigterm",
+            "kill"} <= set(doc["fault_kinds"])
+
+    # the poison ledger carries a reproducible full record
+    from rocm_mpi_tpu.serving.queue import (
+        load_quarantine,
+        request_from_record,
+    )
+
+    records = load_quarantine(out / "quarantine.jsonl")
+    assert records
+    assert request_from_record(records[0]["request"]).workload
