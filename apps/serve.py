@@ -133,6 +133,12 @@ def make_parser():
     p.add_argument("--quarantine", default=None, metavar="FILE.jsonl",
                    help="append-only poison-request ledger (default: "
                    "<--out>/quarantine.jsonl when --out is given)")
+    p.add_argument("--pipeline-depth", type=positive_int, default=None,
+                   help="drain pipeline depth (docs/SERVING.md 'The "
+                   "pipeline'): 1 = serial drain, 2 (default) = "
+                   "double-buffered — batch N+1 assembles/dispatches "
+                   "while batch N computes; results bitwise-equal at "
+                   "any depth")
     add_telemetry_flag(p)
     add_health_flag(p)
     return p
@@ -206,6 +212,9 @@ def main(argv=None) -> int:
         out_dir.mkdir(parents=True, exist_ok=True)
         quarantine = str(out_dir / "quarantine.jsonl")
 
+    cfg_kw = {}
+    if args.pipeline_depth is not None:
+        cfg_kw["pipeline_depth"] = args.pipeline_depth
     svc = SimulationService(config=ServeConfig(
         max_width=args.max_width,
         occupancy_floor=args.occupancy_floor,
@@ -216,10 +225,12 @@ def main(argv=None) -> int:
         max_depth=args.max_depth,
         retry=retry,
         quarantine_path=quarantine,
+        **cfg_kw,
     ))
 
     log0(f"serving {len(requests)} request(s) "
          f"(max_width={args.max_width}, batch_dims={args.batch_dims}, "
+         f"pipeline_depth={svc.config.pipeline_depth}, "
          f"devices={len(jax.devices())})")
 
     pre_served = 0
@@ -257,6 +268,15 @@ def main(argv=None) -> int:
         f"{report.n_bins} bin(s), {report.n_programs} program(s), "
         f"compiles.steady_state={report.compiles.get('steady_state')}"
     )
+    pipe = report.pipeline
+    if pipe.get("batches"):
+        log0(
+            f"  pipeline depth={pipe['depth']} "
+            f"batches={pipe['batches']} bubble={pipe['bubble']:.2f} "
+            f"(assemble {pipe['assemble_s']:.3f}s / dispatch "
+            f"{pipe['dispatch_s']:.3f}s / fetch {pipe['fetch_s']:.3f}s "
+            f"/ resolve {pipe['resolve_s']:.3f}s)"
+        )
     for key, st in sorted(report.bins.items()):
         log0(
             f"  bin {key.key_str():48s} req={st.requests:3d} "

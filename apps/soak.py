@@ -194,6 +194,53 @@ class Soak:
         return {"counters": c, "flooded": flooded,
                 "grew": bool(svc._elastic)}
 
+    def ep_pipeline(self):
+        """The pipelined drain under a slow-batch fault
+        (docs/SERVING.md "The pipeline"): the SAME trace through the
+        double-buffered drain and its serial twin — the overlapped
+        fetch/resolve stage must not reorder terminal accounting
+        (identical queue counters, invariant asserted on both) and
+        every co-served result stays bitwise-equal across modes."""
+        import numpy as np
+
+        def trace():
+            return [
+                _req(
+                    f"pipe-{i:02d}",
+                    shape=SHAPE_A if i % 3 else SHAPE_B,
+                    nt=3 + (i % 3),
+                    ic_scale=1.0 + 0.015 * i,
+                )
+                for i in range(8)
+            ]
+
+        outs = {}
+        counters = {}
+        for depth in (2, 1):
+            svc = self._service(max_width=2, pipeline_depth=depth)
+            tickets = [svc.queue.submit(r) for r in trace()]
+            _drive(svc)
+            svc._assert_accounting()
+            counters[depth] = {
+                k: v for k, v in svc.queue.counters().items()
+                if k != "depth"
+            }
+            outs[depth] = [t.result(timeout=5) for t in tickets]
+            if depth == 2:
+                pipe = svc.pipeline_stats()
+                assert pipe["depth"] == 2 and pipe["batches"] >= 1, pipe
+                self._bank(svc, "pipeline")
+        assert counters[2] == counters[1], (
+            "pipelined drain reordered terminal accounting: "
+            f"{counters[2]} != {counters[1]}"
+        )
+        for i, (a, b) in enumerate(zip(outs[2], outs[1])):
+            for la, lb in zip(a, b):
+                assert np.array_equal(np.asarray(la), np.asarray(lb)), (
+                    f"request {i}: pipelined != serial"
+                )
+        return {"counters": counters[2], "bubble": pipe["bubble"]}
+
     def ep_breaker(self):
         """The circuit-breaker arc: three consecutive injected batch
         errors open SHAPE_A's class (its pending requests reject fast
@@ -403,6 +450,12 @@ class Soak:
              "queue-flood=10@step=2;lane-nan@request=3,times=9;"
              "slow-batch=0.05@step=3;batch-error@step=4",
              self.ep_serve_chaos),
+            # times=2: the pipelined run and its serial twin each
+            # consume one firing of every slow-batch clause.
+            ("pipeline", "in-process",
+             "slow-batch=0.05@step=2,times=2;"
+             "slow-batch=0.05@step=4,times=2",
+             self.ep_pipeline),
             # breaker/storage install their own specs (multiple phases).
             ("breaker", "in-process", None, self.ep_breaker),
             ("storage", "in-process", None, self.ep_storage),

@@ -397,27 +397,88 @@ def _next_bench_record_path() -> str:
     return os.path.join(root, f"BENCH_r{n:02d}.json")
 
 
-def _write_bench_record(rows: dict) -> None:
+def _write_bench_record(rows: dict, rate_rows: dict | None = None) -> None:
     """Bank the suite's rates as a flat metrics baseline (all rates:
-    higher is better). Atomic tmp+rename so a mid-write kill cannot leave
-    a torn record that bricks the schema gate."""
-    if not rows:
+    higher is better; `rate_rows` are the serving drain rungs in
+    requests/s rather than Gpts/s). Atomic tmp+rename so a mid-write
+    kill cannot leave a torn record that bricks the schema gate."""
+    if not rows and not rate_rows:
         return
     path = _next_bench_record_path()
+    metrics = {
+        f"suite.{label}.gpts": {"value": round(v, 4),
+                                "direction": "higher"}
+        for label, v in rows.items()
+    }
+    for label, v in (rate_rows or {}).items():
+        metrics[f"suite.{label}.req_s"] = {
+            "value": round(v, 4), "direction": "higher",
+        }
     doc = {
-        "metrics": {
-            f"suite.{label}.gpts": {"value": round(v, 4),
-                                    "direction": "higher"}
-            for label, v in rows.items()
-        },
+        "metrics": metrics,
     }
     tmp = path + ".tmp"
     with open(tmp, "w") as fh:
         json.dump(doc, fh, indent=1, sort_keys=True)
         fh.write("\n")
     os.replace(tmp, path)
-    print(f"bench.py --suite: banked {len(rows)} rows into {path}",
+    print(f"bench.py --suite: banked {len(metrics)} rows into {path}",
           file=sys.stderr)
+
+
+def _run_serve_drain_rung(n_requests: int = 16, nt_base: int = 2_000,
+                          shapes=((64, 64), (96, 96))) -> dict:
+    """The serving drain rung (ISSUE 15, docs/SERVING.md "The
+    pipeline"): the SAME synthetic trace through both drain modes —
+    serial (depth 1) vs double-buffered (depth 2) — on warmed program
+    caches; returns {label: aggregate requests/s}, the drain-overlap
+    pair `_write_bench_record` banks. time.monotonic interval
+    arithmetic by design (the per-batch device walls ride the serve.*
+    telemetry spans)."""
+    import time as _time
+
+    from rocm_mpi_tpu.serving.queue import Request as _Request
+    from rocm_mpi_tpu.serving.service import (
+        ServeConfig as _ServeConfig,
+        SimulationService as _SimulationService,
+    )
+
+    serve_rows: dict = {}
+
+    def _drain_trace(tag):
+        return [
+            _Request(
+                request_id=f"{tag}-{i:03d}", workload="diffusion",
+                global_shape=shapes[i % len(shapes)], dtype="f32",
+                nt=nt_base + (nt_base // 20) * (i % 4),
+                ic_scale=1.0 + 0.01 * i,
+            )
+            for i in range(n_requests)
+        ]
+
+    for depth, mode in ((1, "serial"), (2, "pipelined")):
+        svc = _SimulationService(config=_ServeConfig(
+            max_width=4, pipeline_depth=depth,
+        ))
+        # Warm pass: every program class compiles here, so the
+        # measured pass is the steady state the service actually runs.
+        svc.run_trace(_drain_trace(f"warm{depth}"))
+        trace = _drain_trace(f"meas{depth}")
+        for r in trace:
+            svc.queue.submit(r)
+        t0 = _time.monotonic()
+        rep = svc.run_trace([])
+        wall = _time.monotonic() - t0
+        rate = rep.served / wall if wall > 0 else 0.0
+        pipe = svc.pipeline_stats()
+        print(
+            f"{'serve drain ' + mode:34s} {rep.served:3d} req "
+            f"in {wall:8.3f} s  {rate:8.2f} req/s  "
+            f"bubble={pipe['bubble']:.2f}",
+            file=sys.stderr,
+        )
+        serve_rows[f"serve drain {mode}"] = rate
+    return serve_rows
 
 
 def run_suite() -> None:
@@ -600,6 +661,8 @@ def run_suite() -> None:
                        warmup=bcfg.warmup, config=bcfg),
         )
 
+    serve_rows = _run_serve_drain_rung()
+
     # Bank the autotuner's resolve outcomes (tune.hits / tune.misses run
     # gauges + the per-key tune.resolve annotations) before the record:
     # a suite steered by a warm cache and one running hand defaults are
@@ -611,7 +674,7 @@ def run_suite() -> None:
     # The trajectory record is written only when the whole ladder ran —
     # a partial (killed) suite prints its rows to stderr but does not
     # bank a record that under-represents the machine.
-    _write_bench_record(suite_rows)
+    _write_bench_record(suite_rows, serve_rows)
 
 
 # --------------------------------------------------------------------------

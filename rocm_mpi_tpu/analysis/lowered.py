@@ -376,6 +376,119 @@ def audit_drivers(local: int = 16, steps: int = 2) -> list[DriverAudit]:
     return rows
 
 
+def audit_batched_drivers(local: int = 16, batch: int = 2,
+                          steps: int = 2) -> list[DriverAudit]:
+    """Compile + audit the SERVING layer's steady-state programs — the
+    multi-tenant batched advances (models.*.batched_advance_fn, the
+    exact callables `serving/service._Program.advance` executes per
+    batch) plus the diffusion batched-hide edition — on the current
+    (CPU) backend over a space×batch mesh (batch rows 1, space 2×1).
+
+    The donation verdict is the serving pipeline's allocation
+    contract (docs/SERVING.md "The pipeline"): every batched state
+    leaf is declared donated, and a declared-but-unaliased donation
+    would mean steady-state serving silently allocates a full batch of
+    state per drain batch — the perf lie the `input_output_alias`
+    check turns into a lint-stage failure. The collective checks ride
+    along: the batched exchange's permutes must stay per-space-axis
+    partial permutations (nothing ever permutes over `batch`), outside
+    any lowered conditional."""
+    import jax
+    import numpy as np
+
+    from rocm_mpi_tpu.config import DiffusionConfig
+    from rocm_mpi_tpu.models import (
+        AcousticWave,
+        HeatDiffusion,
+        ShallowWater,
+        SWEConfig,
+        WaveConfig,
+    )
+
+    dims = (2, 1)
+    shape = (local * dims[0], local * dims[1])
+    lengths = (10.0 * dims[0], 10.0 * dims[1])
+    rows: list[DriverAudit] = []
+
+    def audit(workload, text, args, donate_argnums):
+        roles = audit_roles(text)
+        problems = list(roles.problems)
+        if not roles.sequence:
+            problems.append(
+                "no collectives in the lowered program (the batched "
+                "driver audited away its exchanges?)"
+            )
+        problems += audit_donation(text, args, donate_argnums)
+        rows.append(DriverAudit(
+            workload=workload,
+            num_partitions=roles.num_partitions,
+            n_collectives=len(roles.sequence),
+            donated_params=len(
+                expected_donated_params(args, donate_argnums)
+            ),
+            problems=problems,
+        ))
+
+    def put(a, s):
+        return jax.device_put(np.asarray(a), s)
+
+    lane_steps = np.full(batch, steps, np.int32)
+
+    # diffusion (one donated leaf), shard + the batched-hide overlap
+    m = HeatDiffusion(DiffusionConfig(
+        global_shape=shape, lengths=lengths, nt=8, warmup=0,
+        dtype="f64", dims=dims, b_width=(local // 4, local // 4),
+    ))
+    T0, Cp = m.init_state()
+    Tn = np.asarray(T0)
+    for variant in ("shard", "hide"):
+        adv, bg = m.batched_advance_fn(batch=batch, variant=variant)
+        args = (
+            put(np.stack([Tn] * batch), bg.sharding),
+            put(Cp, bg.aux_sharding),
+            put(lane_steps, bg.batch_sharding),
+            steps,
+        )
+        audit(f"diffusion/batched-{variant}",
+              _compiled_text(adv, *args), args, (0,))
+
+    # wave (both leapfrog carries donated)
+    w = AcousticWave(WaveConfig(
+        global_shape=shape, lengths=lengths, nt=8, warmup=0, dims=dims,
+    ))
+    U0, _, C2 = w.init_state()
+    Un = np.asarray(U0)
+    wadv, wbg = w.batched_advance_fn(batch=batch)
+    wargs = (
+        put(np.stack([Un] * batch), wbg.sharding),
+        put(np.stack([Un] * batch), wbg.sharding),
+        put(C2, wbg.aux_sharding),
+        put(lane_steps, wbg.batch_sharding),
+        steps,
+    )
+    audit("wave/batched", _compiled_text(wadv, *wargs), wargs, (0, 1))
+
+    # SWE (h + every velocity leaf donated; the face masks are not)
+    s = ShallowWater(SWEConfig(
+        global_shape=shape, lengths=lengths, nt=8, warmup=0, dims=dims,
+    ))
+    h0, us0 = s.init_state()
+    Mus = s.face_masks()
+    hn = np.asarray(h0)
+    sadv, sbg = s.batched_advance_fn(batch=batch)
+    zeros_b = np.zeros((batch,) + shape)
+    sargs = (
+        put(np.stack([hn] * batch), sbg.sharding),
+        tuple(put(zeros_b, sbg.sharding) for _ in us0),
+        tuple(put(M, sbg.aux_sharding) for M in Mus),
+        put(lane_steps, sbg.batch_sharding),
+        steps,
+    )
+    audit("swe/batched", _compiled_text(sadv, *sargs), sargs, (0, 1))
+
+    return rows
+
+
 def render_table(rows: list[DriverAudit]) -> str:
     head = (
         f"{'workload':16s} {'parts':>5s} {'collectives':>11s} "
@@ -422,6 +535,7 @@ def main(argv=None) -> int:
     set_cpu_device_count(2)
 
     rows = audit_drivers(local=args.local)
+    rows += audit_batched_drivers(local=args.local)
     table = render_table(rows)
     if args.json:
         import json as _json

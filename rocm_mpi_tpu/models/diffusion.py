@@ -561,15 +561,20 @@ class HeatDiffusion:
         )
 
     def _make_batched_step(self, bgrid, variant: str):
-        """`step(Tb, C) -> Tb` over `(batch, *space)` lane-batched state
-        (C is the UNBATCHED space-shaped coefficient every lane shares —
-        physics is a bin-key field, docs/SERVING.md). "shard" runs the
-        explicit exchange machinery — shard_map over the space×batch
-        mesh, the per-lane local step vmapped over the leading lane axis,
-        halo collectives per-space-axis only; "ap"/"fused" vmap the
-        global-array step and let GSPMD partition the batched array.
-        Every form is bitwise-equal per lane to the unbatched variant
-        (the serving layer's parity contract)."""
+        """(`step(Tb, C) -> Tb`, prepare-or-None) over `(batch, *space)`
+        lane-batched state (C is the UNBATCHED space-shaped coefficient
+        every lane shares — physics is a bin-key field,
+        docs/SERVING.md; `prepare(Cp) -> C` is the loop-invariant
+        coefficient transform, traced once per jitted program like the
+        unbatched variants' prep). "shard" runs the explicit exchange
+        machinery — shard_map over the space×batch mesh, the per-lane
+        local step vmapped over the leading lane axis, halo collectives
+        per-space-axis only; "hide" the lane-batched comm/compute
+        overlap (make_batched_overlap_step on the Cm contract — the
+        exchange hides under the vmapped interior compute);
+        "ap"/"fused" vmap the global-array step and let GSPMD partition
+        the batched array. Every form is bitwise-equal per lane to the
+        unbatched variant (the serving layer's parity contract)."""
         from rocm_mpi_tpu.ops.diffusion import step_fused_padded
         from rocm_mpi_tpu.parallel.halo import exchange_halo_batched
 
@@ -585,12 +590,15 @@ class HeatDiffusion:
                     lambda T: raw(T, C, cfg.lam, dt, cfg.spacing)
                 )(Tb)
 
-            return step
+            return step, None
+
+        if variant == "hide":
+            return self._make_batched_hide_step(bgrid)
 
         if variant != "shard":
             raise ValueError(
-                f"batched advance supports variants 'shard', 'ap', "
-                f"'fused'; got {variant!r} (the Pallas/overlap rungs "
+                f"batched advance supports variants 'shard', 'hide', "
+                f"'ap', 'fused'; got {variant!r} (the Pallas rungs "
                 "are single-lane)"
             )
 
@@ -616,16 +624,79 @@ class HeatDiffusion:
                 check_vma=False,
             )(Tb, C)
 
-        return step
+        return step, None
+
+    def _make_batched_hide_step(self, bgrid):
+        """The lane-batched overlap step (docs/SERVING.md "The
+        pipeline"): the masked-seam hide vmapped over the lane axis —
+        one width-1 exchange of the whole lane batch whose collectives
+        are dataflow-independent of every interior box, so XLA hides
+        the (lane-aggregate) exchange under the vmapped interior
+        compute. Runs the Cm jnp twin (`ops.diffusion.step_cm_padded`)
+        on every dtype — the same kernel the single-lane f64 hide and
+        the CPU traffic audit lower, bitwise-equal to the Pallas Cm
+        form — with the mask+divide folded into the prepared
+        coefficient (`prepare`), so held cells come back unchanged
+        from the region updates themselves."""
+        from rocm_mpi_tpu.ops.diffusion import step_cm_padded
+        from rocm_mpi_tpu.parallel.overlap import make_batched_overlap_step
+
+        cfg = self.config
+        space = bgrid.space
+        dt = cfg.jax_dtype(cfg.dt)
+        pu = lambda tp, cm, lam, dt_, spacing: step_cm_padded(
+            tp, cm, spacing
+        )
+        batched_local = make_batched_overlap_step(
+            bgrid, pu, cfg.b_width, mask_boundary=False,
+            wire_mode=cfg.wire_mode,
+        )
+
+        def prepare(Cp):
+            def local(Cpl):
+                z = jnp.zeros_like(Cpl)
+                return jnp.where(
+                    global_boundary_mask(space), z, (dt * cfg.lam) / Cpl
+                )
+
+            return shard_map(
+                local, mesh=bgrid.mesh, in_specs=(bgrid.aux_spec,),
+                out_specs=bgrid.aux_spec, check_vma=False,
+            )(Cp)
+
+        def step(Tb, Cm):
+            return shard_map(
+                lambda Tb_l, Cml: batched_local(
+                    Tb_l, Cml, cfg.lam, dt, cfg.spacing
+                ),
+                mesh=bgrid.mesh,
+                in_specs=(bgrid.spec, bgrid.aux_spec),
+                out_specs=bgrid.spec,
+                check_vma=False,
+            )(Tb, Cm)
+
+        return step, prepare
 
     def batched_step_fn(self, bgrid, variant: str = "shard",
                         donate: bool = False):
         """jitted steady-state `step(Tb, C) -> Tb` — one batched step as
         its own program (what the perf traffic gate audits: per-lane
         compiled bytes of the B-lane program vs B× the single-lane
-        ideal, rocm_mpi_tpu/perf/traffic.py)."""
-        step = self._make_batched_step(bgrid, variant)
+        ideal, rocm_mpi_tpu/perf/traffic.py). For variants with a
+        prepared coefficient (hide), C is the PREPARED operand —
+        `batched_prepare_fn` builds it, exactly as prepared_step_fn
+        splits the single-lane audit surface."""
+        step, _ = self._make_batched_step(bgrid, variant)
         return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+    def batched_prepare_fn(self, bgrid, variant: str = "shard"):
+        """jitted `prepare(Cp) -> C` for the batched variant's
+        loop-invariant coefficient (identity for the prep-less
+        variants) — the audit-surface companion of batched_step_fn."""
+        _, prep = self._make_batched_step(bgrid, variant)
+        if prep is None:
+            return jax.jit(lambda C: C)
+        return jax.jit(prep)
 
     def batched_advance_fn(
         self,
@@ -644,20 +715,27 @@ class HeatDiffusion:
         lane freezes bitwise once its own count is reached — the
         pass-through select is exact, so every lane is bitwise-equal to
         a standalone run of its own length); `n` the dynamic trip count.
-        Donates Tb (rebind from the result). One compiled program serves
-        any lane_steps/n mix — the bin scheduler's compile-amortization
-        contract (`compiles.steady_state == 0`)."""
+        Donates Tb (rebind from the result; the lowered audit proves
+        the aliasing from the compiled program —
+        analysis/lowered.audit_batched_drivers). One compiled program
+        serves any lane_steps/n mix — the bin scheduler's
+        compile-amortization contract (`compiles.steady_state == 0`).
+        Variant "hide" runs the lane-batched overlap step with its Cm
+        coefficient prepared once inside the jitted program, exactly
+        like the unbatched drivers' prep."""
         if bgrid is None:
             if batch is None:
                 raise ValueError("pass batch= or a prebuilt bgrid=")
             bgrid = self.make_batched_grid(batch, batch_dims, devices)
-        step = self._make_batched_step(bgrid, variant)
+        step, prep = self._make_batched_step(bgrid, variant)
         shape1 = (-1,) + (1,) * bgrid.space.ndim
 
         @functools.partial(jax.jit, donate_argnums=0)
         def advance(Tb, Cp, lane_steps, n):
+            C = Cp if prep is None else prep(Cp)
+
             def body(i, T):
-                new = step(T, Cp)
+                new = step(T, C)
                 active = (i < lane_steps).reshape(shape1)
                 return jnp.where(active, new, T)
 

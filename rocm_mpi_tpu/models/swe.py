@@ -327,9 +327,10 @@ class ShallowWater:
         )
 
     def _make_batched_step(self, bgrid, variant: str):
-        """`step(hb, usb, Mus) -> (hb', usb')` over lane-batched SWE
-        state; the face masks `Mus` are UNBATCHED (wall geometry is
-        config-derived, shared by every lane). Same vocabulary as
+        """(`step(hb, usb, Mus) -> (hb', usb')`, prepare-or-None) over
+        lane-batched SWE state; the face masks `Mus` are UNBATCHED
+        (wall geometry is config-derived, shared by every lane). Same
+        vocabulary (and return convention) as
         HeatDiffusion._make_batched_step."""
         from rocm_mpi_tpu.parallel.halo import exchange_halo_batched
 
@@ -346,7 +347,7 @@ class ShallowWater:
                     in_axes=(0, 0),
                 )(hb, usb)
 
-            return step
+            return step, None
 
         if variant != "shard":
             raise ValueError(
@@ -382,7 +383,7 @@ class ShallowWater:
             )(hb, *usb, *Mus)
             return outs[0], tuple(outs[1:])
 
-        return step
+        return step, None
 
     def batched_advance_fn(
         self,
@@ -396,12 +397,13 @@ class ShallowWater:
         bgrid) — the SWE edition of the multi-tenant batched advance
         (HeatDiffusion.batched_advance_fn has the lane_steps/bitwise
         contract; every state field freezes together when a lane's count
-        is reached). Donates (hb, usb)."""
+        is reached). Donates (hb, usb) — aliasing proven from the
+        compiled program by analysis/lowered.audit_batched_drivers."""
         if bgrid is None:
             if batch is None:
                 raise ValueError("pass batch= or a prebuilt bgrid=")
             bgrid = self.make_batched_grid(batch, batch_dims, devices)
-        step = self._make_batched_step(bgrid, variant)
+        step, _ = self._make_batched_step(bgrid, variant)
         shape1 = (-1,) + (1,) * bgrid.space.ndim
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))

@@ -328,9 +328,10 @@ class AcousticWave:
         )
 
     def _make_batched_step(self, bgrid, variant: str):
-        """`step(Ub, Upb, C2) -> (Ub⁺, Ub)` over lane-batched leapfrog
-        state; `C2` is the UNBATCHED squared wave speed every lane
-        shares. Same vocabulary as HeatDiffusion._make_batched_step."""
+        """(`step(Ub, Upb, C2) -> (Ub⁺, Ub)`, prepare-or-None) over
+        lane-batched leapfrog state; `C2` is the UNBATCHED squared wave
+        speed every lane shares. Same vocabulary (and return
+        convention) as HeatDiffusion._make_batched_step."""
         from rocm_mpi_tpu.parallel.halo import exchange_halo_batched
 
         cfg = self.config
@@ -346,7 +347,7 @@ class AcousticWave:
                 )(Ub, Upb)
                 return new, Ub
 
-            return step
+            return step, None
 
         if variant != "shard":
             raise ValueError(
@@ -376,7 +377,7 @@ class AcousticWave:
             )(Ub, Upb, C2)
             return new, Ub
 
-        return step
+        return step, None
 
     def batched_advance_fn(
         self,
@@ -390,12 +391,13 @@ class AcousticWave:
         bgrid) — the wave edition of the multi-tenant batched advance
         (HeatDiffusion.batched_advance_fn has the lane_steps/bitwise
         contract; both leapfrog carries freeze together when a lane's
-        count is reached). Donates (Ub, Upb)."""
+        count is reached). Donates (Ub, Upb) — aliasing proven from the
+        compiled program by analysis/lowered.audit_batched_drivers."""
         if bgrid is None:
             if batch is None:
                 raise ValueError("pass batch= or a prebuilt bgrid=")
             bgrid = self.make_batched_grid(batch, batch_dims, devices)
-        step = self._make_batched_step(bgrid, variant)
+        step, _ = self._make_batched_step(bgrid, variant)
         shape1 = (-1,) + (1,) * bgrid.space.ndim
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))

@@ -49,7 +49,7 @@ import numpy as np
 from jax import lax
 
 from rocm_mpi_tpu import telemetry
-from rocm_mpi_tpu.parallel.halo import exchange_halo
+from rocm_mpi_tpu.parallel.halo import exchange_halo, exchange_halo_batched
 from rocm_mpi_tpu.parallel.mesh import GlobalGrid
 
 
@@ -133,9 +133,100 @@ def make_overlap_step(
     # so a model whose config carries a deep-only wire mode can still
     # BUILD its per-step variants and run its deep schedule.
     wire.validate_mode(wire_mode)
+    bw = effective_b_width(grid.local_shape, b_width)
+    splice = _make_region_splice(grid, padded_update, bw, mask_boundary)
+
+    def local_step(Tl, Cpl, lam, dt, spacing):
+        if telemetry.enabled():
+            # Trace-time: the slab geometry this compiled overlap step
+            # uses (the per-leaf halo.exchange byte annotations fire
+            # inside exchange_halo below).
+            telemetry.annotate(
+                "overlap.step", b_width=tuple(int(b) for b in bw),
+                leaves=len(jax.tree_util.tree_leaves(Tl)),
+                wire=wire_mode,
+            )
+        # (1) halo exchange of the current state — edge-slice ppermutes,
+        # one exchange per state leaf (SWE: 3 fields; diffusion/wave: 1),
+        # at the wire mode's on-wire precision (received slabs arrive
+        # already decoded to the buffer dtype).
+        Tp = jax.tree_util.tree_map(
+            lambda t: exchange_halo(t, grid, wire_mode=wire_mode), Tl
+        )  # core + 2 per axis
+        return splice(Tl, Tp, Cpl, lam, dt, spacing)
+
+    return local_step
+
+
+def make_batched_overlap_step(
+    bgrid,
+    padded_update: Callable,
+    b_width: tuple[int, ...],
+    mask_boundary: bool = False,
+    wire_mode: str = "f32",
+):
+    """The lane-batched overlap step (docs/SERVING.md "The pipeline"):
+    the masked-seam hide of `make_overlap_step`, vmapped over the
+    leading lane axis of a `BatchedGrid` — the batched serving program
+    itself hides its exchange under interior compute, the paper's
+    tentpole at batch scale.
+
+    Inside a shard_map over `bgrid.mesh`, `batched_local(Tb_l, Cpl,
+    lam, dt, spacing)` takes the local `(local_batch, *local_space)`
+    block of `bgrid.spec`-sharded state and the UNBATCHED lane-shared
+    aux block. The exchange runs through `exchange_halo_batched`
+    (aggregate lane bytes booked on the wire annotation; halo
+    collectives stay strictly per-space-axis — nothing ever permutes
+    over `batch`), and the region splice is vmapped per lane: the
+    interior boxes still read the UNPADDED lane block, so their
+    dataflow independence from the (lane-batched) collective — the
+    whole hide trick — survives the vmap unchanged.
+
+    Stateless wire modes only (f32/bf16), enforced by
+    `exchange_halo_batched`. `mask_boundary` defaults to False — every
+    in-repo batched caller is on the Cm masked-coefficient contract."""
+    from rocm_mpi_tpu.parallel import wire
+
+    wire.validate_mode(wire_mode)
+    space = bgrid.space
+    bw = effective_b_width(space.local_shape, b_width)
+    splice = _make_region_splice(space, padded_update, bw, mask_boundary)
+
+    def batched_local(Tb_l, Cpl, lam, dt, spacing):
+        if telemetry.enabled():
+            telemetry.annotate(
+                "overlap.step.batched",
+                b_width=tuple(int(b) for b in bw),
+                lanes=int(jax.tree_util.tree_leaves(Tb_l)[0].shape[0]),
+                leaves=len(jax.tree_util.tree_leaves(Tb_l)),
+                wire=wire_mode,
+            )
+        Tp_b = jax.tree_util.tree_map(
+            lambda t: exchange_halo_batched(t, bgrid,
+                                            wire_mode=wire_mode),
+            Tb_l,
+        )
+        return jax.vmap(
+            lambda Tl, Tpl: splice(Tl, Tpl, Cpl, lam, dt, spacing)
+        )(Tb_l, Tp_b)
+
+    return batched_local
+
+
+def _make_region_splice(
+    grid: GlobalGrid,
+    padded_update: Callable,
+    bw: tuple[int, ...],
+    mask_boundary: bool,
+):
+    """Build `splice(Tl, Tp, Cpl, lam, dt, spacing) -> Tl_new`: the
+    boundary-slab/interior decomposition and the in-place DUS splice of
+    `make_overlap_step`, factored over an ALREADY-exchanged padded
+    state `Tp` so the single-lane and lane-batched steps share one
+    seam (the batched edition exchanges through
+    `exchange_halo_batched` and vmaps this per lane)."""
     local = grid.local_shape
     ndim = grid.ndim
-    bw = effective_b_width(local, b_width)
 
     def boxes(axis, prefix):
         """Enumerate the region boxes (per-axis (lo, hi) core ranges) —
@@ -176,24 +267,7 @@ def make_overlap_step(
             edge_lo.append(lo)
             edge_hi.append(hi)
 
-    def local_step(Tl, Cpl, lam, dt, spacing):
-        if telemetry.enabled():
-            # Trace-time: the slab geometry this compiled overlap step
-            # uses (the per-leaf halo.exchange byte annotations fire
-            # inside exchange_halo below).
-            telemetry.annotate(
-                "overlap.step", b_width=tuple(int(b) for b in bw),
-                leaves=len(jax.tree_util.tree_leaves(Tl)),
-                wire=wire_mode,
-            )
-        # (1) halo exchange of the current state — edge-slice ppermutes,
-        # one exchange per state leaf (SWE: 3 fields; diffusion/wave: 1),
-        # at the wire mode's on-wire precision (received slabs arrive
-        # already decoded to the buffer dtype).
-        Tp = jax.tree_util.tree_map(
-            lambda t: exchange_halo(t, grid, wire_mode=wire_mode), Tl
-        )  # core + 2 per axis
-
+    def splice(Tl, Tp, Cpl, lam, dt, spacing):
         def region(bounds):
             """Candidate update of the core box given by `bounds`. Slab
             boxes read the padded state; ghost-free boxes (the interior)
@@ -238,4 +312,4 @@ def make_overlap_step(
             lambda old, nw: jnp.where(mask, old, nw), Tl, new
         )
 
-    return local_step
+    return splice

@@ -484,6 +484,7 @@ def audit_batched(local: int = DEFAULT_LOCAL, dims=(2, 1),
         budgets = load_budgets()
     serving = budgets.get("serving", {})
     tolerance = serving.get("batch_tolerance")
+    hide_tolerance = serving.get("hide_tolerance")
 
     dims = tuple(int(d) for d in dims)
     cfg = DiffusionConfig(
@@ -492,18 +493,27 @@ def audit_batched(local: int = DEFAULT_LOCAL, dims=(2, 1),
         nt=8, warmup=0, dtype="f64", dims=dims,
     )
     model = HeatDiffusion(cfg)
+    # The batched-hide twin: same problem, a REAL overlap decomposition
+    # at the audit's shard size (audit_variants has the why — the
+    # default frame would swallow the shard whole).
+    model_hide = HeatDiffusion(dataclasses.replace(
+        cfg, b_width=(local // 8, local // 8)
+    ))
     itemsize = jax.numpy.dtype(cfg.jax_dtype).itemsize
     local_shape = model.grid.local_shape
     wire1 = exchange_nbytes(local_shape, itemsize, 1)
     T0, Cp = model.init_state()
     T0n, Cpn = np.asarray(T0), np.asarray(Cp)
 
-    def measure(width: int):
-        bgrid = model.make_batched_grid(width, batch_dims=1)
-        step = model.batched_step_fn(bgrid, donate=True)
+    def measure(width: int, variant: str = "shard", m=None):
+        m = model if m is None else m
+        bgrid = m.make_batched_grid(width, batch_dims=1)
+        step = m.batched_step_fn(bgrid, variant=variant, donate=True)
         Tb = jax.device_put(np.stack([T0n] * width), bgrid.sharding)
-        Cpb = jax.device_put(Cpn, bgrid.aux_sharding)
-        return _modeled_bytes(step, Tb, Cpb)
+        Cb = m.batched_prepare_fn(bgrid, variant)(
+            jax.device_put(Cpn, bgrid.aux_sharding)
+        )
+        return _modeled_bytes(step, Tb, Cb)
 
     rows: list[TrafficRow] = []
     measured, wire, raw = measure(batch)
@@ -513,6 +523,22 @@ def audit_batched(local: int = DEFAULT_LOCAL, dims=(2, 1),
         ideal_bytes=ideal_batched_step_bytes(local_shape, itemsize, batch),
         wire_bytes=wire, wire_ideal=batch * wire1,
         cost_analysis_bytes=raw, budget=tolerance,
+    ))
+
+    # The batched-hide program (docs/SERVING.md "The pipeline"): the
+    # lane-batched comm/compute overlap the serving layer compiles for
+    # variant "hide" bins. Its wire bytes must still be EXACTLY B× one
+    # lane's exchange (an over-wire batched hide is permuting padding),
+    # and its modeled bytes gate against the committed hide tolerance —
+    # an un-overlapped or padding-bloated pipeline program fails here,
+    # in the lint stage, before it ever serves traffic.
+    measured, wire, raw = measure(batch, variant="hide", m=model_hide)
+    rows.append(TrafficRow(
+        variant=f"batched-hide{batch}", steps=1,
+        measured_bytes=measured,
+        ideal_bytes=ideal_batched_step_bytes(local_shape, itemsize, batch),
+        wire_bytes=wire, wire_ideal=batch * wire1,
+        cost_analysis_bytes=raw, budget=hide_tolerance,
     ))
 
     if include_batch_fixture:

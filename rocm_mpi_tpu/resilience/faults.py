@@ -154,7 +154,7 @@ Instrumented fault points:
     "restore"  — utils/checkpoint.restore_state, before each restore
                  attempt (step = the step being restored). OPT-IN for
                  the same reason
-    "serve-batch" — serving/service.SimulationService._execute_batch,
+    "serve-batch" — serving/service.SimulationService._prepare_batch,
                  before each batch's lane assembly, flight step bump,
                  and collectives (step = the service's global batch
                  ordinal). OPT-IN: its step numbering is batches, not

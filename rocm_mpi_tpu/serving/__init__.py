@@ -6,10 +6,13 @@ heterogeneous requests onto shared compiled programs (`bins.py` — since
 the persistent compile cache is unsound on this stack, bin-packed
 program reuse is the ONLY compile amortizer), and a service driver
 (`service.py`) that executes batches on a space×batch mesh
-(parallel.mesh.BatchedGrid), multiplexes per-session checkpoints,
-streams per-request telemetry, and consumes the resilience layer's
-ElasticPolicy (grow when the queue is deep, shrink when idle, requeue
-rc-75 preemptions).
+(parallel.mesh.BatchedGrid) through a PIPELINED drain — explicit
+assemble → dispatch → fetch → resolve stages, double-buffered by
+default so host work overlaps device compute, bitwise-equal to the
+serial drain at any depth (docs/SERVING.md "The pipeline") —
+multiplexes per-session checkpoints, streams per-request telemetry,
+and consumes the resilience layer's ElasticPolicy (grow when the
+queue is deep, shrink when idle, requeue rc-75 preemptions).
 
 The request plane is hardened (docs/SERVING.md "SLOs and admission"):
 per-request deadlines expire stale pending tickets at pop time, a

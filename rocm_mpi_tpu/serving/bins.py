@@ -283,6 +283,44 @@ def validate_manifest_doc(doc: dict) -> list[str]:
         isinstance(p, str) for p in progs
     ):
         problems.append("missing programs list")
+    pipe = doc.get("pipeline")
+    if pipe is not None and pipe != {}:
+        # The drain-pipeline block (docs/SERVING.md "The pipeline"):
+        # depth, resolved batches, the device-bubble fraction, and the
+        # per-stage host walls — a hand-edited bubble outside [0, 1]
+        # or a non-count depth must fail here, not silently corrupt
+        # the next pipeline-efficiency audit of an archived manifest.
+        if not isinstance(pipe, dict):
+            problems.append("'pipeline' block is not an object")
+        else:
+            depth = pipe.get("depth")
+            if not isinstance(depth, int) or isinstance(depth, bool) \
+                    or depth < 1:
+                problems.append(f"pipeline.depth {depth!r} not >= 1")
+            batches = pipe.get("batches")
+            if not isinstance(batches, int) or isinstance(batches, bool) \
+                    or batches < 0:
+                problems.append(
+                    f"pipeline.batches {batches!r} not a count"
+                )
+            bubble = pipe.get("bubble")
+            if not isinstance(bubble, (int, float)) \
+                    or isinstance(bubble, bool) \
+                    or not 0.0 <= bubble <= 1.0:
+                problems.append(
+                    f"pipeline.bubble {bubble!r} outside [0, 1]"
+                )
+            for field in ("assemble_s", "dispatch_s", "fetch_s",
+                          "resolve_s", "busy_s", "wall_s"):
+                v = pipe.get(field)
+                if v is not None and (
+                    not isinstance(v, (int, float))
+                    or isinstance(v, bool) or v < 0
+                ):
+                    problems.append(
+                        f"pipeline.{field} {v!r} not a non-negative "
+                        "wall"
+                    )
     queue = doc.get("queue")
     if queue is not None:
         if not isinstance(queue, dict):

@@ -29,6 +29,19 @@ is a pure function of the submitted trace — in a multi-controller
 service every rank plans identical batches, so the batched collectives
 can never diverge (the GL08 hazard class). Sessions and result
 fetching are single-controller (the drill pins program counts).
+
+The drain hot path is PIPELINED (docs/SERVING.md "The pipeline"):
+each batch runs four explicit stages — assemble (host lane state) →
+dispatch (device transfer + the batched advance + a non-blocking host
+copy, all JAX async) → fetch (ONE blocking wait on the whole batch,
+then the finiteness verdicts) → resolve (session saves, ticket
+resolution, accounting). At `ServeConfig.pipeline_depth >= 2`
+(default 2, double-buffered) batch N+1's assemble/dispatch overlaps
+batch N's device compute and batch N's fetch/resolve runs while N+1
+computes; depth 1 is the serial drain. Results are bitwise-equal at
+any depth, every batch resolves inside its own drain pass, and the
+`serve.device_bubble` gauge reports the fraction of drain wall the
+device sat idle.
 """
 
 from __future__ import annotations
@@ -99,6 +112,23 @@ class ServeConfig:
     retry: RequestRetryPolicy | None = None  # None -> defaults
     circuit: CircuitPolicy | None = None  # None -> defaults
     quarantine_path: str | None = None
+    # The serving pipeline (docs/SERVING.md "The pipeline"): how many
+    # batches may be in flight at once inside one drain pass. Depth 1
+    # is the serial drain (assemble → dispatch → block → resolve, one
+    # batch at a time); depth 2 (the default) double-buffers — batch
+    # N+1's host assembly/dispatch overlaps batch N's device compute,
+    # and batch N's fetch/resolve runs while N+1 computes. Results are
+    # bitwise-equal at any depth (the stages reorder WAITING, never
+    # work); every batch still resolves inside its own drain pass, so
+    # the drain-boundary accounting invariant is depth-independent.
+    pipeline_depth: int = 2
+    # Host-side stage callbacks {stage: fn(stage, info)} for
+    # {"assemble","dispatch","fetch","resolve"} — called AFTER the
+    # stage, on the host, outside any traced region (a hook that
+    # mutates service/module state inside a traced body is the GL02
+    # hazard class; tests/analysis_fixtures/gl02_serving_pos.py). Used
+    # by drills to inject deterministic host-stage latency.
+    stage_hooks: dict | None = None
 
     def resolved_floor(self) -> float:
         if self.occupancy_floor is not None:
@@ -122,6 +152,7 @@ class ServeReport:
     programs: list = dataclasses.field(default_factory=list)
     compiles: dict = dataclasses.field(default_factory=dict)
     elastic: list = dataclasses.field(default_factory=list)
+    pipeline: dict = dataclasses.field(default_factory=dict)
 
     @property
     def n_bins(self) -> int:
@@ -145,6 +176,7 @@ class ServeReport:
                 "preempted": self.preempted,
                 "elastic": list(self.elastic),
                 "compiles": dict(self.compiles),
+                "pipeline": dict(self.pipeline),
             },
         )
 
@@ -461,6 +493,35 @@ class _Breaker:
         return min(n, 1) if self.state == "half-open" else 0
 
 
+@dataclasses.dataclass
+class _InFlight:
+    """One dispatched-but-unresolved batch riding the drain pipeline
+    (docs/SERVING.md "The pipeline"): everything the resolve stage
+    needs. `anchors` holds the batch's DONATED input leaves purely as
+    a deletion anchor: on this stack, dropping the last Python
+    reference to a donated-and-still-computing array blocks the host
+    until the consuming computation finishes (measured — the silent
+    re-serialization that would undo the whole pipeline), so the refs
+    ride here untouched and are released at fetch time, when deletion
+    is free. They are NEVER read — a retry/requeue after a dispatched
+    batch re-assembles from host state only (the donated-buffer hazard
+    the drill pins; reading one would raise jax's deleted-array
+    error)."""
+
+    key: BinKey
+    width: int
+    split: bool
+    seq: int  # the service's global batch ordinal (fault site)
+    prog: _Program
+    live: list  # tickets that actually hold a lane
+    starts: list  # per-live-lane resume start steps
+    lane_steps: object  # numpy (width,) int32 per-lane step counts
+    out: tuple  # advanced state leaves (device, async)
+    fetch: bool  # resolve tickets with host results?
+    need_host: bool  # fetch or session saves need the host copy
+    anchors: tuple = ()  # donated inputs: deletion anchor ONLY
+
+
 class SimulationService:
     """Multi-tenant batched simulation service (module docstring; the
     CLI driver is apps/serve.py)."""
@@ -491,6 +552,20 @@ class SimulationService:
         self.retries_total = 0  # lifetime retry-requeues (SLO block)
         self._admission_sync = {"rejected": 0, "expired": 0}
         self._multi: bool | None = None
+        # Pipeline accounting (docs/SERVING.md "The pipeline"):
+        # cumulative per-stage host walls, device-busy wall (≥1 batch
+        # dispatched-but-unfetched), and the drain execute wall the
+        # bubble gauge is measured against. time.monotonic by design —
+        # interval arithmetic on the scheduler clock, not a telemetry
+        # measurement (the spans carry those).
+        self._pipe = {
+            "batches": 0, "assemble_s": 0.0, "dispatch_s": 0.0,
+            "fetch_s": 0.0, "resolve_s": 0.0, "busy_s": 0.0,
+            "wall_s": 0.0,
+        }
+        self._inflight_n = 0
+        self._inflight_since: float | None = None
+        self.last_bubble: float | None = None  # most recent drain's
 
     def _is_multi(self) -> bool:
         """Multi-controller? Resolved once; also flips the queue's
@@ -651,10 +726,63 @@ class SimulationService:
             }},
         )
 
-    # ---- execution ------------------------------------------------------
+    # ---- execution (the drain pipeline, docs/SERVING.md) ----------------
+
+    def _stage_hook(self, stage: str, **info) -> None:
+        """Fire the host-side stage callback (ServeConfig.stage_hooks)
+        AFTER `stage` — outside every traced region by construction."""
+        hooks = self.config.stage_hooks
+        if not hooks:
+            return
+        fn = hooks.get(stage)
+        if fn is not None:
+            fn(stage, info)
+
+    def _note_dispatched(self) -> None:
+        """A batch entered flight (dispatched, unfetched): the device
+        is busy while >= 1 batch is in flight — the complement is the
+        bubble the serve.device_bubble gauge reports."""
+        if self._inflight_n == 0:
+            self._inflight_since = time.monotonic()
+        self._inflight_n += 1
+
+    def _note_fetched(self) -> None:
+        if self._inflight_n > 0:
+            self._inflight_n -= 1
+            if self._inflight_n == 0 and self._inflight_since is not None:
+                self._pipe["busy_s"] += (
+                    time.monotonic() - self._inflight_since
+                )
+                self._inflight_since = None
 
     def _execute_batch(self, key: BinKey, tickets: list[Ticket],
                        width: int, split: bool) -> None:
+        """The serial per-batch chokepoint (pipeline_depth == 1, and
+        the override seam the failure drills monkeypatch): prepare,
+        then resolve immediately — the staged pipeline with zero
+        overlap. Bitwise-identical to the pipelined drain by
+        construction: both run the same stages on the same batches in
+        the same order; only the waiting is scheduled differently."""
+        fl = self._prepare_batch(key, tickets, width, split)
+        if fl is not None:
+            self._resolve_batch(fl)
+
+    def _prepare_batch(self, key: BinKey, tickets: list[Ticket],
+                       width: int, split: bool) -> _InFlight | None:
+        """Pipeline stages 1+2 — assemble (host) + dispatch (async).
+
+        Assembles every lane's start state on the host, places the
+        batch on device, and dispatches the batched advance plus a
+        non-blocking device-to-host copy of the results (JAX async
+        dispatch: both return immediately as futures; the per-lane
+        finiteness verdict is deliberately NOT dispatched here — the
+        fetch stage computes it, see _resolve_batch). Nothing here
+        waits on the device, so batch N+1's prepare runs while batch N
+        computes. Returns the in-flight record the resolve stage
+        consumes, or None when no lane survived assembly. The input
+        device leaves are donated to the advance and NOT carried on
+        the record — a later retry can only re-assemble from host
+        state, never read a donated buffer."""
         import numpy as np
 
         from rocm_mpi_tpu import telemetry
@@ -688,158 +816,312 @@ class SimulationService:
         # nt behind the saved step) fails ITS ticket only — the
         # co-batched neighbors keep their lanes; the failed lane stays
         # idle padding.
+        t0 = time.monotonic()
         live: list[Ticket] = []
         starts: list[int] = []
-        lanes: list[tuple] = []
-        scales = np.zeros(width, dtype=prog.base_np_dtype)
-        lane_steps = np.zeros(width, dtype=np.int32)
-        for t in tickets:
-            try:
-                if multi and (t.request.resume or t.request.session):
-                    raise ValueError(
-                        "session checkpoints are single-controller only"
+        with telemetry.span("serve.assemble", phase="serve",
+                            bin=key.key_str(), width=width):
+            lanes: list[tuple] = []
+            scales = np.zeros(width, dtype=prog.base_np_dtype)
+            lane_steps = np.zeros(width, dtype=np.int32)
+            for t in tickets:
+                try:
+                    if multi and (t.request.resume or t.request.session):
+                        raise ValueError(
+                            "session checkpoints are single-controller "
+                            "only"
+                        )
+                    start = (
+                        self._resume_step(t.request, prog)
+                        if t.request.resume else 0
                     )
-                start = (
-                    self._resume_step(t.request, prog)
-                    if t.request.resume else 0
-                )
+                    if not multi:
+                        leaves, _ = self._lane_start_state(
+                            t.request, prog, start
+                        )
+                except ValueError as e:
+                    # A per-request validation error (bad session,
+                    # resume past nt): the request itself is wrong —
+                    # terminal, never retried.
+                    self._fail_ticket(t, str(e))
+                    continue
+                except Exception as e:  # noqa: BLE001 — tenant isolation
+                    # Transient lane-assembly failure (corrupt
+                    # checkpoint, storage flap on restore): retry
+                    # within budget.
+                    self._retry_or_quarantine(t, str(e))
+                    continue
+                j = len(live)
+                live.append(t)
+                starts.append(start)
+                lane_steps[j] = t.request.nt - start
+                scales[j] = t.request.ic_scale
                 if not multi:
-                    leaves, _ = self._lane_start_state(
-                        t.request, prog, start
-                    )
-            except ValueError as e:
-                # A per-request validation error (bad session, resume
-                # past nt): the request itself is wrong — terminal,
-                # never retried.
-                self._fail_ticket(t, str(e))
-                continue
-            except Exception as e:  # noqa: BLE001 — tenant isolation
-                # Transient lane-assembly failure (corrupt checkpoint,
-                # storage flap on restore): retry within budget.
-                self._retry_or_quarantine(t, str(e))
-                continue
-            j = len(live)
-            live.append(t)
-            starts.append(start)
-            lane_steps[j] = t.request.nt - start
-            scales[j] = t.request.ic_scale
-            if not multi:
-                lanes.append(leaves)
-            if faults.serving_fault("lane-nan", request=t.ordinal) \
-                    is not None:
-                # Poison THIS lane's initial state (the numerical-
-                # failure drill): the finiteness reduction below must
-                # fail only this ticket while its co-batched neighbors
-                # stay bitwise-equal to their standalone twins.
-                scales[j] = float("nan")
-                if not multi:
-                    lanes[j] = tuple(
-                        l * float("nan") for l in lanes[j]
-                    )
-            t.start_step = start
+                    lanes.append(leaves)
+                if faults.serving_fault("lane-nan", request=t.ordinal) \
+                        is not None:
+                    # Poison THIS lane's initial state (the numerical-
+                    # failure drill): the finiteness reduction must
+                    # fail only this ticket while its co-batched
+                    # neighbors stay bitwise-equal to their standalone
+                    # twins.
+                    scales[j] = float("nan")
+                    if not multi:
+                        lanes[j] = tuple(
+                            l * float("nan") for l in lanes[j]
+                        )
+                t.start_step = start
+        self._pipe["assemble_s"] += time.monotonic() - t0
+        self._stage_hook("assemble", key=key.key_str(), width=width,
+                         seq=seq, live=len(live))
         if not live:
-            return
+            return None
         n = int(lane_steps.max())
 
-        if multi:
-            # Multi-controller lane assembly is entirely on device (a
-            # host-assembled batch cannot be placed onto a sharding
-            # spanning other processes).
-            leaves_dev = prog.init_batched(
-                _to_global(scales, bgrid.batch_sharding)
-            )
-        else:
-            # Idle pad lanes: zero state, zero steps (frozen from step
-            # 0 — pure machine padding, the waste the occupancy floor
-            # bounds).
-            zero = tuple(np.zeros_like(l) for l in prog.base_np)
-            while len(lanes) < width:
-                lanes.append(zero)
-            leaves_dev = tuple(
-                _to_global(
-                    np.stack([lanes[i][leaf] for i in range(width)]),
-                    bgrid.sharding,
-                )
-                for leaf in range(prog.n_leaves)
-            )
-        steps_dev = _to_global(lane_steps, bgrid.batch_sharding)
-
+        t0 = time.monotonic()
         with telemetry.span(
-            "serve.batch", phase="serve",
-            bin=key.key_str(), width=width, live=len(live),
-            steps=n,
+            "serve.dispatch", phase="serve",
+            bin=key.key_str(), width=width, live=len(live), steps=n,
         ):
-            out = prog.adapter.run(prog, leaves_dev, steps_dev, n)
-            for leaf in out:
-                leaf.block_until_ready()
+            if multi:
+                # Multi-controller lane assembly is entirely on device
+                # (a host-assembled batch cannot be placed onto a
+                # sharding spanning other processes).
+                leaves_dev = prog.init_batched(
+                    _to_global(scales, bgrid.batch_sharding)
+                )
+            else:
+                # Idle pad lanes: zero state, zero steps (frozen from
+                # step 0 — pure machine padding, the waste the
+                # occupancy floor bounds).
+                zero = tuple(np.zeros_like(l) for l in prog.base_np)
+                while len(lanes) < width:
+                    lanes.append(zero)
+                leaves_dev = tuple(
+                    _to_global(
+                        np.stack([lanes[i][leaf] for i in range(width)]),
+                        bgrid.sharding,
+                    )
+                    for leaf in range(prog.n_leaves)
+                )
+            steps_dev = _to_global(lane_steps, bgrid.batch_sharding)
+            out = tuple(prog.adapter.run(prog, leaves_dev, steps_dev, n))
+            fetch = self.config.fetch_results
+            if fetch is None:
+                fetch = not multi
+            # Session persistence is independent of result fetching: a
+            # fetch_results=False service must still honor the durable-
+            # session contract (both need the host copy).
+            need_host = fetch or any(t.request.session for t in live)
+            if need_host and all(
+                leaf.is_fully_addressable for leaf in out
+            ):
+                # Start the device->host copies NOW, without blocking:
+                # by the time the resolve stage reads them the transfer
+                # has been riding under the next batch's compute.
+                for leaf in out:
+                    copy_async = getattr(leaf, "copy_to_host_async",
+                                         None)
+                    if copy_async is None:
+                        break
+                    copy_async()
+        self._pipe["dispatch_s"] += time.monotonic() - t0
+        self._stage_hook("dispatch", key=key.key_str(), width=width,
+                         seq=seq, live=len(live))
+        fl = _InFlight(
+            key=key, width=width, split=split, seq=seq, prog=prog,
+            live=live, starts=starts, lane_steps=lane_steps, out=out,
+            fetch=fetch, need_host=need_host,
+            anchors=(leaves_dev, steps_dev),
+        )
+        # Busy-mark LAST, after the stage hook and record construction:
+        # a raise between a _note_dispatched and its matching
+        # _note_fetched (resolve's finally) would leave _inflight_n
+        # stuck high and freeze the bubble accounting for the service's
+        # lifetime.
+        self._note_dispatched()
+        return fl
 
-        # The per-lane finiteness reduction (tenant isolation extended
-        # to NUMERICAL failure): a NaN/Inf lane fails only its own
-        # ticket — through the retry budget, so a persistently-poison
-        # request ends quarantined, never re-batched forever.
-        finite = np.asarray(prog.lane_finite(out))
+    def _resolve_batch(self, fl: _InFlight) -> None:
+        """Pipeline stages 3+4 — fetch (block) + resolve (host).
 
-        fetch = self.config.fetch_results
-        if fetch is None:
-            fetch = not multi
-        # Session persistence is independent of result fetching: a
-        # fetch_results=False service must still honor the durable-
-        # session contract (both need the host copy).
-        need_host = fetch or any(t.request.session for t in live)
-        host = None
-        if need_host and all(leaf.is_fully_addressable for leaf in out):
-            host = tuple(np.asarray(leaf) for leaf in out)
+        The one place the drain waits on the device: ONE blocking call
+        on the whole batch (never leaf-by-leaf in Python), then the
+        host copies the dispatch stage already set in motion. The
+        finiteness verdict, session saves, ticket resolution, and
+        accounting all run here — while the NEXT batch computes, when
+        the drain is pipelined."""
+        import jax
+        import numpy as np
+
+        from rocm_mpi_tpu import telemetry
+        from rocm_mpi_tpu.telemetry import flight
+
+        key, width = fl.key, fl.width
+        prog, live, starts = fl.prog, fl.live, fl.starts
+        lane_steps = fl.lane_steps
+        n = int(lane_steps.max())
+        t0 = time.monotonic()
+        try:
+            with telemetry.span("serve.fetch", phase="serve",
+                                bin=key.key_str(), width=width):
+                jax.block_until_ready(fl.out)
+                host = None
+                if fl.need_host and all(
+                    leaf.is_fully_addressable for leaf in fl.out
+                ):
+                    host = tuple(np.asarray(leaf) for leaf in fl.out)
+                # The per-lane finiteness verdict (tenant isolation
+                # extended to NUMERICAL failure): a NaN/Inf lane fails
+                # only its own ticket — through the retry budget, so a
+                # persistently-poison request ends quarantined, never
+                # re-batched forever. Computed from the HOST copies
+                # when the fetch already paid for them: dispatching the
+                # compiled reduction here would serialize against the
+                # NEXT batch's in-flight compute (one outstanding
+                # dispatch on this stack — measured, the silent
+                # re-serialization class) and undo the pipeline. The
+                # compiled replicated all-reduce remains the no-host
+                # path — multi-controller services need every rank to
+                # read one identical verdict, and they host-fetch
+                # nothing.
+                if host is not None:
+                    finite = np.array([
+                        all(
+                            bool(np.isfinite(leaf[j]).all())
+                            for leaf in host
+                        )
+                        for j in range(width)
+                    ])
+                else:
+                    finite = np.asarray(prog.lane_finite(fl.out))
+        finally:
+            # The busy interval ends even when the fetch raises —
+            # a failed batch must not read as a forever-busy device.
+            # The donated-input anchors release HERE: the advance has
+            # finished (or failed), so dropping the last references no
+            # longer blocks the host (_InFlight.anchors has the why).
+            fl.anchors = ()
+            self._pipe["fetch_s"] += time.monotonic() - t0
+            self._note_fetched()
+        self._stage_hook("fetch", key=key.key_str(), width=width,
+                         seq=fl.seq, live=len(live))
+
+        t0 = time.monotonic()
         done = 0
-        for j, t in enumerate(live):
-            if not bool(finite[j]):
+        with telemetry.span("serve.resolve", phase="serve",
+                            bin=key.key_str(), width=width,
+                            live=len(live)):
+            for j, t in enumerate(live):
+                if not bool(finite[j]):
+                    telemetry.record_event(
+                        "serve.lane.nan",
+                        request_id=t.request.request_id,
+                        bin=key.key_str(), width=width, lane=j,
+                    )
+                    self._retry_or_quarantine(
+                        t, "non-finite state (NaN/Inf) in lane"
+                    )
+                    continue
+                # Lane-isolated resolution: one tenant's failing
+                # session save (unwritable dir, disk full) must not
+                # fail its co-batched neighbors or skew the completion
+                # accounting.
+                try:
+                    lane = (
+                        tuple(leaf[j] for leaf in host)
+                        if host is not None else None
+                    )
+                    if t.request.session and lane is not None:
+                        self._save_session(t, lane, prog)
+                except ValueError as e:
+                    self._fail_ticket(t, str(e))
+                    continue
+                except Exception as e:  # noqa: BLE001 — tenant isolation
+                    self._retry_or_quarantine(t, str(e))
+                    continue
+                t.steps_run = int(lane_steps[j])
+                t._resolve(lane if fl.fetch else None)
+                done += 1
+                latency = t.age_s()
                 telemetry.record_event(
-                    "serve.lane.nan",
+                    "serve.request.done",
                     request_id=t.request.request_id,
-                    bin=key.key_str(), width=width, lane=j,
+                    bin=key.key_str(), width=width,
+                    steps=int(lane_steps[j]), start=starts[j],
+                    latency_s=round(latency, 6),
+                    deadline_miss=bool(
+                        t.request.deadline_s is not None
+                        and latency > t.request.deadline_s
+                    ),
                 )
-                self._retry_or_quarantine(
-                    t, "non-finite state (NaN/Inf) in lane"
-                )
-                continue
-            # Lane-isolated resolution: one tenant's failing session
-            # save (unwritable dir, disk full) must not fail its
-            # co-batched neighbors or skew the completion accounting.
-            try:
-                lane = (
-                    tuple(leaf[j] for leaf in host)
-                    if host is not None else None
-                )
-                if t.request.session and lane is not None:
-                    self._save_session(t, lane, prog)
-            except ValueError as e:
-                self._fail_ticket(t, str(e))
-                continue
-            except Exception as e:  # noqa: BLE001 — tenant isolation
-                self._retry_or_quarantine(t, str(e))
-                continue
-            t.steps_run = int(lane_steps[j])
-            t._resolve(lane if fetch else None)
-            done += 1
-            latency = t.age_s()
-            telemetry.record_event(
-                "serve.request.done",
-                request_id=t.request.request_id,
-                bin=key.key_str(), width=width,
-                steps=int(lane_steps[j]), start=starts[j],
-                latency_s=round(latency, 6),
-                deadline_miss=bool(
-                    t.request.deadline_s is not None
-                    and latency > t.request.deadline_s
-                ),
-            )
-        self.queue.note_completed(done)
-        flight.progress(serve_completed=done)
+            self.queue.note_completed(done)
+            flight.progress(serve_completed=done)
 
-        st = self._stats.get(key)
-        if st is None:
-            st = self._stats[key] = BinStats(key=key)
-        st.note_batch(width, [int(s) for s in lane_steps[:len(live)]],
-                      n, split=split)
+            st = self._stats.get(key)
+            if st is None:
+                st = self._stats[key] = BinStats(key=key)
+            st.note_batch(width,
+                          [int(s) for s in lane_steps[:len(live)]],
+                          n, split=fl.split)
+        self._pipe["resolve_s"] += time.monotonic() - t0
+        self._pipe["batches"] += 1
+        self._stage_hook("resolve", key=key.key_str(), width=width,
+                         seq=fl.seq, live=len(live))
+
+    def _batch_failed(self, key: BinKey, batch_ts: list[Ticket],
+                      width: int, e: Exception) -> None:
+        """The batch-level failure chokepoint (tenant isolation): a
+        batch failure — at prepare (compile error, injected
+        batch-error) or at resolve (device fault surfacing at fetch) —
+        fails ITS tickets and lets the other bins' batches keep
+        serving; an unhandled escape would strand every later popped
+        ticket in 'running' forever and kill the daemon without the
+        rc-75 requeue path. The tickets ride the retry budget
+        (transient faults requeue bounded, then quarantine); K
+        consecutive failures open the class's circuit breaker."""
+        from rocm_mpi_tpu import telemetry
+
+        telemetry.record_event(
+            "serve.batch.error", bin=key.key_str(), width=width,
+            error=str(e),
+        )
+        br = self._breakers[key]
+        if br.note_failure(self._circuit, self._drains):
+            telemetry.record_event(
+                "serve.circuit.open", bin=key.key_str(),
+                consecutive=br.consecutive,
+            )
+        for t in batch_ts:
+            if not t.done() and t.state == "running":
+                # Same routing as the lane level: a ValueError is a
+                # per-request/program-class validation error (unknown
+                # physics) — terminal, never retried; anything else is
+                # transient and rides the retry budget.
+                if isinstance(e, ValueError):
+                    self._fail_ticket(t, str(e))
+                else:
+                    self._retry_or_quarantine(t, str(e))
+
+    def pipeline_stats(self) -> dict:
+        """Lifetime pipeline accounting (the manifest's `pipeline`
+        block, docs/SERVING.md "The pipeline"): per-stage host walls,
+        the resolved batches, and the device bubble — the fraction of
+        the cumulative drain-execute wall with NO batch in flight."""
+        p = self._pipe
+        wall = p["wall_s"]
+        bubble = max(0.0, 1.0 - p["busy_s"] / wall) if wall > 0 else 0.0
+        return {
+            "depth": max(1, int(self.config.pipeline_depth)),
+            "batches": int(p["batches"]),
+            "bubble": round(bubble, 4),
+            "assemble_s": round(p["assemble_s"], 6),
+            "dispatch_s": round(p["dispatch_s"], 6),
+            "fetch_s": round(p["fetch_s"], 6),
+            "resolve_s": round(p["resolve_s"], 6),
+            "busy_s": round(p["busy_s"], 6),
+            "wall_s": round(p["wall_s"], 6),
+        }
 
     def _fail_ticket(self, t: Ticket, error: str) -> None:
         """The per-request-error chokepoint: ticket, queue counter, AND
@@ -1033,52 +1315,103 @@ class SimulationService:
                 pending.append((key, ts[i:i + take], w, w != canonical))
                 i += take
 
+        # The drain pipeline (docs/SERVING.md "The pipeline"): at
+        # depth 1, each batch runs assemble → dispatch → fetch →
+        # resolve serially through the _execute_batch chokepoint; at
+        # depth D >= 2, up to D-1 batches ride dispatched-but-
+        # unresolved, so batch N+1's host assembly and transfer overlap
+        # batch N's device compute, and batch N's fetch/resolve runs
+        # while N+1 computes. Every batch still resolves INSIDE this
+        # drain pass (the bounded tail drain below), so the
+        # drain-boundary accounting invariant and the retry/breaker/
+        # preemption semantics are depth-independent — and the results
+        # bitwise-equal, since the stages reorder waiting, never work.
         preempted = False
+        depth = max(1, int(self.config.pipeline_depth))
+        inflight: list[tuple] = []  # FIFO: (key, tickets, width, fl)
+        exec_t0 = time.monotonic()
+        busy0 = self._pipe["busy_s"]
+
+        def _finish(entry) -> None:
+            nonlocal served
+            fkey, fts, fw, fl = entry
+            fbr = self._breakers[fkey]
+            try:
+                self._resolve_batch(fl)
+                served += sum(1 for t in fts if t.state == "done")
+                if fbr.note_success():
+                    telemetry.record_event(
+                        "serve.circuit.close", bin=fkey.key_str(),
+                    )
+            except Exception as e:  # noqa: BLE001 — tenant isolation
+                self._batch_failed(fkey, fts, fw, e)
+
         for bi, (key, batch_ts, w, split) in enumerate(pending):
             if self._preempt_requested():
+                # Undispatched work requeues at the batch boundary (the
+                # rc-75 contract); already-dispatched batches FINISH in
+                # the tail drain below — in-flight lanes always
+                # complete their batch.
                 preempted = True
                 rest = [t for _, ts2, _, _ in pending[bi:] for t in ts2]
                 self.queue.requeue(rest)
                 flight.progress(serve_requeued=len(rest))
                 break
             br = self._breakers[key]
+            if depth == 1:
+                try:
+                    self._execute_batch(key, batch_ts, w, split)
+                    served += sum(
+                        1 for t in batch_ts if t.state == "done"
+                    )
+                    if br.note_success():
+                        telemetry.record_event(
+                            "serve.circuit.close", bin=key.key_str(),
+                        )
+                except Exception as e:  # noqa: BLE001 — tenant isolation
+                    self._batch_failed(key, batch_ts, w, e)
+                continue
+            if inflight and any(t.request.resume for t in batch_ts):
+                # Session read-after-write barrier: a resume lane's
+                # assembly reads its session dir, and an in-flight
+                # batch's resolve may still be ABOUT to write it (the
+                # session save lives in the resolve stage). Flush the
+                # pipeline first so the resume batch assembles against
+                # exactly the state the serial drain would see — the
+                # bitwise-equal contract; a rare, bounded stall.
+                while inflight:
+                    _finish(inflight.pop(0))
             try:
-                self._execute_batch(key, batch_ts, w, split)
-                served += sum(1 for t in batch_ts if t.state == "done")
+                fl = self._prepare_batch(key, batch_ts, w, split)
+            except Exception as e:  # noqa: BLE001 — tenant isolation
+                self._batch_failed(key, batch_ts, w, e)
+                continue
+            if fl is None:
+                # No lane survived assembly: the serial path books this
+                # as a (no-op) served batch too.
                 if br.note_success():
                     telemetry.record_event(
                         "serve.circuit.close", bin=key.key_str(),
                     )
-            except Exception as e:  # noqa: BLE001 — tenant isolation:
-                # a batch-level failure (compile error, injected
-                # batch-error, device mismatch) must fail ITS tickets
-                # and let the other bins' batches keep serving — an
-                # unhandled escape here would strand every later popped
-                # ticket in 'running' forever and kill the daemon
-                # without the rc-75 requeue path. The tickets ride the
-                # retry budget (transient faults requeue bounded, then
-                # quarantine); K consecutive failures open the class's
-                # circuit breaker.
-                telemetry.record_event(
-                    "serve.batch.error", bin=key.key_str(), width=w,
-                    error=str(e),
-                )
-                if br.note_failure(self._circuit, self._drains):
-                    telemetry.record_event(
-                        "serve.circuit.open", bin=key.key_str(),
-                        consecutive=br.consecutive,
-                    )
-                for t in batch_ts:
-                    if not t.done() and t.state == "running":
-                        # Same routing as the lane level: a ValueError
-                        # is a per-request/program-class validation
-                        # error (unknown physics) — terminal, never
-                        # retried; anything else is transient and rides
-                        # the retry budget.
-                        if isinstance(e, ValueError):
-                            self._fail_ticket(t, str(e))
-                        else:
-                            self._retry_or_quarantine(t, str(e))
+                continue
+            inflight.append((key, batch_ts, w, fl))
+            while len(inflight) >= depth:
+                _finish(inflight.pop(0))
+        # The bounded tail drain: everything still in flight resolves
+        # before the drain returns.
+        for entry in inflight:
+            _finish(entry)
+
+        if pending:
+            d_wall = time.monotonic() - exec_t0
+            self._pipe["wall_s"] += d_wall
+            d_busy = self._pipe["busy_s"] - busy0
+            bubble = (
+                max(0.0, 1.0 - d_busy / d_wall) if d_wall > 0 else 0.0
+            )
+            self.last_bubble = bubble
+            telemetry.gauge("serve.pipeline_depth", float(depth))
+            telemetry.gauge("serve.device_bubble", round(bubble, 4))
 
         if not preempted and not self._compiled_this_drain \
                 and self._programs:
@@ -1237,6 +1570,7 @@ class SimulationService:
         report.bins = dict(self._stats)
         report.programs = sorted(self._programs)
         report.elastic = list(self._elastic)
+        report.pipeline = self.pipeline_stats()
         snap = compiles.snapshot()
         report.compiles = {
             "total": snap["totals"]["backend_compiles"],

@@ -101,11 +101,18 @@ def extract_metrics(doc: dict) -> dict[str, tuple[float, str]]:
     for name, v in (doc.get("gauges") or {}).items():
         if not isinstance(v, (int, float)) or isinstance(v, bool):
             continue
-        if name.startswith("compiles."):
-            # Compile counts: fewer is better and ZERO is evidence (the
-            # steady-state contract), unlike the rate gauges where an
-            # absent/zero value means "not measured".
+        if name.startswith("compiles.") or name == "serve.device_bubble":
+            # Compile counts AND the serving pipeline's device-bubble
+            # fraction: fewer/less is better and ZERO is evidence (the
+            # steady-state / fully-overlapped contracts), unlike the
+            # rate gauges where an absent/zero value means "not
+            # measured".
             out[f"gauges.{name}"] = (float(v), LOWER)
+        elif name.startswith("serve.pipeline_"):
+            # Config echoes (serve.pipeline_depth): recorded for the
+            # summary reader, but a depth change is a deliberate knob,
+            # not a directional health metric — never regress-gated.
+            continue
         elif v > 0:
             out[f"gauges.{name}"] = (float(v), HIGHER)
     return out
@@ -270,6 +277,16 @@ def _validate_perf_budgets(doc: dict) -> list[str]:
                     f"serving batch_tolerance {tol!r} must be >= 1.0 "
                     "(a B-lane program can never move fewer bytes than "
                     "B x one lane)"
+                )
+            hide = serving.get("hide_tolerance")
+            if hide is not None and (
+                not isinstance(hide, (int, float))
+                or isinstance(hide, bool) or hide < 1.0
+            ):
+                problems.append(
+                    f"serving hide_tolerance {hide!r} must be >= 1.0 "
+                    "(the batched-hide program is gated per lane "
+                    "against the single-lane exchanged-step ideal)"
                 )
             floor = serving.get("occupancy_floor")
             if not isinstance(floor, (int, float)) \

@@ -206,6 +206,32 @@ run_tuning_search() {
     || echo "[watcher] tuning search rc=$? (continuing; cache keeps prior winners)"
 }
 
+suite_done() {
+  # The bench trajectory exists once bench.py --suite has banked at
+  # least one BENCH_r{n}.json at the repo root (ROADMAP item 5a — the
+  # file set was empty for nine perf PRs; the first healthy window must
+  # close that gap).
+  ls BENCH_r*.json >/dev/null 2>&1
+}
+
+run_bench_suite() {
+  # bench.py --suite: the full ladder (per-step/VMEM/deep/3D rows, the
+  # wire-mode pair, the batched-throughput rung, and the serial-vs-
+  # pipelined serving drain rung) banked atomically as BENCH_r{n}.json
+  # — the telemetry-regress flat-metrics trajectory record
+  # archive_telemetry copies and lint.sh schema-gates. A partial
+  # (killed) suite banks nothing by design, so re-running on the next
+  # healthy probe is safe. Bounded so a wedged backend cannot eat the
+  # rest of the window.
+  if suite_done; then
+    echo "[watcher] bench suite already banked — skipping"
+    return 0
+  fi
+  echo "[watcher] bench.py --suite (the BENCH_r{n}.json trajectory)"
+  timeout -k 15 3600 python bench.py --suite \
+    || echo "[watcher] bench suite rc=$? (continuing; no partial record banked)"
+}
+
 run_soak() {
   # The bounded chaos soak (docs/RESILIENCE.md §8, ROADMAP item 5) —
   # the ad-hoc serve smoke, grown up: one episode per fault family
@@ -310,6 +336,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     bash scripts/run_chip_queue.sh
     queue_rc=$?
     run_tuning_search
+    run_bench_suite
     run_soak
     run_tier_groups
     archive_telemetry

@@ -1,0 +1,45 @@
+"""GL02 true negatives, serving-pipeline edition: the SHIPPED
+chokepoint shapes. The drain pipeline's stage accounting and stage
+hooks mutate INSTANCE state from plain host-side methods — after the
+dispatch returns, outside every traced region — which is the legal
+form (serving/service.SimulationService._prepare_batch /
+_resolve_batch / _stage_hook)."""
+
+import time
+
+import jax
+
+
+class PipelineAccounting:
+    """The _pipe/_note_dispatched shape: instance-attr mutation from
+    untraced host methods."""
+
+    def __init__(self):
+        self.busy_s = 0.0
+        self.inflight = 0
+        self.since = None
+
+    def note_dispatched(self):
+        if self.inflight == 0:
+            self.since = time.monotonic()
+        self.inflight += 1
+
+    def note_fetched(self):
+        self.inflight -= 1
+        if self.inflight == 0 and self.since is not None:
+            self.busy_s += time.monotonic() - self.since
+            self.since = None
+
+
+def resolve_hook(stage, info):
+    """The stage-callback contract: a HOST-side callable fired after
+    the stage — free to sleep, log, or mutate its own closure."""
+    time.sleep(0.0)
+    return (stage, dict(info))
+
+
+@jax.jit
+def pure_batched_step(x, *, lane_steps=None):
+    # the pipeline's traced half stays pure: per-lane variation is
+    # traced DATA (lane_steps), never a host-state read-back
+    return x * 2 if lane_steps is None else x + 1

@@ -1,0 +1,29 @@
+"""GL02 true positives, serving-pipeline edition (ISSUE 15 satellite):
+host-side service state mutated from INSIDE a traced/async region. The
+drain pipeline's stage callbacks (ServeConfig.stage_hooks) run on the
+host AFTER each stage by contract — a "hook" that instead pokes service
+or module state from a jitted body runs once at trace time and is
+silently skipped by every cached-program reuse, exactly the stale-global
+class GL02 exists for."""
+
+import jax
+import rocm_mpi_tpu.serving.service as serving_service
+
+_BUBBLE_MARKS = 0
+
+
+@jax.jit
+def fetch_stage_with_state_write(x):
+    global _BUBBLE_MARKS  # GL02: bubble accounting in a traced body
+    _BUBBLE_MARKS = _BUBBLE_MARKS + 1
+    return x * 2
+
+
+@jax.jit
+def resolve_stage_with_cross_module_write(x):
+    # GL02 (cross-module mutation): stamping the service module's
+    # pipeline state from a traced body — the next reuse of this
+    # compiled program never re-runs the write, so the "accounting"
+    # freezes at trace time.
+    serving_service._PIPELINE_STAGE = "resolve"
+    return x + 1

@@ -195,6 +195,30 @@ def test_gl02_flags_tuning_cache_write_in_traced_body():
     assert "_TUNED" in messages
 
 
+def test_gl02_flags_stage_callback_state_write_in_traced_body():
+    """ISSUE 15's hazard fixture: the drain pipeline's stage callbacks
+    are HOST-side by contract — a "hook" that mutates service/module
+    state from inside a traced body (the fetch/resolve stage's async
+    region) runs once at trace time and is skipped by every cached
+    program reuse; both shipped shapes (a bubble-accounting `global`
+    and a cross-module write into the service module) must fire."""
+    findings = [
+        f for f in lint_fixture("gl02_serving_pos.py") if f.rule == "GL02"
+    ]
+    assert len(findings) >= 2, findings
+    messages = " | ".join(f.message for f in findings)
+    assert "_BUBBLE_MARKS" in messages
+    assert "serving_service._PIPELINE_STAGE" in messages
+
+
+def test_gl02_serving_chokepoint_shapes_stay_clean():
+    """The SHIPPED pipeline shapes — instance-attr stage accounting
+    from plain host methods, a host-side stage hook, a pure traced
+    batched step — must not fire (the real chokepoint is pinned clean
+    repo-wide by test_self_lint)."""
+    assert "GL02" not in live_rules(lint_fixture("gl02_serving_neg.py"))
+
+
 # ---------------------------------------------------------------------------
 # GL08 / GL09 — the interprocedural rule families (ISSUE 8)
 # ---------------------------------------------------------------------------

@@ -175,8 +175,10 @@ def test_bounded_soak_acceptance(tmp_path):
                          out / "quarantine.jsonl"]) == []
 
     names = {ep["name"]: ep for ep in doc["episodes"]}
-    assert set(names) == {"serve-chaos", "breaker", "storage", "evict",
-                          "gloo-serve", "gloo-kill"}
+    assert set(names) == {"serve-chaos", "pipeline", "breaker",
+                          "storage", "evict", "gloo-serve", "gloo-kill"}
+    # the pipeline episode proved overlap does not reorder accounting
+    assert "bubble" in names["pipeline"], names["pipeline"]
     assert all(ep["ok"] for ep in doc["episodes"]), doc["episodes"]
     assert doc["accounting_ok"] is True
 
