@@ -1,0 +1,251 @@
+"""The fleet driver: N simulation-service replicas behind one router
+(docs/SERVING.md "The fleet"; serving/router.py has the policy).
+
+Builds an in-process fleet — N independent `SimulationService`
+replicas, one `FleetRouter` front end, one durable ticket journal —
+serves a deterministic synthetic trace through it, and banks the
+fleet sidecars under --out:
+
+    fleet-journal.jsonl    the append-only ticket journal
+                           (rmt-fleet-journal v1, schema-checked)
+    fleet-report.json      the merged fleet report (rmt-fleet-report
+                           v1: replica rows, journal-derived SLO
+                           block, accounting verdict, autoscale trail)
+
+Fault drills ride the standard grammar (--inject-fault
+"replica-kill@step=2,rank=1" kills replica 1 at fleet tick 2; the
+router reconciles from the journal and the run still has to balance).
+
+Exit codes: 0 fleet drained clean and every journaled ticket reached
+exactly one terminal state; 1 accounting broke or a request
+failed/was quarantined; 75 preempted (queued work journaled, rc 75 is
+the scheduler's requeue signal); 2 usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+import tempfile
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from apps._common import (  # noqa: E402
+    add_health_flag,
+    add_telemetry_flag,
+    positive_int,
+    setup_health,
+    setup_telemetry,
+)
+from apps.serve import synthetic_trace  # noqa: E402
+
+
+def make_parser():
+    p = argparse.ArgumentParser(
+        description="multi-replica serving fleet: router + journal + "
+        "N SimulationService replicas (docs/SERVING.md 'The fleet')"
+    )
+    p.add_argument("--replicas", type=positive_int, default=3,
+                   help="fleet size at launch (default 3)")
+    p.add_argument("--synthetic", type=positive_int, default=None,
+                   metavar="N", help="serve N deterministic synthetic "
+                   "requests (default 12)")
+    p.add_argument("--seed", type=int, default=1,
+                   help="synthetic-trace seed (determinism contract)")
+    p.add_argument("--nt-max", type=positive_int, default=64,
+                   help="synthetic per-request step-count cap")
+    p.add_argument("--dtype", default="f32",
+                   choices=["f32", "f64", "bf16"],
+                   help="synthetic-trace dtype")
+    p.add_argument("--max-width", type=positive_int, default=8,
+                   help="widest batch lane count per replica")
+    p.add_argument("--max-depth", type=positive_int, default=None,
+                   help="per-replica admission bound: the router "
+                   "spills over it and fleet-full rejects carry the "
+                   "MERGED retry-after hint (default: unbounded)")
+    p.add_argument("--cpu-devices", type=int, default=0, metavar="N",
+                   help="simulate N virtual CPU devices")
+    p.add_argument("--sessions", default=None, metavar="DIR",
+                   help="session root: each replica checkpoints its "
+                   "sessions under DIR/replica-<id>/")
+    p.add_argument("--deadline-s", type=float, default=None,
+                   help="stamp every synthetic request with this TTL "
+                   "(expired by the ROUTER's clock — replicas never "
+                   "own wall time)")
+    p.add_argument("--elastic", action="store_true",
+                   help="promote ElasticPolicy to the fleet "
+                   "autoscaler: grow/retire whole replicas on "
+                   "aggregate queue depth")
+    p.add_argument("--max-replicas", type=positive_int, default=None,
+                   help="autoscale ceiling (default: --replicas)")
+    p.add_argument("--grow-depth", type=positive_int, default=8,
+                   help="aggregate backlog per live replica that "
+                   "makes the autoscaler consider a grow (--elastic)")
+    p.add_argument("--ticks", type=positive_int, default=1000,
+                   help="fleet drive-tick budget (bounded drills)")
+    p.add_argument("--out", default=None, metavar="DIR",
+                   help="bank fleet-journal.jsonl + fleet-report.json "
+                   "under DIR")
+    p.add_argument("--inject-fault", default=None, metavar="SPEC",
+                   help="deterministic fault plan, e.g. "
+                   "'replica-kill@step=2,rank=1' (rank = REPLICA id; "
+                   "resilience/faults.py has the grammar)")
+    add_telemetry_flag(p)
+    add_health_flag(p)
+    return p
+
+
+def main(argv=None) -> int:
+    args = make_parser().parse_args(argv)
+
+    if args.inject_fault:
+        from rocm_mpi_tpu.resilience import faults
+
+        faults.install(args.inject_fault)
+
+    import jax
+
+    from rocm_mpi_tpu.parallel.distributed import maybe_initialize_distributed
+
+    maybe_initialize_distributed()
+    if args.cpu_devices:
+        from rocm_mpi_tpu.utils.backend import set_cpu_device_count
+
+        jax.config.update("jax_platforms", "cpu")
+        set_cpu_device_count(args.cpu_devices)
+    setup_telemetry(args, jax)
+    setup_health(args, jax)
+    from rocm_mpi_tpu.telemetry import compiles
+
+    compiles.install()
+    from rocm_mpi_tpu.resilience import preempt
+
+    preempt.install_from_env()
+
+    from rocm_mpi_tpu.serving import journal as fleet_journal
+    from rocm_mpi_tpu.serving.router import FleetRouter
+    from rocm_mpi_tpu.serving.service import ServeConfig, SimulationService
+    from rocm_mpi_tpu.telemetry import health
+    from rocm_mpi_tpu.utils.logging import log0
+
+    n = args.synthetic or 12
+    requests = synthetic_trace(
+        n, args.seed, nt_max=args.nt_max, dtype=args.dtype,
+        deadline_s=args.deadline_s,
+    )
+    if any(r.dtype == "f64" for r in requests):
+        jax.config.update("jax_enable_x64", True)
+
+    out = pathlib.Path(args.out) if args.out else None
+    if out is not None:
+        out.mkdir(parents=True, exist_ok=True)
+        journal_path = out / "fleet-journal.jsonl"
+    else:
+        journal_path = (
+            pathlib.Path(tempfile.mkdtemp(prefix="rmt-fleet-"))
+            / "fleet-journal.jsonl"
+        )
+    journal = fleet_journal.TicketJournal(journal_path)
+
+    policy = None
+    if args.elastic:
+        from rocm_mpi_tpu.resilience.policy import ElasticPolicy
+
+        policy = ElasticPolicy()
+
+    def factory(rid: int) -> SimulationService:
+        sessions_dir = None
+        if args.sessions:
+            sessions_dir = str(
+                pathlib.Path(args.sessions) / f"replica-{rid}"
+            )
+        return SimulationService(config=ServeConfig(
+            max_width=args.max_width,
+            sessions_dir=sessions_dir,
+        ))
+
+    router = FleetRouter(
+        factory, args.replicas,
+        journal=journal,
+        max_depth_per_replica=args.max_depth,
+        policy=policy,
+        max_replicas=args.max_replicas,
+        grow_queue_depth=args.grow_depth,
+    )
+    log0(f"fleet up: {args.replicas} replica(s), journal "
+         f"{journal_path} (max_width={args.max_width}, "
+         f"max_depth={args.max_depth}, devices={len(jax.devices())})")
+
+    # This driver is its own submitter: the trace is paced into the
+    # fleet in waves with one drive tick between them — a drain pass
+    # empties a replica's whole backlog, so up-front submission would
+    # finish in one tick and a fault plan keyed to fleet ticks
+    # (replica-kill@step=K) could never fire MID-traffic. With
+    # --max-depth it also paces against the fleet backlog (drive,
+    # then submit) so the fixed trace is never fast-rejected into the
+    # void — the fleet-full reject path is for external submitters
+    # who can honor the merged retry-after hint.
+    served = 0
+    wave = max(1, len(requests) // 4)
+    for i in range(0, len(requests), wave):
+        for r in requests[i:i + wave]:
+            if args.max_depth is not None:
+                while router.healthy_replicas() and all(
+                    rep.depth() >= args.max_depth
+                    for rep in router.healthy_replicas()
+                ):
+                    served += router.drive_once()
+            router.submit(r)
+        if i + wave < len(requests):
+            served += router.drive_once()
+    served += router.drive(max_ticks=args.ticks)
+
+    problems = router.check_accounting()
+    merged = router.merged_counters()
+    stream_paths = ()
+    if args.telemetry:
+        stream_paths = tuple(sorted(
+            pathlib.Path(args.telemetry).glob("telemetry-rank*.jsonl")
+        ))
+    doc = router.report_doc(stream_paths=stream_paths)
+
+    log0(
+        f"fleet served {served} batch-request(s): "
+        f"{merged['completed']}/{merged['submitted']} done, "
+        f"{merged['failed']} failed, {merged['rejected']} rejected, "
+        f"{merged['expired']} expired, "
+        f"{merged['quarantined']} quarantined, "
+        f"{merged['retries']} retries"
+    )
+    for rep in router.replicas:
+        state = ("up" if rep.healthy
+                 else (rep.verdict or "down"))
+        log0(f"  replica {rep.id}: {state} "
+             f"counters={rep.svc.queue.counters()}")
+    for ev in router.autoscale_events:
+        log0(f"  autoscale: {ev}")
+    jc = doc["journal"]
+    log0(f"  journal: {jc['tickets']} ticket(s), {jc['open']} open, "
+         f"{jc['rerouted']} rerouted, {jc['torn_lines']} torn")
+    for p in problems:
+        log0(f"  ACCOUNTING: {p}")
+    log0(health.format_fleet_status(health.fleet_status(doc)))
+
+    if out is not None and jax.process_index() == 0:
+        report_path = out / "fleet-report.json"
+        fleet_journal.write_fleet_report(report_path, doc)
+        log0(f"banked {journal_path.name} and {report_path.name} "
+             f"({len(doc['replicas'])} replica row(s))")
+    journal.close()
+
+    if router.preempted:
+        log0("preempted: queued work journaled; rc 75 (EX_TEMPFAIL)")
+        return 75
+    if problems or merged["failed"] or merged["quarantined"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

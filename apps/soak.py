@@ -353,6 +353,98 @@ class Soak:
         assert c["completed"] == 6, c
         return {"counters": c, "requeued_at_eviction": requeued}
 
+    def ep_fleet(self):
+        """The fleet kill drill (docs/SERVING.md "The fleet"): three
+        in-process replicas behind the router + ticket journal,
+        replica 1 killed MID-traffic by the fault grammar at fleet
+        tick 2 — every journaled ticket reaches exactly one terminal
+        state fleet-wide (journal replay is idempotent and balances),
+        the surviving tenants' results stay bitwise-equal to a
+        standalone twin, and the merged fleet report banks
+        schema-valid with compiles.steady_state 0 per replica."""
+        import numpy as np
+
+        from rocm_mpi_tpu.serving import journal as fleet_journal
+        from rocm_mpi_tpu.serving.router import FleetRouter
+        from rocm_mpi_tpu.telemetry import compiles
+
+        # The report rows carry the process-global steady-recompile
+        # count; isolate this episode's window from earlier episodes'
+        # compile traffic (the installed tap stays).
+        compiles.reset()
+
+        def trace():
+            # Three bins over two shapes: wave pacing below guarantees
+            # at least one ticket is OPEN on replica 1 at the tick-2
+            # kill (bin affinity spreads the three bins one per
+            # replica on first route).
+            return [
+                _req(
+                    f"fleet-{i:02d}",
+                    shape=SHAPE_A if i % 3 else SHAPE_B,
+                    nt=3 + (i % 3),
+                    ic_scale=1.0 + 0.015 * i,
+                )
+                for i in range(9)
+            ]
+
+        jpath = self.out / "fleet-journal.jsonl"
+        if jpath.exists():
+            jpath.unlink()
+        journal = fleet_journal.TicketJournal(jpath)
+        router = FleetRouter(
+            lambda rid: self._service(max_width=2), 3, journal=journal,
+        )
+        reqs = trace()
+        tickets = []
+        for i in range(0, len(reqs), 3):
+            tickets += [router.submit(r) for r in reqs[i:i + 3]]
+            router.drive_once()
+        router.drive()
+        problems = router.check_accounting()
+        assert not problems, problems
+        dead = [r for r in router.replicas if not r.alive]
+        assert [r.id for r in dead] == [1], (
+            f"replica-kill@step=2,rank=1 did not kill replica 1: "
+            f"{[(r.id, r.alive, r.verdict) for r in router.replicas]}"
+        )
+        state = router.journal_state()
+        counts = state.counts()
+        assert counts["open"] == 0 and counts["rerouted"] >= 1, counts
+        # Replay idempotence: the journal is a pure fold — replaying
+        # the complete journal changes no counter.
+        assert fleet_journal.replay(journal.segments()).counts() \
+            == counts, "journal replay is not idempotent"
+        # Bitwise twin: the same trace through ONE standalone service.
+        twin = self._service(max_width=2)
+        twin_tickets = [twin.queue.submit(r) for r in trace()]
+        _drive(twin)
+        for t, ref in zip(tickets, twin_tickets):
+            assert t.state == "done", (t.request.request_id, t.error)
+            for a, b in zip(t.result(timeout=5),
+                            ref.result(timeout=5)):
+                assert np.array_equal(np.asarray(a), np.asarray(b)), (
+                    f"{t.request.request_id}: fleet != standalone twin"
+                )
+        streams = sorted(
+            pathlib.Path(self.stream_dirs[0]).glob(
+                "telemetry-rank*.jsonl"
+            )
+        )
+        doc = router.report_doc(stream_paths=streams)
+        assert doc["accounting_ok"], doc
+        for row in doc["replicas"]:
+            assert row["steady_state"] == 0, row
+        fleet_journal.write_fleet_report(
+            self.out / "fleet-report.json", doc
+        )
+        journal.close()
+        merged = router.merged_counters()
+        for k, v in merged.items():
+            self.counters[k] = self.counters.get(k, 0) + int(v)
+        return {"counters": merged, "rerouted": counts["rerouted"],
+                "killed": [r.id for r in dead]}
+
     # ---- gloo-real episodes --------------------------------------------
 
     def _serve_argv(self, n: int, extra=()):
@@ -460,6 +552,8 @@ class Soak:
             ("breaker", "in-process", None, self.ep_breaker),
             ("storage", "in-process", None, self.ep_storage),
             ("evict", "in-process", None, self.ep_evict),
+            ("fleet", "in-process", "replica-kill@step=2,rank=1",
+             self.ep_fleet),
         ]
         if gloo:
             eps += [

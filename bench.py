@@ -58,11 +58,17 @@ skips the multi-ten-second Mosaic compiles.
 252², temporal-blocked and per-step paths at 12288², 3D) and prints a
 human-readable table to stderr — the source of BASELINE.md's measured
 numbers. It runs inline (manual/diagnostic use; no subprocess shielding).
+
+`--compare r{n} r{m}` diffs two banked BENCH_r{NN}.json trajectory
+records (baseline first) with the regress gate's tolerance semantics:
+a per-key delta table plus dropped/new rungs, exit 1 on any regression
+beyond tolerance — ROADMAP item 5's first-class before/after report.
 """
 
 import dataclasses
 import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -678,6 +684,89 @@ def run_suite() -> None:
 
 
 # --------------------------------------------------------------------------
+# Trajectory compare (ROADMAP item 5: first-class before/after numbers)
+# --------------------------------------------------------------------------
+
+
+def _resolve_record(spec: str) -> str:
+    """A --compare operand to a record path: 'r3' / 'r03' / '3' name the
+    repo-root BENCH_r{NN}.json trajectory records; anything carrying a
+    path separator or a .json suffix is an explicit path (tests and
+    archived `docs/telemetry_r*/` records live elsewhere)."""
+    s = spec.strip()
+    if os.sep in s or s.endswith(".json"):
+        return s
+    m = re.fullmatch(r"r?(\d+)", s)
+    if not m:
+        raise ValueError(
+            f"--compare operand {spec!r}: expected rN or a .json path"
+        )
+    root = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(root, f"BENCH_r{int(m.group(1)):02d}.json")
+
+
+def compare_records(base_spec: str, cur_spec: str,
+                    tolerance: float | None = None) -> int:
+    """`bench.py --compare r{n} r{m}`: the per-key trajectory report
+    between two banked suite records — baseline first, current second.
+    Reuses the regress machinery (same directions, same tolerance
+    semantics as the committed gate) and prints one row per shared
+    metric plus the keys only one record carries, so a silently
+    dropped or newly added rung is visible instead of vanishing from
+    the diff. Exit 1 when any metric moved the wrong way by more than
+    the tolerance; exit 2 when an input cannot be read or the records
+    share no keys."""
+    from rocm_mpi_tpu.telemetry import regress
+
+    try:
+        base_path = _resolve_record(base_spec)
+        cur_path = _resolve_record(cur_spec)
+    except ValueError as e:
+        print(f"bench.py --compare: {e}", file=sys.stderr)
+        return 2
+    base = regress.load_json(base_path)
+    cur = regress.load_json(cur_path)
+    bad = [p for p, d in ((base_path, base), (cur_path, cur)) if d is None]
+    if bad:
+        for p in bad:
+            print(f"bench.py --compare: cannot read {p}", file=sys.stderr)
+        return 2
+    tol = regress.DEFAULT_TOLERANCE if tolerance is None else tolerance
+    deltas = regress.compare(cur, base, tolerance=tol)
+    base_keys = regress.extract_metrics(base)
+    cur_keys = regress.extract_metrics(cur)
+    if not deltas:
+        print(
+            f"bench.py --compare: no shared metric keys between "
+            f"{base_path} and {cur_path}",
+            file=sys.stderr,
+        )
+        return 2
+
+    width = max(len(d.name) for d in deltas)
+    print(f"bench.py --compare: {os.path.basename(base_path)} -> "
+          f"{os.path.basename(cur_path)} (tolerance {tol:.0%})")
+    for d in deltas:
+        verdict = "REGRESSED" if d.regressed else "ok"
+        print(
+            f"  {d.name:{width}s}  {d.baseline:12.4f} -> "
+            f"{d.current:12.4f}  {d.change:+8.1%}  "
+            f"[{d.direction} is better] {verdict}"
+        )
+    for name in sorted(set(base_keys) - set(cur_keys)):
+        print(f"  {name:{width}s}  dropped (baseline-only rung)")
+    for name in sorted(set(cur_keys) - set(base_keys)):
+        print(f"  {name:{width}s}  new (no baseline)")
+    bad_rows = regress.regressions(deltas)
+    print(
+        f"  {len(deltas)} compared, {len(bad_rows)} regressed, "
+        f"{len(set(base_keys) - set(cur_keys))} dropped, "
+        f"{len(set(cur_keys) - set(base_keys))} new"
+    )
+    return 1 if bad_rows else 0
+
+
+# --------------------------------------------------------------------------
 # Parent: budget, retries, guaranteed JSON
 # --------------------------------------------------------------------------
 
@@ -870,6 +959,24 @@ def main() -> int:
         return child_main(budget)
     if "--prime-cache" in argv:
         return prime_cache()
+    if "--compare" in argv:
+        # Trajectory report: no backend, no subprocess — pure file diff.
+        i = argv.index("--compare")
+        ops = [a for a in argv[i + 1:] if not a.startswith("-")][:2]
+        tol = None
+        for a in argv:
+            if a.startswith("--tolerance="):
+                try:
+                    tol = float(a.split("=", 1)[1])
+                except ValueError:
+                    print(f"bench.py --compare: malformed {a!r}",
+                          file=sys.stderr)
+                    return 2
+        if len(ops) != 2:
+            print("usage: bench.py --compare rN rM [--tolerance=F] "
+                  "(baseline first, current second)", file=sys.stderr)
+            return 2
+        return compare_records(ops[0], ops[1], tol)
     if "--suite" in argv:
         # Manual/diagnostic mode: no subprocess shielding; honor the
         # platform override BEFORE run_suite's first backend use, and keep

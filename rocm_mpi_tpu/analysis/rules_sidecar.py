@@ -44,10 +44,11 @@ from rocm_mpi_tpu.analysis.core import ModuleContext, Rule
 # The committed artifact families (scripts/lint.sh schema-checks these
 # names; chip_watcher archives them). `quarantine` and `soak-report`
 # joined with the request-plane hardening (docs/SERVING.md "SLOs and
-# admission"; docs/RESILIENCE.md §8).
+# admission"; docs/RESILIENCE.md §8); `fleet` covers the ticket
+# journal and the merged fleet report (docs/SERVING.md "The fleet").
 _ARTIFACT_NAME_RE = re.compile(
     r"(heartbeat|manifest|postmortem|bundle|elastic|cache|tuning|"
-    r"baseline|findings|summary|quarantine|soak)[-\w.]*\.jsonl?\b"
+    r"baseline|findings|summary|quarantine|soak|fleet)[-\w.]*\.jsonl?\b"
 )
 
 _SCHEMA_KEYS = {"schema", "kind"}

@@ -80,6 +80,24 @@ the raising `fault_point` below — the caller interprets the clause):
                             control drill: a bounded queue must reject
                             the overflow fast with a retry-after hint
 
+Fleet-plane kinds (docs/SERVING.md "The fleet"; consumed by the fleet
+router's drive loop through `replica_fault`, never by the raising
+`fault_point` — `rank=` names the REPLICA id, not a process rank):
+
+    replica-kill@step=K,rank=R   at the Kth fleet drive tick, replica
+                            R dies without cleanup (the SIGKILL /
+                            rc-75 / watchdog-verdict analog): its
+                            queue counters are gone, and only the
+                            router's ticket journal can prove what it
+                            owed — the replay-reconciliation drill
+    replica-stall@step=K,rank=R  at the Kth drive tick replica R stops
+                            making progress but stays up — the
+                            wedged-replica analog: the router's health
+                            view must DEMOTE it (no new routes) and
+                            re-route its pending tickets exactly as
+                            for a kill, while its frozen state stays
+                            readable
+
 The infrastructure kinds compose with serving through the opt-in
 `serve-batch` site: `kill@step=2,rank=1,at=serve-batch` kills rank 1
 before the 2nd batch's collectives (step = the service's global batch
@@ -193,6 +211,12 @@ SERVING_KINDS = frozenset(
 SLOW_BATCH_DEFAULT_S = 0.5
 QUEUE_FLOOD_DEFAULT_N = 16
 
+# Fleet-plane kinds (module docstring): matched ONLY by
+# `replica_fault` — their `rank=` modifier names a REPLICA id, not a
+# process rank, so neither `fault_point` nor `serving_fault` may ever
+# interpret them.
+REPLICA_KINDS = frozenset({"replica-kill", "replica-stall"})
+
 
 class InjectedCrash(RuntimeError):
     """The injected failure run_supervised retries around."""
@@ -260,7 +284,8 @@ def _parse_clause(raw: str) -> FaultClause:
         delay_s = float(QUEUE_FLOOD_DEFAULT_N)
     if kind not in ("crash", "kill", "die", "truncate-latest", "delay",
                     "stall") and kind not in IO_KINDS \
-            and kind not in SERVING_KINDS:
+            and kind not in SERVING_KINDS \
+            and kind not in REPLICA_KINDS:
         raise ValueError(f"unknown fault kind {kind!r} in {raw!r}")
     clause = FaultClause(kind, delay_s=delay_s)
     triggers = [t for t in [trigger.strip()] + mods if t]
@@ -306,6 +331,11 @@ def _parse_clause(raw: str) -> FaultClause:
             and clause.step is None:
         raise ValueError(
             f"{kind} needs a step=N trigger (batch/drain ordinal): "
+            f"{raw!r}"
+        )
+    if kind in REPLICA_KINDS and clause.step is None:
+        raise ValueError(
+            f"{kind} needs a step=K trigger (the fleet drive tick): "
             f"{raw!r}"
         )
     return clause
@@ -436,6 +466,39 @@ def serving_fault(kind: str, step=None, request=None):
     return None
 
 
+def replica_fault(kind: str, step=None, replica=None):
+    """Match-and-consume for the fleet-plane kinds (module docstring):
+    returns the firing `FaultClause` or None. `step` is the router's
+    drive-tick ordinal; `replica` the replica id a clause's `rank=`
+    modifier scopes to (an unscoped clause matches any replica — the
+    first drive tick to ask, wins). The CALLER interprets the clause:
+    the router marks the replica dead for replica-kill, demotes it for
+    replica-stall, and runs journal-replay reconciliation for both.
+    Deliberately NOT `serving_fault`: there `rank=` means the calling
+    process's rank, and a fleet drill scoping `rank=1` must kill
+    replica 1, not depend on which process hosts the router."""
+    if kind not in REPLICA_KINDS:
+        raise ValueError(f"not a replica fault kind: {kind!r}")
+    plan = install_from_env()
+    if not plan:
+        return None
+    for clause in plan.clauses:
+        if clause.kind != kind:
+            continue
+        if clause.fires >= (clause.times or plan.MAX_FIRES):
+            continue
+        if clause.rank is not None and (
+            replica is None or clause.rank != int(replica)
+        ):
+            continue
+        if step is None or clause.step is None \
+                or int(step) != clause.step:
+            continue
+        clause.fires += 1
+        return clause
+    return None
+
+
 def fault_point(name: str, step=None, directory=None) -> None:
     """Instrumentation hook: a no-op without an installed/env plan.
 
@@ -450,9 +513,11 @@ def fault_point(name: str, step=None, directory=None) -> None:
         plan._segments_seen += 1
     rank = _rank()
     for clause in plan.clauses:
-        if clause.kind in SERVING_KINDS:
-            # Serving kinds are matched only by serving_fault(): their
-            # step numbering is batches/drains, not simulation steps.
+        if clause.kind in SERVING_KINDS or clause.kind in REPLICA_KINDS:
+            # Serving kinds are matched only by serving_fault() and
+            # replica kinds only by replica_fault(): their step
+            # numbering is batches/drains/drive-ticks, not simulation
+            # steps — and a replica clause's rank= is a replica id.
             continue
         if clause.fires >= (clause.times or plan.MAX_FIRES):
             continue

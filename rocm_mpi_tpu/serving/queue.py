@@ -69,6 +69,16 @@ TERMINAL_STATES = ("done", "failed", "rejected", "expired", "quarantined")
 # Retry-after fallback when no batch has completed yet (no throughput
 # observation to derive a hint from).
 DEFAULT_RETRY_AFTER_S = 1.0
+# Retry-after ceiling: the backlog÷rate derivation over a sparse or
+# long-spanning completion window can extrapolate to near-infinity
+# ("come back in 4 hours" is a lie about a queue that drains in
+# seconds once live) — every hint is clamped here.
+MAX_RETRY_AFTER_S = 60.0
+# Throughput-window staleness horizon: completion marks older than
+# this say nothing about CURRENT throughput (the post-flood idle
+# edge) — a stale window falls back to the default, never
+# extrapolates.
+RETRY_WINDOW_STALE_S = 60.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -496,14 +506,24 @@ class RequestQueue:
 
     def _retry_after_locked(self, depth: int) -> float:
         """Retry-after hint: backlog ÷ observed completion throughput
-        over the recent history window; the fallback constant when no
-        batch has completed yet. A hint, not a promise."""
+        over the recent history window. Every edge is BOUNDED into
+        [0.01, MAX_RETRY_AFTER_S]: zero/one completion marks (cold
+        start) fall back to the default constant; a window whose
+        newest mark is RETRY_WINDOW_STALE_S old (post-flood idle)
+        falls back too, because extrapolating a dead window produces
+        a near-infinite hint; a same-instant burst (span 0) likewise.
+        A hint, not a promise."""
         marks = self._done_marks
         if len(marks) >= 2:
             span = marks[-1][0] - marks[0][0]
             n = sum(c for _, c in marks)
-            if span > 0 and n > 0:
-                return max(depth * span / n, 0.01)
+            stale = (
+                time.monotonic() - marks[-1][0] > RETRY_WINDOW_STALE_S
+            )
+            if span > 0 and n > 0 and not stale:
+                return min(
+                    max(depth * span / n, 0.01), MAX_RETRY_AFTER_S
+                )
         return DEFAULT_RETRY_AFTER_S
 
     def retry_after_hint(self) -> float:
@@ -565,6 +585,37 @@ class RequestQueue:
         with self._lock:
             out, self._expired_log = self._expired_log, []
         return out
+
+    def expire_overdue(self, now: float | None = None) -> list[Ticket]:
+        """Expire pending tickets past their deadline with the
+        CALLER'S clock — the fleet router's single-writer wall-clock
+        authority (docs/SERVING.md "The fleet"): replica queues run
+        with `wall_slo` off, so no replica-local clock ever makes an
+        SLO decision; the router makes every one of them through this
+        hook before draining a replica. Returns the tickets after
+        terminally failing them; `take_expired` still feeds their
+        telemetry as usual."""
+        now = time.monotonic() if now is None else now
+        expired: list[Ticket] = []
+        with self._lock:
+            for lst in (self._front, self._pending):
+                keep: list[Ticket] = []
+                for t in lst:
+                    d = t.request.deadline_s
+                    if d is not None and now - t.submitted_mono >= d:
+                        expired.append(t)
+                    else:
+                        keep.append(t)
+                lst[:] = keep
+            self.expired += len(expired)
+            self._expired_log.extend(expired)
+        for t in expired:
+            t._terminal_fail(
+                "expired",
+                f"deadline-exceeded: pending {t.age_s(now):.2f}s > "
+                f"deadline_s {t.request.deadline_s} (router clock)",
+            )
+        return expired
 
     def next_ready_delay(self) -> float | None:
         """Seconds until the earliest backoff-parked ticket becomes

@@ -1,0 +1,602 @@
+"""The fleet router: N independent `SimulationService` replicas behind
+one front end (docs/SERVING.md "The fleet"; ROADMAP item 2).
+
+Routing policy — compile state is the scarce resource, so affinity IS
+the load-balancing policy:
+
+  1. SESSION affinity: a sessioned request sticks to the replica that
+     owns its session directory (resume reads replica-local state; a
+     resume that landed elsewhere would silently recompute from
+     scratch). Stickiness outranks the saturation bound.
+  2. PROGRAM-CLASS affinity: a bin's traffic sticks to the replica
+     that already compiled its program classes (`BinKey` → replica).
+     First route wins and is journaled; every later request of the
+     same bin follows it, so `compiles.steady_state == 0` holds PER
+     REPLICA — spreading a bin across replicas would compile it N
+     times and then recompile nowhere, which is worse than queueing.
+  3. SPILLOVER: when the affine replica is saturated (its depth at
+     the per-replica bound), non-sessioned traffic spills to the
+     least-loaded healthy replica with room — deterministically, in
+     (depth, id) order. When NO replica has room, the router rejects
+     fast with the MERGED retry-after hint (the minimum over healthy
+     replicas' throughput-derived hints: the earliest any of them
+     frees a slot).
+
+What the router NEVER does: hand a wall clock to a replica. Replica
+queues run `wall_slo = False`; deadline expiry is decided by the
+router's single clock (`RequestQueue.expire_overdue`) before each
+drain — the GL08 divergence class, lifted fleet-wide (two replicas
+disagreeing about "now" would terminate the same ticket twice, the
+exact double-terminal the journal invariant forbids).
+
+Every transition is journaled (serving/journal.py): submit at the
+front door, route (and re-route) decisions, and each ticket's ONE
+terminal state, harvested from replica queues at drain boundaries by
+the router — the single journal writer. A replica killed mid-traffic
+(the `replica-kill@step=K,rank=R` fault, a real SIGKILL, rc-75
+preemption, or a watchdog/heartbeat verdict) triggers replay-based
+reconciliation: the journal names every ticket whose LAST route hit
+the dead replica with no terminal, and the router re-routes exactly
+those. Side effects stay at-most-once because the only durable side
+effect a replica makes — a session step save — is guarded by the
+session layer's step manifests (a re-routed session resumes from the
+last VALID saved step; a torn save is invisible).
+
+`ElasticPolicy` is promoted to the fleet autoscaler: aggregate queue
+depth grows the fleet by whole replicas (`replica_factory` is the
+spawn), sustained idleness retires the highest-id replica (rc-75 is
+the clean drain signal an out-of-process replica would exit with).
+"""
+
+from __future__ import annotations
+
+import time
+
+from rocm_mpi_tpu.serving import bins as _bins
+from rocm_mpi_tpu.serving import journal as _journal
+from rocm_mpi_tpu.serving import slo as _slo
+from rocm_mpi_tpu.serving.queue import (
+    DEFAULT_RETRY_AFTER_S,
+    MAX_RETRY_AFTER_S,
+    TERMINAL_STATES,
+    Ticket,
+)
+
+DEFAULT_STALL_GRACE_S = 20.0
+
+
+class Replica:
+    """One fleet member: a `SimulationService` plus the router's view
+    of its health. `alive=False` — killed/retired (its queue state is
+    presumed lost; the journal is the record). `demoted=True` — up but
+    untrusted (progress-stalled): no new routes, pending re-routed."""
+
+    def __init__(self, rid: int, svc):
+        self.id = int(rid)
+        self.svc = svc
+        self.alive = True
+        self.demoted = False
+        self.retiring = False
+        self.verdict: str | None = None
+        # The replica queue never owns a wall clock (module docstring).
+        svc.queue.wall_slo = False
+
+    @property
+    def healthy(self) -> bool:
+        return self.alive and not self.demoted and not self.retiring
+
+    def depth(self) -> int:
+        return self.svc.queue.depth() if self.alive else 0
+
+    def row(self, steady_state: int) -> dict:
+        """The replica's fleet-report row. For an in-process fleet a
+        dead replica's counters are still readable (frozen at the
+        kill); a real SIGKILL loses them — which is why the MERGED
+        accounting comes from the journal, never from these rows."""
+        return {
+            "id": self.id,
+            "alive": self.alive,
+            "demoted": self.demoted,
+            "verdict": self.verdict,
+            "counters": self.svc.queue.counters(),
+            "retries": int(self.svc.retries_total),
+            "programs": len(self.svc._programs),
+            "bins": len(self.svc._stats),
+            "steady_state": int(steady_state),
+        }
+
+
+class _TicketRec:
+    __slots__ = ("request", "ticket", "replica", "journaled")
+
+    def __init__(self, request, ticket, replica):
+        self.request = request
+        self.ticket = ticket
+        self.replica = replica
+        self.journaled = False
+
+
+class FleetTicket:
+    """The caller's handle on a fleet submission. A re-route after a
+    replica kill REPLACES the underlying queue ticket (the dead
+    replica's ticket object died with its queue); this proxy always
+    follows the record's CURRENT ticket, so `state`/`result()` survive
+    reconciliation — the caller never learns their request moved."""
+
+    __slots__ = ("_rec",)
+
+    def __init__(self, rec: _TicketRec):
+        self._rec = rec
+
+    def __getattr__(self, name):
+        return getattr(self._rec.ticket, name)
+
+    def __repr__(self):
+        t = self._rec.ticket
+        return (f"FleetTicket({t.request.request_id!r}, "
+                f"state={t.state!r}, replica={self._rec.replica})")
+
+
+class FleetRouter:
+    """The front end (module docstring). `replica_factory(rid)` builds
+    one `SimulationService`; the router owns N of them, the ticket
+    journal, and every wall-clock decision."""
+
+    def __init__(self, replica_factory, n_replicas: int, *,
+                 journal: _journal.TicketJournal,
+                 max_depth_per_replica: int | None = None,
+                 policy=None, max_replicas: int | None = None,
+                 grow_queue_depth: int = 8, idle_retire_ticks: int = 3,
+                 heartbeat_dirs: dict | None = None,
+                 stall_grace_s: float = DEFAULT_STALL_GRACE_S):
+        if int(n_replicas) < 1:
+            raise ValueError(
+                f"n_replicas must be >= 1, got {n_replicas}"
+            )
+        self._factory = replica_factory
+        self.journal = journal
+        self.max_depth_per_replica = (
+            int(max_depth_per_replica)
+            if max_depth_per_replica is not None else None
+        )
+        self.policy = policy
+        self.max_replicas = (
+            int(max_replicas) if max_replicas is not None
+            else int(n_replicas)
+        )
+        self.grow_queue_depth = int(grow_queue_depth)
+        self.idle_retire_ticks = int(idle_retire_ticks)
+        self.heartbeat_dirs = dict(heartbeat_dirs or {})
+        self.stall_grace_s = float(stall_grace_s)
+        self.replicas: list[Replica] = []
+        self._affinity: dict[str, int] = {}   # bin key_str -> replica
+        self._sessions: dict[str, int] = {}   # session id -> replica
+        self._tickets: dict[str, _TicketRec] = {}
+        self._tick = 0
+        self._idle_ticks = 0
+        self._last_scale_tick: int | None = None
+        self._hb_progress: dict[int, tuple] = {}  # rid -> (key, mono)
+        self.router_rejected = 0
+        self.preempted = False
+        self.autoscale_events: list[dict] = []
+        for rid in range(int(n_replicas)):
+            self._spawn(rid)
+
+    # ---- fleet membership ----------------------------------------------
+
+    def _spawn(self, rid: int) -> Replica:
+        rep = Replica(rid, self._factory(rid))
+        self.replicas.append(rep)
+        return rep
+
+    def replica(self, rid: int) -> Replica:
+        for rep in self.replicas:
+            if rep.id == int(rid):
+                return rep
+        raise KeyError(f"no replica {rid}")
+
+    def healthy_replicas(self) -> list[Replica]:
+        return [r for r in self.replicas if r.healthy]
+
+    def fleet_depth(self) -> int:
+        return sum(r.depth() for r in self.healthy_replicas())
+
+    # ---- routing --------------------------------------------------------
+
+    def _bin_of(self, request) -> str | None:
+        try:
+            return _bins.bin_key(request).key_str()
+        except ValueError:
+            # The replica will fail the ticket at drain with the real
+            # diagnostic; routing just needs SOME deterministic target.
+            return None
+
+    def _least_loaded(self, exclude=()) -> Replica | None:
+        """Deterministic spill order: (depth, id) over the healthy
+        set — same trace, same health history => same choice."""
+        candidates = [
+            r for r in self.healthy_replicas() if r.id not in exclude
+        ]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda r: (r.depth(), r.id))
+
+    def retry_after_hint(self) -> float:
+        """The MERGED hint: the earliest any healthy replica expects a
+        slot to free — min over their throughput-derived hints,
+        bounded exactly like the single-queue hint."""
+        hints = [
+            r.svc.queue.retry_after_hint()
+            for r in self.healthy_replicas()
+        ]
+        if not hints:
+            return DEFAULT_RETRY_AFTER_S
+        return min(max(min(hints), 0.01), MAX_RETRY_AFTER_S)
+
+    def submit(self, request) -> FleetTicket:
+        """Route one request (module docstring policy). Always returns
+        a ticket; a fleet-wide saturation reject is a terminally
+        `rejected` ticket carrying the merged retry-after hint."""
+        rid_req = request.request_id
+        bkey = self._bin_of(request)
+        self.journal.record_submit(
+            rid_req, session=request.session, bin_key=bkey,
+        )
+        target = None
+        sticky = False
+        if request.session and request.session in self._sessions:
+            pin = self._sessions[request.session]
+            try:
+                rep = self.replica(pin)
+            except KeyError:
+                rep = None
+            if rep is not None and rep.healthy:
+                target, sticky = rep, True
+            else:
+                # The pinned replica is gone; the session's durable
+                # state (step manifests) is what makes the re-route
+                # at-most-once, not the pin.
+                del self._sessions[request.session]
+        if target is None and bkey is not None \
+                and bkey in self._affinity:
+            try:
+                rep = self.replica(self._affinity[bkey])
+            except KeyError:
+                rep = None
+            if rep is not None and rep.healthy:
+                target = rep
+            else:
+                del self._affinity[bkey]
+        if target is None:
+            target = self._least_loaded()
+        if target is None:
+            raise RuntimeError("no healthy replica in the fleet")
+        bound = self.max_depth_per_replica
+        if bound is not None and not sticky \
+                and target.depth() >= bound:
+            spill = None
+            for rep in sorted(self.healthy_replicas(),
+                              key=lambda r: (r.depth(), r.id)):
+                if rep.depth() < bound:
+                    spill = rep
+                    break
+            if spill is None:
+                hint = self.retry_after_hint()
+                self.router_rejected += 1
+                t = Ticket(request)
+                t._terminal_fail(
+                    "rejected",
+                    f"fleet-full (every replica at max_depth "
+                    f"{bound}); retry-after ~{hint:.2f}s",
+                )
+                self.journal.record_terminal(
+                    rid_req, "rejected", replica=None,
+                )
+                rec = _TicketRec(request, t, -1)
+                rec.journaled = True
+                self._tickets[rid_req] = rec
+                return FleetTicket(rec)
+            # Spillover deliberately does NOT move the bin affinity:
+            # the bin still prefers the replica holding its programs.
+            target = spill
+        ticket = target.svc.queue.submit(request)
+        self.journal.record_route(rid_req, target.id)
+        rec = _TicketRec(request, ticket, target.id)
+        self._tickets[rid_req] = rec
+        if bkey is not None and bkey not in self._affinity:
+            self._affinity[bkey] = target.id
+        if request.session:
+            self._sessions.setdefault(request.session, target.id)
+        return FleetTicket(rec)
+
+    def replica_map(self) -> dict[str, int]:
+        """The bin -> replica affinity table (test surface: same trace
+        => same map)."""
+        return dict(self._affinity)
+
+    # ---- failure, health, reconciliation --------------------------------
+
+    def kill_replica(self, rid: int, verdict: str = "killed") -> None:
+        """A replica died (SIGKILL / rc-75 / watchdog): mark it dead
+        and reconcile from the journal."""
+        rep = self.replica(rid)
+        rep.alive = False
+        rep.verdict = verdict
+        self._reconcile(rid)
+
+    def demote_replica(self, rid: int, verdict: str = "stalled") -> None:
+        """A replica is up but not progressing: no new routes, pending
+        re-routed. In-process the router simply stops draining it, so
+        a demoted replica can never race its re-routed tickets (the
+        router IS its drain loop)."""
+        rep = self.replica(rid)
+        rep.demoted = True
+        rep.verdict = verdict
+        self._reconcile(rid)
+
+    def _reconcile(self, rid: int) -> None:
+        """Replay the journal; every ticket whose LAST route hit `rid`
+        with no terminal is re-routed to a healthy replica. Pure
+        journal fold — running it again after the re-routes finds
+        nothing open on `rid` (the idempotence the drill pins)."""
+        for bkey in [k for k, v in self._affinity.items()
+                     if v == int(rid)]:
+            del self._affinity[bkey]
+        for sess in [k for k, v in self._sessions.items()
+                     if v == int(rid)]:
+            del self._sessions[sess]
+        state = _journal.replay(self.journal.segments())
+        for rid_req in state.open_on(rid):
+            rec = self._tickets.get(rid_req)
+            if rec is None:
+                continue
+            # A session's tickets move TOGETHER: the first re-route
+            # re-pins the session and the rest follow it — splitting
+            # one tenant's in-order work across replicas would race
+            # its own step manifests.
+            target = None
+            sess = rec.request.session
+            if sess and sess in self._sessions:
+                try:
+                    rep = self.replica(self._sessions[sess])
+                except KeyError:
+                    rep = None
+                if rep is not None and rep.healthy:
+                    target = rep
+            if target is None:
+                target = self._least_loaded(exclude=(int(rid),))
+            if target is None:
+                raise RuntimeError(
+                    "fleet exhausted: no healthy replica to re-route "
+                    f"{rid_req!r} to"
+                )
+            rec.ticket = target.svc.queue.submit(rec.request)
+            rec.replica = target.id
+            rec.journaled = False
+            self.journal.record_route(rid_req, target.id, reroute=True)
+            if rec.request.session:
+                self._sessions[rec.request.session] = target.id
+            bkey = self._bin_of(rec.request)
+            if bkey is not None and bkey not in self._affinity:
+                self._affinity[bkey] = target.id
+
+    def poll_health(self, now: float | None = None) -> None:
+        """Read the PR-5 heartbeat sidecars for replicas that have
+        them (`heartbeat_dirs[rid]`): a replica whose progress key has
+        not advanced within `stall_grace_s` while it still owes work
+        is demoted — the same stalled-vs-advancing signature the
+        launcher watchdog uses, read by the router's single clock."""
+        if not self.heartbeat_dirs:
+            return
+        from rocm_mpi_tpu.telemetry import health as _health
+
+        now = time.monotonic() if now is None else now
+        for rep in list(self.replicas):
+            if not rep.healthy:
+                continue
+            directory = self.heartbeat_dirs.get(rep.id)
+            if directory is None:
+                continue
+            beats, _skipped = _health.load_heartbeats(directory)
+            if not beats:
+                continue
+            key = tuple(
+                _health._progress_key(doc)
+                for _rank, doc in sorted(beats.items())
+            )
+            prev = self._hb_progress.get(rep.id)
+            if prev is None or prev[0] != key:
+                self._hb_progress[rep.id] = (key, now)
+                continue
+            if rep.depth() > 0 and now - prev[1] > self.stall_grace_s:
+                self.demote_replica(rep.id, verdict="progress-stalled")
+
+    # ---- the autoscaler (ElasticPolicy, promoted) -----------------------
+
+    def maybe_scale(self) -> bool:
+        """Whole-replica elasticity on AGGREGATE queue depth: grow
+        when the fleet backlog exceeds grow_queue_depth per live
+        replica (and the policy + replica budget agree), retire the
+        highest-id replica after sustained fleet idleness. rc-75 is
+        the clean drain signal a real retired replica exits with."""
+        policy = self.policy
+        if policy is None:
+            return False
+        live = self.healthy_replicas()
+        n_live = len(live)
+        depth = self.fleet_depth()
+        if depth >= self.grow_queue_depth * max(n_live, 1) \
+                and policy.wants_grow(
+                    n_live, self.max_replicas,
+                    step=self._tick,
+                    last_change_step=self._last_scale_tick,
+                ):
+            rid = max(r.id for r in self.replicas) + 1
+            self._spawn(rid)
+            self._last_scale_tick = self._tick
+            self.autoscale_events.append({
+                "event": "fleet.grow", "replica": rid,
+                "replicas": n_live + 1, "depth": depth,
+                "tick": self._tick,
+            })
+            return True
+        min_live = max(1, int(getattr(policy, "min_ranks", 1)))
+        if depth == 0 and self._idle_ticks >= self.idle_retire_ticks \
+                and n_live > min_live:
+            victim = max(live, key=lambda r: r.id)
+            victim.retiring = True
+            # Idle => its queue is empty; the journal proves it owes
+            # nothing (reconcile finds no open tickets).
+            self._reconcile(victim.id)
+            victim.alive = False
+            victim.verdict = "retired"
+            self._last_scale_tick = self._tick
+            self.autoscale_events.append({
+                "event": "fleet.retire", "replica": victim.id,
+                "replicas": n_live - 1, "signal": "rc-75",
+                "tick": self._tick,
+            })
+            return True
+        return False
+
+    # ---- the drive loop -------------------------------------------------
+
+    def _harvest(self, rep: Replica) -> None:
+        """Journal each ticket that reached a terminal state on `rep`
+        since the last harvest — the router is the single journal
+        writer, and a drain boundary is the only place terminals
+        appear (nothing is in flight between drains)."""
+        for rid_req, rec in self._tickets.items():
+            if rec.journaled or rec.replica != rep.id:
+                continue
+            state = rec.ticket.state
+            if state in TERMINAL_STATES:
+                self.journal.record_terminal(
+                    rid_req, state, replica=rep.id,
+                )
+                rec.journaled = True
+
+    def drive_once(self) -> int:
+        """One fleet tick: consume due replica faults, poll health,
+        autoscale, then expire-and-drain each healthy replica with the
+        router's clock and harvest its terminals. Returns requests
+        served this tick."""
+        from rocm_mpi_tpu import telemetry
+        from rocm_mpi_tpu.resilience import faults
+
+        self._tick += 1
+        for rep in list(self.replicas):
+            if not rep.alive:
+                continue
+            if faults.replica_fault("replica-kill", step=self._tick,
+                                    replica=rep.id):
+                self.kill_replica(rep.id, verdict="injected-kill")
+                continue
+            if faults.replica_fault("replica-stall", step=self._tick,
+                                    replica=rep.id):
+                self.demote_replica(rep.id, verdict="injected-stall")
+        self.poll_health()
+        self.maybe_scale()
+        served = 0
+        now = time.monotonic()
+        for rep in self.healthy_replicas():
+            # The single-writer clock: the ROUTER expires overdue
+            # tickets; the replica's pop never consults wall time.
+            rep.svc.queue.expire_overdue(now)
+            n, _preempted = rep.svc.drain_once()
+            served += n
+            self._harvest(rep)
+        depth = self.fleet_depth()
+        self._idle_ticks = self._idle_ticks + 1 if depth == 0 else 0
+        telemetry.gauge("fleet.replicas_live",
+                        float(len(self.healthy_replicas())))
+        telemetry.gauge("fleet.depth", float(depth))
+        telemetry.gauge(
+            "fleet.demoted",
+            float(sum(1 for r in self.replicas
+                      if r.alive and r.demoted)),
+        )
+        return served
+
+    def drive(self, max_ticks: int = 1000) -> int:
+        """Drain the fleet: tick until every healthy replica is empty
+        (or a preemption notice stops the loop at a tick boundary —
+        queued work stays queued and journaled, nothing is lost).
+        Returns total served."""
+        from rocm_mpi_tpu.resilience import preempt
+
+        served = 0
+        for _ in range(int(max_ticks)):
+            if preempt.requested():
+                self.preempted = True
+                break
+            served += self.drive_once()
+            if self.fleet_depth() == 0:
+                break
+            delays = [
+                d for d in (
+                    r.svc.queue.next_ready_delay()
+                    for r in self.healthy_replicas()
+                ) if d
+            ]
+            if delays:
+                time.sleep(min(min(delays), 0.25))
+        return served
+
+    # ---- accounting and the merged report -------------------------------
+
+    def journal_state(self) -> _journal.JournalState:
+        return _journal.replay(self.journal.segments())
+
+    def check_accounting(self) -> list[str]:
+        """THE fleet invariant at drain: every journaled ticket has
+        exactly one terminal state fleet-wide, and every LIVE
+        replica's own books balance. Dead replicas are exactly why
+        the journal — not their counters — is the source of truth."""
+        state = self.journal_state()
+        problems = _journal.exactly_one_terminal(state)
+        for rep in self.healthy_replicas():
+            problems += [
+                f"replica {rep.id}: {p}"
+                for p in rep.svc.queue.check_accounting(in_flight=0)
+            ]
+        return problems
+
+    def merged_counters(self) -> dict:
+        """Fleet-wide terminal counters, JOURNAL-derived (a killed
+        replica's queue counters died with it); retries are summed
+        from the replicas that are still readable."""
+        state = self.journal_state()
+        term = state.terminal_counts()
+        return {
+            "submitted": len(state.tickets),
+            "completed": term["done"],
+            "failed": term["failed"],
+            "rejected": term["rejected"],
+            "expired": term["expired"],
+            "quarantined": term["quarantined"],
+            "retries": sum(
+                int(r.svc.retries_total) for r in self.replicas
+            ),
+        }
+
+    def report_doc(self, stream_paths=()) -> dict:
+        """The merged fleet report (`rmt-fleet-report` v1): replica
+        rows, the journal-derived merged SLO block (latencies from the
+        telemetry streams when the run banked any), the journal
+        accounting block, and the autoscale trail."""
+        from rocm_mpi_tpu.telemetry import compiles
+
+        state = self.journal_state()
+        accounting_ok = not self.check_accounting()
+        steady = compiles.snapshot()["steady_recompiles"]
+        # In-process replicas share one compile tap; the per-replica
+        # steady number is the shared window's count (0 stays 0 for
+        # every replica — the pin the acceptance drill cares about).
+        rows = [rep.row(steady) for rep in self.replicas]
+        slo = _slo.slo_block(self.merged_counters(), stream_paths)
+        return _journal.fleet_report_doc(
+            rows, slo, state.counts(),
+            accounting_ok=accounting_ok,
+            autoscale=self.autoscale_events,
+        )

@@ -1504,6 +1504,12 @@ class SimulationService:
                 break
             if self.queue.depth() == 0:
                 break
+            # A preemption notice between drain passes (the remaining
+            # work is all backoff-parked) must stop the loop at this
+            # boundary — queued work stays queued, nothing requeues.
+            if self._preempt_requested():
+                report.preempted = True
+                break
             # Pending work may all be backoff-parked: wait out the
             # earliest retry eligibility instead of spinning.
             delay = self.queue.next_ready_delay()
@@ -1520,6 +1526,15 @@ class SimulationService:
         report = ServeReport()
         idle_since = None
         while True:
+            # A SIGTERM can land BETWEEN drain passes (the daemon is
+            # idle-polling, not mid-batch): notice it here, requeue
+            # nothing (nothing was popped), and exit rc 75 — without
+            # this check an idle daemon would poll straight through
+            # its preemption grace and die to the scheduler's SIGKILL
+            # with a clean-looking exit path.
+            if self._preempt_requested():
+                report.preempted = True
+                break
             self.maybe_resize()
             served, preempted = self.drain_once()
             report.served += served
@@ -1596,8 +1611,12 @@ class SimulationService:
         report = ServeReport()
         self._finish_report(report)
         # The manifest's lifetime view: everything this service has
-        # completed (report.served is per-drain-session).
+        # completed (report.served is per-drain-session), and whether
+        # a preemption notice is pending at banking time — the rc-75
+        # exit path banks the manifest, and a manifest that said
+        # preempted=False there would misreport the daemon's exit.
         report.served = self.queue.counters()["completed"]
+        report.preempted = self._preempt_requested()
         doc = report.manifest_doc(queue_counters=self.queue.counters())
         _bins.write_manifest(path, doc)
         return doc

@@ -457,6 +457,69 @@ def format_serve_status(status: dict | None) -> str | None:
     return f"serve idle ({tail})"
 
 
+def fleet_status(report: dict | None) -> dict | None:
+    """The fleet-plane view next to the SERVE badge (docs/SERVING.md
+    "The fleet"), computed from a merged fleet report
+    (serving/journal.py `rmt-fleet-report`): live/total replicas, the
+    journal-derived merged SLO counts, the re-route count, and the
+    accounting verdict. None when the doc isn't a fleet report."""
+    if not report or report.get("schema") != "rmt-fleet-report":
+        return None
+    replicas = report.get("replicas") or []
+    slo = report.get("slo") or {}
+    journal = report.get("journal") or {}
+    live = sum(
+        1 for r in replicas
+        if r.get("alive") and not r.get("demoted")
+    )
+    return {
+        "live": live,
+        "total": len(replicas),
+        "demoted": sum(
+            1 for r in replicas
+            if r.get("alive") and r.get("demoted")
+        ),
+        "depth": int(journal.get("open", 0) or 0),
+        "done": int(slo.get("done", 0) or 0),
+        "failed": int(slo.get("failed", 0) or 0),
+        "rejected": int(slo.get("rejected", 0) or 0),
+        "expired": int(slo.get("expired", 0) or 0),
+        "quarantined": int(slo.get("quarantined", 0) or 0),
+        "rerouted": int(journal.get("rerouted", 0) or 0),
+        "accounting_ok": bool(report.get("accounting_ok")),
+    }
+
+
+def format_fleet_status(status: dict | None) -> str | None:
+    """`[FLEET 2/3 up — depth=4, 17 done, 3 rerouted]` while the fleet
+    owes work; the quieter `fleet idle (3/3 up — 17 done)` once the
+    journal shows every ticket terminal. A broken accounting invariant
+    is the loudest thing on the line — a lost or double-terminal
+    ticket must not hide behind healthy-looking counts. None when
+    there is no fleet report."""
+    if not status:
+        return None
+    up = f"{status['live']}/{status['total']} up"
+    tail = f"{status['done']} done"
+    if status.get("failed"):
+        tail += f", {status['failed']} failed"
+    if status.get("expired"):
+        tail += f", {status['expired']} deadline-missed"
+    if status.get("quarantined"):
+        tail += f", {status['quarantined']} quarantined"
+    if status.get("rejected"):
+        tail += f", {status['rejected']} rejected"
+    if status.get("rerouted"):
+        tail += f", {status['rerouted']} rerouted"
+    if status.get("demoted"):
+        tail += f", {status['demoted']} demoted"
+    if not status.get("accounting_ok"):
+        tail += ", ACCOUNTING BROKEN"
+    if status["depth"]:
+        return f"[FLEET {up} — depth={status['depth']}, {tail}]"
+    return f"fleet idle ({up} — {tail})"
+
+
 def wire_status(directory) -> list[str]:
     """The run's active wire-precision mode(s) (docs/PERF.md "Wire
     precision"), annotation-sourced from the telemetry rank streams in

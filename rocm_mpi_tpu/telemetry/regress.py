@@ -182,6 +182,7 @@ def _classify_json(doc: dict) -> str | None:
     )
 
     from rocm_mpi_tpu.serving.bins import BIN_MANIFEST_SCHEMA
+    from rocm_mpi_tpu.serving.journal import FLEET_REPORT_SCHEMA
     from rocm_mpi_tpu.serving.slo import SOAK_SCHEMA
 
     named = {
@@ -193,6 +194,7 @@ def _classify_json(doc: dict) -> str | None:
         BASELINE_SCHEMA: "graftlint baseline",
         BIN_MANIFEST_SCHEMA: "serving bin manifest",
         SOAK_SCHEMA: "soak report",
+        FLEET_REPORT_SCHEMA: "fleet report",
     }
     if doc.get("schema") in named:
         return named[doc["schema"]]
@@ -240,6 +242,10 @@ def _validate_classified(doc: dict, kind: str) -> list[str]:
         from rocm_mpi_tpu.serving.slo import validate_soak_report
 
         return validate_soak_report(doc)
+    if kind == "fleet report":
+        from rocm_mpi_tpu.serving.journal import validate_fleet_report
+
+        return validate_fleet_report(doc)
     return []
 
 
@@ -254,6 +260,8 @@ _WIRE_MODES = ("f32", "bf16", "int8", "int8_delta")
 # tests/test_serving.py pins these spellings against serving.queue.
 _SERVE_REQUEST_SCHEMA = "rmt-serve-request"
 _QUARANTINE_SCHEMA = "rmt-serve-quarantine"
+# tests/test_fleet.py pins this spelling against serving.journal.
+_FLEET_JOURNAL_SCHEMA = "rmt-fleet-journal"
 
 
 def _validate_perf_budgets(doc: dict) -> list[str]:
@@ -412,6 +420,13 @@ def check_schema(paths) -> list[str]:
                     )
 
                     for p in validate_quarantine_record(doc):
+                        problems.append(f"{raw}:{i}: {p}")
+                elif doc.get("schema") == _FLEET_JOURNAL_SCHEMA:
+                    from rocm_mpi_tpu.serving.journal import (
+                        validate_journal_record,
+                    )
+
+                    for p in validate_journal_record(doc):
                         problems.append(f"{raw}:{i}: {p}")
                 elif doc.get("kind") == "event":
                     for p in _validate_event_record(doc):

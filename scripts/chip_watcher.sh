@@ -140,15 +140,32 @@ archive_telemetry() {
   # under docs/telemetry_r5/ where lint.sh's soak-report*/quarantine*/
   # serve-manifest* schema globs gate them.
   local s
+  # ... plus the fleet sidecars (docs/SERVING.md "The fleet"): the
+  # router's ticket journal and merged report from the soak's fleet
+  # episode — lint.sh's fleet-journal*/fleet-report* globs gate the
+  # copies. run_fleet_smoke's standalone pair is archived under
+  # distinct -smoke names below (same base names, different run).
   for s in output/soak/soak-report.json \
            output/soak/quarantine.jsonl \
            output/soak/serve-manifest-*.json \
            output/soak/gloo-serve/serve-manifest.json \
-           output/soak/gloo-serve/serve-requests.jsonl; do
+           output/soak/gloo-serve/serve-requests.jsonl \
+           output/soak/fleet-journal.jsonl \
+           output/soak/fleet-report.json; do
     [ -s "$s" ] || continue
     mkdir -p docs/telemetry_r5
     cp -p "$s" docs/telemetry_r5/ && found=$((found + 1))
   done
+  if [ -s output/fleet/fleet-journal.jsonl ]; then
+    mkdir -p docs/telemetry_r5
+    cp -p output/fleet/fleet-journal.jsonl \
+      docs/telemetry_r5/fleet-journal-smoke.jsonl && found=$((found + 1))
+  fi
+  if [ -s output/fleet/fleet-report.json ]; then
+    mkdir -p docs/telemetry_r5
+    cp -p output/fleet/fleet-report.json \
+      docs/telemetry_r5/fleet-report-smoke.json && found=$((found + 1))
+  fi
   local e ename
   for e in output/*/elastic.jsonl; do
     [ -s "$e" ] || continue
@@ -249,6 +266,20 @@ run_soak() {
     || echo "[watcher] soak rc=$? (continuing; report still archived)"
 }
 
+run_fleet_smoke() {
+  # The multi-replica fleet smoke (docs/SERVING.md "The fleet"): a
+  # bounded 2-replica apps/fleet.py run — router affinity, the durable
+  # ticket journal, and the merged report exercised on the real
+  # backend each healthy burst. Banks fleet-journal.jsonl +
+  # fleet-report.json under output/fleet (archive_telemetry copies
+  # them; lint.sh schema-gates the archived copies). Bounded so a
+  # wedged backend cannot eat the window.
+  echo "[watcher] fleet smoke (2 replicas, 12 synthetic requests)"
+  timeout -k 15 600 python apps/fleet.py --replicas 2 --synthetic 12 \
+    --out output/fleet \
+    || echo "[watcher] fleet rc=$? (continuing...)"
+}
+
 group_log() { echo "docs/tpu_tier_${1}_r5.txt"; }
 
 group_done() {
@@ -338,6 +369,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     run_tuning_search
     run_bench_suite
     run_soak
+    run_fleet_smoke
     run_tier_groups
     archive_telemetry
     if headline_done && [ "$queue_rc" -eq 0 ] && tier_done; then

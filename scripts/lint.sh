@@ -92,6 +92,17 @@ health_records+=(
   docs/telemetry_r*/quarantine*.jsonl
   docs/telemetry_r*/soak-report*.json
 )
+# Fleet sidecars (docs/SERVING.md "The fleet"): the router's durable
+# ticket journal and the merged fleet report apps/fleet.py banks. The
+# journal is the replay-reconciliation record — a drifted writer means
+# a replica kill can no longer be reconciled from disk; same stakes,
+# same gate.
+health_records+=(
+  output/*/fleet-journal*.jsonl
+  output/*/fleet-report*.json
+  docs/telemetry_r*/fleet-journal*.jsonl
+  docs/telemetry_r*/fleet-report*.json
+)
 # The graftlint artifacts: the findings document stage 1 just banked
 # (plus any chip_watcher-archived copies) and the committed baseline.
 # A drifted reporter or a hand-mangled baseline must fail HERE, not

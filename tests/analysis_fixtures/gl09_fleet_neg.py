@@ -1,0 +1,28 @@
+"""GL09 true negatives for the fleet sidecars (ISSUE 16): the two
+committed disciplines as the real writers spell them —
+serving/journal.TicketJournal (append-only JSONL segments) and
+serving/journal.write_fleet_report (tmp+rename).
+
+Never imported — parsed only (tests/test_analysis_rules.py).
+"""
+
+import json
+import os
+
+
+def append_journal_record(path, doc):
+    # Append-only: the ticket journal's discipline — a torn final line
+    # is droppable at replay, every complete line stays valid, nothing
+    # banked is ever rewritten (single writer: the router).
+    record = {"schema": "rmt-fleet-journal", "v": 1, **doc}
+    with open(path, "a") as fh:
+        fh.write(json.dumps(record) + "\n")
+
+
+def write_fleet_report_atomic(path, doc):
+    # tmp + os.replace: the reference shape (serving/journal.py).
+    record = {"schema": "rmt-fleet-report", "v": 1, **doc}
+    tmp = str(path) + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(record, fh)
+    os.replace(tmp, path)

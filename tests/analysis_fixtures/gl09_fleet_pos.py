@@ -1,0 +1,36 @@
+"""GL09 true positives for the fleet sidecars (ISSUE 16): the
+doctored in-place twins of the REAL journal and report writers
+(serving/journal.TicketJournal appends; write_fleet_report is
+tmp+rename — these twins drop the discipline and must fire).
+
+Never imported — parsed only (tests/test_analysis_rules.py).
+"""
+
+import json
+
+
+def write_journal_in_place(directory, records):
+    # The doctored twin of TicketJournal._append: REWRITES the whole
+    # ticket journal in "w" mode — the one artifact reconciliation
+    # replays after a replica kill, torn exactly when it matters.
+    path = f"{directory}/fleet-journal.jsonl"
+    with open(path, "w") as fh:  # GL09
+        for doc in records:
+            fh.write(json.dumps(doc) + "\n")
+
+
+def write_fleet_report_in_place(path, replicas, slo):
+    # The doctored twin of journal.write_fleet_report: dumps the merged
+    # report straight onto the final path — a mid-write flap leaves a
+    # torn accounting verdict.
+    doc = {"schema": "rmt-fleet-report", "v": 1, "replicas": replicas,
+           "slo": slo}
+    with open(path, "w") as fh:  # GL09
+        json.dump(doc, fh)
+
+
+def write_journal_by_name(directory, line):
+    # Even with an opaque payload, the path names the fleet family:
+    # evidence enough (write_text form).
+    target = directory / "fleet-journal.jsonl"
+    target.write_text(json.dumps(line))  # GL09

@@ -48,9 +48,55 @@ def trace(seed_tag: str):
     return reqs
 
 
+def fleet_main() -> int:
+    """The 2-replica fleet smoke, gloo-real: every rank mirrors the
+    SAME router (routing is a pure fold over the trace — no wall time,
+    no randomness), so the per-rank replica maps must come out
+    identical and the interleaved replica drains plan the same batched
+    collectives on every rank. The driving test compares the printed
+    map across ranks."""
+    import pathlib
+    import tempfile
+
+    from rocm_mpi_tpu.parallel.distributed import process_id
+    from rocm_mpi_tpu.serving import journal as fleet_journal
+    from rocm_mpi_tpu.serving.router import FleetRouter
+    from rocm_mpi_tpu.serving.service import ServeConfig, SimulationService
+
+    journal = fleet_journal.TicketJournal(
+        pathlib.Path(tempfile.mkdtemp(prefix="rmt-fleet-worker-"))
+        / "fleet-journal.jsonl"
+    )
+    router = FleetRouter(
+        lambda rid: SimulationService(config=ServeConfig(max_width=4)),
+        2, journal=journal,
+    )
+    tickets = [router.submit(r) for r in trace("fleet")]
+    router.drive()
+    problems = router.check_accounting()
+    assert not problems, problems
+    for t in tickets:
+        assert t.state == "done", (t.request.request_id, t.state,
+                                   t.error)
+    merged = router.merged_counters()
+    fmap = ",".join(
+        f"{k}->{v}" for k, v in sorted(router.replica_map().items())
+    )
+    journal.close()
+    print(
+        f"FLEET_WORKER_DONE rank={process_id()} "
+        f"done={merged['completed']} map={fmap}",
+        flush=True,
+    )
+    return 0
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
-    p.parse_args()
+    p.add_argument("--fleet", action="store_true",
+                   help="run the 2-replica in-process fleet smoke "
+                   "instead of the single-service drill")
+    args = p.parse_args()
 
     from rocm_mpi_tpu.parallel.distributed import (
         maybe_initialize_distributed,
@@ -65,6 +111,9 @@ def main() -> int:
     from rocm_mpi_tpu.telemetry import compiles
 
     compiles.install()
+
+    if args.fleet:
+        return fleet_main()
 
     from rocm_mpi_tpu.serving.service import ServeConfig, SimulationService
 

@@ -386,6 +386,32 @@ def test_gl09_serving_sidecar_twins():
         )
 
 
+def test_gl09_fleet_sidecar_twins():
+    """The fleet's sidecars (ISSUE 16): the REAL writers lint clean —
+    serving/journal.TicketJournal appends, write_fleet_report is
+    tmp+rename — while their doctored in-place twins fire
+    (payload-schema evidence for both, plus the fleet family name
+    alone as path evidence)."""
+    findings = [
+        f for f in lint_fixture("gl09_fleet_pos.py")
+        if f.rule == "GL09" and not f.suppressed
+    ]
+    assert len(findings) == 3, [(f.line, f.message) for f in findings]
+    neg = lint_fixture("gl09_fleet_neg.py")
+    assert "GL09" not in live_rules(neg), [
+        (f.line, f.message) for f in neg if f.rule == "GL09"
+    ]
+    repo = pathlib.Path(__file__).parent.parent
+    for mod in ("serving/journal.py", "serving/router.py"):
+        real = (repo / "rocm_mpi_tpu" / mod).read_text()
+        real_findings = lint_source(real, f"rocm_mpi_tpu/{mod}")
+        assert "GL09" not in live_rules(real_findings), (
+            mod,
+            [(f.line, f.message) for f in real_findings
+             if f.rule == "GL09"],
+        )
+
+
 def test_serving_fault_kinds_parse_and_consume():
     """The serving-plane fault grammar (docs/SERVING.md "SLOs and
     admission"): the four kinds parse with their triggers, serving

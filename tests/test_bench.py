@@ -297,3 +297,102 @@ def test_env_budget_malformed(monkeypatch, capsys):
     assert bench._env_budget() == 42.5
     monkeypatch.delenv("BENCH_BUDGET_S")
     assert bench._env_budget() == bench.DEFAULT_BUDGET_S
+
+
+# ---------------------------------------------------------------------------
+# Trajectory compare (ROADMAP item 5: bench.py --compare rN rM)
+# ---------------------------------------------------------------------------
+
+
+def _record(path, rows):
+    path.write_text(json.dumps({
+        "metrics": {
+            k: {"value": v, "direction": "higher"} for k, v in rows.items()
+        },
+    }))
+    return path
+
+
+def test_compare_resolves_record_specs():
+    bench = _import_bench()
+    root = str(REPO)
+    assert bench._resolve_record("r3") == os.path.join(
+        root, "BENCH_r03.json")
+    assert bench._resolve_record("r12") == os.path.join(
+        root, "BENCH_r12.json")
+    assert bench._resolve_record("7") == os.path.join(
+        root, "BENCH_r07.json")
+    # explicit paths pass through untouched (archived records)
+    assert bench._resolve_record("docs/x/BENCH_r01.json") == \
+        "docs/x/BENCH_r01.json"
+    with pytest.raises(ValueError, match="--compare operand"):
+        bench._resolve_record("rX")
+
+
+def test_compare_reports_deltas_and_gates_regressions(tmp_path, capsys):
+    """The trajectory report: per-key delta rows against the regress
+    tolerance semantics — exit 0 within tolerance, exit 1 when a rung
+    moved the wrong way, and dropped/new rungs named instead of
+    silently vanishing from the diff."""
+    bench = _import_bench()
+    base = _record(tmp_path / "BENCH_r01.json",
+                   {"suite.a.gpts": 10.0, "suite.b.gpts": 5.0,
+                    "suite.old.gpts": 1.0})
+    cur = _record(tmp_path / "BENCH_r02.json",
+                  {"suite.a.gpts": 10.5, "suite.b.gpts": 5.2,
+                   "suite.new.req_s": 7.0})
+    assert bench.compare_records(str(base), str(cur)) == 0
+    out = capsys.readouterr().out
+    assert "suite.a.gpts" in out and "+5.0%" in out
+    assert "dropped (baseline-only rung)" in out  # suite.old
+    assert "new (no baseline)" in out  # suite.new
+    assert "2 compared, 0 regressed, 1 dropped, 1 new" in out
+
+    # a higher-is-better rung falling past the tolerance gates exit 1
+    worse = _record(tmp_path / "BENCH_r03.json",
+                    {"suite.a.gpts": 10.0, "suite.b.gpts": 2.0})
+    assert bench.compare_records(str(base), str(worse)) == 1
+    assert "REGRESSED" in capsys.readouterr().out
+    # ... unless the caller widens the tolerance explicitly
+    assert bench.compare_records(str(base), str(worse),
+                                 tolerance=0.9) == 0
+    capsys.readouterr()
+
+
+def test_compare_rejects_unreadable_and_disjoint_inputs(tmp_path, capsys):
+    bench = _import_bench()
+    base = _record(tmp_path / "BENCH_r01.json", {"suite.a.gpts": 1.0})
+    assert bench.compare_records(
+        str(base), str(tmp_path / "missing.json")) == 2
+    assert "cannot read" in capsys.readouterr().err
+    other = _record(tmp_path / "BENCH_r04.json", {"suite.z.gpts": 1.0})
+    assert bench.compare_records(str(base), str(other)) == 2
+    assert "no shared metric keys" in capsys.readouterr().err
+
+
+def test_compare_cli_end_to_end(tmp_path):
+    """The CLI spelling the ROADMAP names: `bench.py --compare rN rM`
+    (explicit paths here — the repo root's numbered records are the
+    chip window's to bank) runs backend-free and fast."""
+    base = _record(tmp_path / "BENCH_r01.json", {"suite.a.gpts": 4.0})
+    cur = _record(tmp_path / "BENCH_r02.json", {"suite.a.gpts": 1.0})
+    ok = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"),
+         "--compare", str(cur), str(cur)],
+        capture_output=True, text=True, timeout=60, cwd=str(REPO),
+    )
+    assert ok.returncode == 0, ok.stderr
+    assert "0 regressed" in ok.stdout
+    bad = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"),
+         "--compare", str(base), str(cur)],
+        capture_output=True, text=True, timeout=60, cwd=str(REPO),
+    )
+    assert bad.returncode == 1, (bad.stdout, bad.stderr)
+    assert "REGRESSED" in bad.stdout
+    usage = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--compare", str(base)],
+        capture_output=True, text=True, timeout=60, cwd=str(REPO),
+    )
+    assert usage.returncode == 2
+    assert "usage" in usage.stderr

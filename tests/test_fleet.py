@@ -1,0 +1,524 @@
+"""The fleet (docs/SERVING.md "The fleet"; ISSUE 16).
+
+Covers the durable ticket journal (rmt-fleet-journal v1: record
+validation, segment sealing, torn-tail tolerance, replay idempotence,
+the exactly-one-terminal invariant), the merged fleet report
+(rmt-fleet-report v1: validator, atomic writer, regress recognition),
+the router policy (program-class affinity determinism, session
+stickiness, deterministic spillover under a saturated replica, the
+merged retry-after fast reject), the autoscaler (whole-replica
+grow/retire on aggregate depth), the FLEET badge, and THE acceptance
+drill: a 3-replica fleet with replica 1 killed mid-traffic via the
+fault grammar — every journaled ticket reaches exactly one terminal
+state fleet-wide, surviving tenants bitwise-equal to a standalone
+twin. The gloo-real 2-rank edition drives tests/serving_worker.py
+--fleet via spawn_ranks.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+from rocm_mpi_tpu.serving import journal as fjournal  # noqa: E402
+from rocm_mpi_tpu.serving.queue import Request  # noqa: E402
+
+
+def _req(rid, shape=(16, 16), nt=4, workload="diffusion", **kw):
+    return Request(request_id=rid, workload=workload,
+                   global_shape=shape, nt=nt, **kw)
+
+
+def _service(**cfg):
+    from rocm_mpi_tpu.serving.service import (
+        ServeConfig,
+        SimulationService,
+    )
+
+    cfg.setdefault("max_width", 2)
+    return SimulationService(config=ServeConfig(**cfg))
+
+
+def _router(tmp_path, n=3, name="fleet-journal.jsonl", **kw):
+    from rocm_mpi_tpu.serving.router import FleetRouter
+
+    journal = fjournal.TicketJournal(tmp_path / name)
+    return FleetRouter(lambda rid: _service(), n, journal=journal,
+                       **kw), journal
+
+
+def _mixed_trace(tag, n=9):
+    """Three bins over two shapes (same mix the soak fleet episode
+    paces): i % 3 == 0 is the (24, 24) class, the rest split (16, 16)
+    by step count."""
+    return [
+        _req(
+            f"{tag}-{i:02d}",
+            shape=(16, 16) if i % 3 else (24, 24),
+            nt=3 + (i % 3),
+            ic_scale=1.0 + 0.015 * i,
+        )
+        for i in range(n)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# The ticket journal
+# ---------------------------------------------------------------------------
+
+
+def test_journal_record_validation():
+    good = {"schema": fjournal.JOURNAL_SCHEMA,
+            "v": fjournal.JOURNAL_VERSION, "kind": "route",
+            "seq": 3, "request_id": "r1", "replica": 0}
+    assert fjournal.validate_journal_record(good) == []
+    assert fjournal.validate_journal_record({}) != []
+    bad_kind = dict(good, kind="nope")
+    assert any("kind" in p
+               for p in fjournal.validate_journal_record(bad_kind))
+    bad_state = {"schema": fjournal.JOURNAL_SCHEMA,
+                 "v": fjournal.JOURNAL_VERSION, "kind": "terminal",
+                 "seq": 4, "request_id": "r1", "state": "vaporized"}
+    assert any("state" in p
+               for p in fjournal.validate_journal_record(bad_state))
+    no_replica = dict(good, replica=None)
+    assert any("replica" in p
+               for p in fjournal.validate_journal_record(no_replica))
+
+
+def test_journal_append_replay_and_seq_resume(tmp_path):
+    path = tmp_path / "fleet-journal.jsonl"
+    j = fjournal.TicketJournal(path)
+    j.record_submit("a", bin_key="bin-a")
+    j.record_route("a", 0)
+    j.record_terminal("a", "done", replica=0)
+    j.record_submit("b", session="sess-b", bin_key="bin-b")
+    j.record_route("b", 1)
+    j.close()
+
+    state = fjournal.replay([path])
+    assert state.counts()["tickets"] == 2
+    assert state.counts()["terminal"]["done"] == 1
+    assert state.open_on(1) == ["b"]
+    assert state.open_on(0) == []
+    assert state.tickets["b"]["session"] == "sess-b"
+
+    # A reopened journal resumes the seq counter past what's on disk —
+    # single-writer monotonicity survives a router restart.
+    j2 = fjournal.TicketJournal(path)
+    j2.record_terminal("b", "done", replica=1)
+    j2.close()
+    docs = [json.loads(l) for l in path.read_text().splitlines()]
+    seqs = [d["seq"] for d in docs]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    assert fjournal.replay([path]).counts()["open"] == 0
+
+
+def test_journal_replay_is_idempotent_and_tolerates_torn_tail(tmp_path):
+    path = tmp_path / "fleet-journal.jsonl"
+    j = fjournal.TicketJournal(path)
+    for i in range(4):
+        j.record_submit(f"r{i}")
+        j.record_route(f"r{i}", i % 2)
+        j.record_terminal(f"r{i}", "done", replica=i % 2)
+    j.close()
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write('{"torn')  # the mid-write kill artifact
+
+    first = fjournal.replay([path])
+    again = fjournal.replay([path])
+    # Replaying a complete journal changes no counter: a pure fold.
+    assert first.counts() == again.counts()
+    assert first.counts()["torn_lines"] == 1
+    assert first.counts()["terminal"]["done"] == 4
+    assert fjournal.exactly_one_terminal(first) == []
+
+
+def test_journal_segments_seal_atomically(tmp_path):
+    path = tmp_path / "fleet-journal.jsonl"
+    j = fjournal.TicketJournal(path)
+    j.record_submit("a")
+    sealed = j.seal_segment()
+    assert sealed is not None and sealed.exists()
+    assert not list(tmp_path.glob("*.tmp"))
+    j.record_submit("b")
+    j.record_route("a", 0)
+    segs = j.segments()
+    assert segs[-1] == path and sealed in segs
+    state = fjournal.replay(segs)
+    assert state.counts()["tickets"] == 2
+    assert state.open_on(0) == ["a"]
+    # Sealing an empty live segment is a no-op.
+    j.seal_segment()
+    assert j.seal_segment() is None
+    j.close()
+
+
+def test_exactly_one_terminal_names_the_violations():
+    state = fjournal.JournalState()
+    mk = fjournal.JOURNAL_SCHEMA, fjournal.JOURNAL_VERSION
+
+    def rec(kind, seq, rid, **kw):
+        state.apply({"schema": mk[0], "v": mk[1], "kind": kind,
+                     "seq": seq, "request_id": rid, **kw})
+
+    rec("submit", 0, "lost")
+    rec("route", 1, "lost", replica=0)
+    rec("submit", 2, "double")
+    rec("route", 3, "double", replica=1)
+    rec("terminal", 4, "double", state="done", replica=1)
+    rec("terminal", 5, "double", state="expired", replica=1)
+    rec("terminal", 6, "ghost", state="done", replica=0)
+    problems = fjournal.exactly_one_terminal(state)
+    assert any("lost" in p and "no terminal" in p for p in problems)
+    assert any("double" in p and "2 terminal" in p for p in problems)
+    assert any("ghost" in p for p in problems)
+
+
+# ---------------------------------------------------------------------------
+# The merged fleet report
+# ---------------------------------------------------------------------------
+
+
+def _report_doc(**over):
+    slo = {"submitted": 2, "done": 2, "failed": 0, "rejected": 0,
+           "expired": 0, "quarantined": 0, "retries": 0}
+    counts = {"tickets": 2, "open": 0, "rerouted": 1, "torn_lines": 0,
+              "terminal": {"done": 2, "failed": 0, "rejected": 0,
+                           "expired": 0, "quarantined": 0}}
+    doc = fjournal.fleet_report_doc(
+        [{"id": 0, "alive": True, "steady_state": 0},
+         {"id": 1, "alive": False, "steady_state": 0}],
+        slo, counts, accounting_ok=True,
+        autoscale=[{"event": "fleet.grow", "replica": 2}],
+    )
+    doc.update(over)
+    return doc
+
+
+def test_fleet_report_roundtrip_and_gate(tmp_path):
+    doc = _report_doc()
+    assert fjournal.validate_fleet_report(doc) == []
+    path = tmp_path / "fleet-report.json"
+    fjournal.write_fleet_report(path, doc)
+    assert path.is_file() and not list(tmp_path.glob("*.tmp"))
+
+    from rocm_mpi_tpu.telemetry.regress import check_schema
+
+    assert check_schema([path]) == []
+
+    # Doctored docs fail the writer AND the regress gate.
+    bad = _report_doc(replicas=[])
+    assert fjournal.validate_fleet_report(bad) != []
+    with pytest.raises(ValueError):
+        fjournal.write_fleet_report(tmp_path / "never.json", bad)
+    bad2 = _report_doc()
+    del bad2["journal"]["terminal"]["expired"]
+    bad2_path = tmp_path / "bad-fleet-report.json"
+    bad2_path.write_text(json.dumps(bad2))
+    assert any("terminal" in p for p in check_schema([bad2_path]))
+
+
+def test_fleet_schema_spellings_pinned_against_regress():
+    """telemetry.regress spells the fleet journal marker locally
+    (stdlib read side) — drift from serving.journal must fail loudly;
+    the report schema is imported (journal.py is stdlib-at-import)."""
+    from rocm_mpi_tpu.telemetry import regress
+
+    assert regress._FLEET_JOURNAL_SCHEMA == fjournal.JOURNAL_SCHEMA
+    assert fjournal.FLEET_REPORT_SCHEMA == "rmt-fleet-report"
+    from rocm_mpi_tpu.serving.queue import TERMINAL_STATES
+
+    assert fjournal.TERMINAL_STATES == TERMINAL_STATES
+
+
+def test_fleet_journal_lines_pass_regress_check_schema(tmp_path):
+    path = tmp_path / "fleet-journal.jsonl"
+    j = fjournal.TicketJournal(path)
+    j.record_submit("a", session="s", bin_key="b")
+    j.record_route("a", 0)
+    j.record_terminal("a", "done", replica=0)
+    j.close()
+
+    from rocm_mpi_tpu.telemetry.regress import check_schema
+
+    assert check_schema([path]) == []
+    # A doctored line (bad terminal state) is caught per-line.
+    doc = json.loads(path.read_text().splitlines()[-1])
+    doc["state"] = "vaporized"
+    bad = tmp_path / "bad-fleet-journal.jsonl"
+    bad.write_text(json.dumps(doc) + "\n")
+    assert any("state" in p for p in check_schema([bad]))
+
+
+# ---------------------------------------------------------------------------
+# The FLEET badge
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_badge():
+    from rocm_mpi_tpu.telemetry import health
+
+    assert health.fleet_status(None) is None
+    assert health.fleet_status({"schema": "rmt-soak-report"}) is None
+    doc = _report_doc()
+    st = health.fleet_status(doc)
+    assert st["live"] == 1 and st["total"] == 2
+    assert st["done"] == 2 and st["rerouted"] == 1
+    line = health.format_fleet_status(st)
+    assert line == "fleet idle (1/2 up — 2 done, 1 rerouted)"
+    busy = dict(st, depth=3, accounting_ok=False)
+    line2 = health.format_fleet_status(busy)
+    assert line2.startswith("[FLEET 1/2 up — depth=3")
+    assert "ACCOUNTING BROKEN" in line2
+
+
+# ---------------------------------------------------------------------------
+# Router policy (no draining needed: routing is pre-drain state)
+# ---------------------------------------------------------------------------
+
+
+def test_affinity_determinism_same_trace_same_map(tmp_path):
+    ra, ja = _router(tmp_path / "a", n=3)
+    rb, jb = _router(tmp_path / "b", n=3)
+    trace = _mixed_trace("det", n=9)
+    for r in trace:
+        ra.submit(r)
+        rb.submit(r)
+    assert ra.replica_map() == rb.replica_map()
+    assert len(set(ra.replica_map().values())) == 3  # bins spread
+    # The journal's route trail agrees request-by-request.
+    routes_a = {k: v["routes"] for k, v in ra.journal_state().tickets.items()}
+    routes_b = {k: v["routes"] for k, v in rb.journal_state().tickets.items()}
+    assert routes_a == routes_b
+    ja.close(), jb.close()
+
+
+def test_spillover_ordering_under_saturated_replica(tmp_path):
+    router, journal = _router(tmp_path, n=3, max_depth_per_replica=2)
+    # Pin one bin to replica 0 and fill it to the bound.
+    t0 = router.submit(_req("sat-0", nt=3))
+    router.submit(_req("sat-1", nt=3, ic_scale=1.1))
+    (bkey, rid0), = router.replica_map().items()
+    assert router.replica(rid0).depth() == 2
+    # Same-bin overflow spills WITHOUT moving the affinity, in
+    # deterministic (depth, id) order over the replicas with room.
+    s1 = router.submit(_req("sat-2", nt=3, ic_scale=1.2))
+    s2 = router.submit(_req("sat-3", nt=3, ic_scale=1.3))
+    assert router.replica_map() == {bkey: rid0}
+    spill_rids = [router._tickets[t].replica for t in ("sat-2", "sat-3")]
+    others = sorted(r.id for r in router.replicas if r.id != rid0)
+    assert spill_rids == others, spill_rids
+    assert s1.state == "queued" and s2.state == "queued"
+    assert t0.state == "queued"
+    journal.close()
+
+
+def test_fleet_full_fast_reject_carries_merged_hint(tmp_path):
+    router, journal = _router(tmp_path, n=2, max_depth_per_replica=1)
+    router.submit(_req("full-0", nt=3))
+    router.submit(_req("full-1", nt=3, ic_scale=1.1))
+    assert all(r.depth() == 1 for r in router.replicas)
+    t = router.submit(_req("full-2", nt=3, ic_scale=1.2))
+    assert t.state == "rejected"
+    assert "fleet-full" in t.error and "retry-after" in t.error
+    assert router.router_rejected == 1
+    # The reject is journaled terminal — no lost ticket, and the hint
+    # is the bounded merged minimum.
+    state = router.journal_state()
+    assert state.tickets["full-2"]["terminals"] == [("rejected", None)]
+    from rocm_mpi_tpu.serving.queue import (
+        DEFAULT_RETRY_AFTER_S,
+        MAX_RETRY_AFTER_S,
+    )
+
+    hint = router.retry_after_hint()
+    assert 0.01 <= hint <= MAX_RETRY_AFTER_S
+    assert hint == DEFAULT_RETRY_AFTER_S  # no completions yet: default
+    journal.close()
+
+
+def test_session_affinity_sticks_and_survives_kill(tmp_path):
+    router, journal = _router(tmp_path, n=3)
+    t = router.submit(_req("sess-0", nt=3, session="tenant-a"))
+    pinned = router._tickets["sess-0"].replica
+    # Later sessioned traffic follows the pin even when other replicas
+    # are emptier.
+    router.submit(_req("other-0", nt=4, ic_scale=1.2))
+    t2 = router.submit(_req("sess-1", nt=3, ic_scale=1.1,
+                            session="tenant-a"))
+    assert router._tickets["sess-1"].replica == pinned
+    # Kill the pinned replica: the session unpins and its OPEN tickets
+    # re-route (step manifests make the replay at-most-once).
+    router.kill_replica(pinned, verdict="test-kill")
+    assert router._sessions["tenant-a"] != pinned
+    new_home = router._tickets["sess-0"].replica
+    assert new_home != pinned
+    assert router._tickets["sess-1"].replica == new_home
+    t3 = router.submit(_req("sess-2", nt=3, ic_scale=1.3,
+                            session="tenant-a"))
+    assert router._tickets["sess-2"].replica == new_home
+    assert t.state == t2.state == t3.state == "queued"
+    journal.close()
+
+
+def test_router_reconcile_is_idempotent(tmp_path):
+    router, journal = _router(tmp_path, n=3)
+    for r in _mixed_trace("rec", n=6):
+        router.submit(r)
+    before = {k: v.replica for k, v in router._tickets.items()}
+    victim = 1
+    router.kill_replica(victim, verdict="test")
+    moved = {k: v.replica for k, v in router._tickets.items()}
+    assert all(rid != victim for rid in moved.values())
+    assert any(before[k] == victim for k in before), "nothing to move?"
+    rerouted = router.journal_state().counts()["rerouted"]
+    assert rerouted >= 1
+    # A second reconcile of the same replica finds nothing open on it:
+    # the journal already shows every moved ticket's last route
+    # elsewhere.
+    router._reconcile(victim)
+    assert {k: v.replica for k, v in router._tickets.items()} == moved
+    assert router.journal_state().counts()["rerouted"] == rerouted
+    journal.close()
+
+
+# ---------------------------------------------------------------------------
+# The autoscaler
+# ---------------------------------------------------------------------------
+
+
+def test_autoscaler_grows_and_retires_whole_replicas(tmp_path):
+    from rocm_mpi_tpu.resilience.policy import ElasticPolicy
+
+    router, journal = _router(
+        tmp_path, n=1,
+        policy=ElasticPolicy(min_grow_interval_steps=0),
+        max_replicas=2, grow_queue_depth=2, idle_retire_ticks=2,
+    )
+    for i in range(4):
+        router.submit(_req(f"scale-{i}", nt=2, ic_scale=1.0 + 0.1 * i))
+    router._tick += 1
+    assert router.maybe_scale() is True
+    assert len(router.replicas) == 2
+    assert router.autoscale_events[0]["event"] == "fleet.grow"
+    # At the ceiling: no further grow.
+    router._tick += 1
+    assert router.maybe_scale() is False
+    router.drive()
+    # Sustained idleness retires the highest-id replica with the
+    # rc-75 drain signal stamped on the event.
+    for _ in range(4):
+        router.drive_once()
+        if len(router.healthy_replicas()) == 1:
+            break
+    retire = [e for e in router.autoscale_events
+              if e["event"] == "fleet.retire"]
+    assert retire and retire[0]["replica"] == 1
+    assert retire[0]["signal"] == "rc-75"
+    assert not router.replica(1).alive
+    assert router.check_accounting() == []
+    journal.close()
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance drill
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_kill_drill_three_replicas(tmp_path):
+    """THE ISSUE-16 acceptance: replica 1 of 3 killed mid-traffic via
+    the fault grammar — every journaled ticket reaches exactly one
+    terminal state fleet-wide, survivors' results bitwise-equal to a
+    standalone twin, merged report schema-valid, steady_state 0 per
+    replica."""
+    from rocm_mpi_tpu.resilience import faults
+    from rocm_mpi_tpu.telemetry import compiles
+
+    compiles.reset()
+    router, journal = _router(tmp_path, n=3)
+    faults.install("replica-kill@step=2,rank=1")
+    try:
+        reqs = _mixed_trace("drill", n=9)
+        tickets = []
+        for i in range(0, len(reqs), 3):
+            tickets += [router.submit(r) for r in reqs[i:i + 3]]
+            router.drive_once()
+        router.drive()
+    finally:
+        faults.install(None)
+
+    assert [r.id for r in router.replicas if not r.alive] == [1]
+    assert router.replica(1).verdict == "injected-kill"
+    assert router.check_accounting() == []
+    state = router.journal_state()
+    assert fjournal.exactly_one_terminal(state) == []
+    counts = state.counts()
+    assert counts["open"] == 0 and counts["rerouted"] >= 1
+
+    twin = _service()
+    twin_tickets = [twin.queue.submit(r) for r in _mixed_trace("drill", n=9)]
+    while twin.queue.depth():
+        twin.drain_once()
+    for t, ref in zip(tickets, twin_tickets):
+        assert t.state == "done", (t.request.request_id, t.error)
+        for a, b in zip(t.result(timeout=5), ref.result(timeout=5)):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    doc = router.report_doc()
+    assert fjournal.validate_fleet_report(doc) == []
+    assert doc["accounting_ok"] is True
+    for row in doc["replicas"]:
+        assert row["steady_state"] == 0, row
+    journal.close()
+
+
+def test_fleet_stall_demotion_reroutes(tmp_path):
+    """replica-stall demotes (up but untrusted): no new routes, its
+    pending tickets re-route, and the fleet still balances."""
+    from rocm_mpi_tpu.resilience import faults
+
+    router, journal = _router(tmp_path, n=2)
+    faults.install("replica-stall@step=1,rank=0")
+    try:
+        tickets = [router.submit(r) for r in _mixed_trace("stall", n=6)]
+        router.drive()
+    finally:
+        faults.install(None)
+    rep = router.replica(0)
+    assert rep.alive and rep.demoted
+    assert rep.verdict == "injected-stall"
+    assert router.check_accounting() == []
+    for t in tickets:
+        assert t.state == "done", (t.request.request_id, t.error)
+    assert all(
+        rec.replica == 1 for rec in router._tickets.values()
+    )
+    journal.close()
+
+
+def test_fleet_gloo_two_rank_smoke(tmp_path):
+    """Gloo-real 2-rank fleet smoke: every rank mirrors the same
+    2-replica router over the SAME trace and must print the identical
+    replica map (routing is a pure fold — the GL08 hazard class would
+    diverge the batched collectives otherwise)."""
+    from rocm_mpi_tpu.parallel.launcher import spawn_ranks
+
+    results = spawn_ranks(
+        [REPO / "tests" / "serving_worker.py", "--fleet"],
+        nprocs=2, timeout=420,
+    )
+    lines = []
+    for rank, (proc, (out, err)) in enumerate(results):
+        assert proc.returncode == 0, (rank, out[-500:], err[-2000:])
+        done = [l for l in out.splitlines() if "FLEET_WORKER_DONE" in l]
+        assert len(done) == 1, out
+        assert f"rank={rank}" in done[0]
+        assert "done=6" in done[0], done[0]
+        lines.append(done[0].split("map=", 1)[1])
+    assert lines[0] == lines[1], lines

@@ -19,7 +19,9 @@ tests/serving_worker.py via spawn_ranks.
 from __future__ import annotations
 
 import json
+import os
 import pathlib
+import signal
 import subprocess
 import sys
 import time
@@ -38,6 +40,9 @@ from rocm_mpi_tpu.models.wave import AcousticWave, WaveConfig  # noqa: E402
 from rocm_mpi_tpu.parallel import mesh as pmesh  # noqa: E402
 from rocm_mpi_tpu.serving import bins as sbins  # noqa: E402
 from rocm_mpi_tpu.serving.queue import (  # noqa: E402
+    DEFAULT_RETRY_AFTER_S,
+    MAX_RETRY_AFTER_S,
+    RETRY_WINDOW_STALE_S,
     Request,
     RequestQueue,
     load_trace,
@@ -685,6 +690,81 @@ def test_queue_full_rejects_fast_with_retry_after():
     del a, b
 
 
+def test_retry_after_hint_bounded_on_cold_start():
+    """Satellite: with ZERO (or one) completion marks the throughput
+    window is empty — a cold-start flood used to derive a degenerate
+    0/∞ hint from it. The hint must fall back to the bounded default,
+    never 0, never unbounded."""
+    q = RequestQueue()
+    for i in range(4):
+        q.submit(Request(request_id=f"cold{i}"))
+    assert q.retry_after_hint() == DEFAULT_RETRY_AFTER_S
+    # one mark is still not a window (span needs two endpoints)
+    q.note_completed(1)
+    assert q.retry_after_hint() == DEFAULT_RETRY_AFTER_S
+    # and the queue-full fast-reject path carries the same bounded hint
+    q2 = RequestQueue(max_depth=1)
+    q2.submit(Request(request_id="a"))
+    rej = q2.submit(Request(request_id="b"))
+    assert f"retry-after ~{DEFAULT_RETRY_AFTER_S:.2f}s" in rej.error
+
+
+def test_retry_after_hint_edges_are_clamped():
+    """Satellite: every derived-hint edge is pinned into
+    [0.01, MAX_RETRY_AFTER_S] — a slow window clamps at the cap, a
+    stale window (post-flood idle) and a same-instant burst (span 0)
+    fall back to the default, and a fast window never rounds to 0."""
+    now = time.monotonic()
+    q = RequestQueue()
+    for i in range(4):
+        q.submit(Request(request_id=f"e{i}"))
+    # slow window: 2 completions over 40 s, depth 4 -> 80 s derived,
+    # clamped to the cap (an honest "come back much later", bounded)
+    q._done_marks[:] = [(now - 50.0, 1), (now - 10.0, 1)]
+    assert q.retry_after_hint() == MAX_RETRY_AFTER_S
+    # stale window: the newest mark is past RETRY_WINDOW_STALE_S —
+    # extrapolating a dead window would be near-infinite; default wins
+    q._done_marks[:] = [
+        (now - RETRY_WINDOW_STALE_S - 40.0, 8),
+        (now - RETRY_WINDOW_STALE_S - 1.0, 8),
+    ]
+    assert q.retry_after_hint() == DEFAULT_RETRY_AFTER_S
+    # span 0: a same-instant completion burst has no rate; default wins
+    # (the old derivation divided by it)
+    q._done_marks[:] = [(now - 1.0, 3), (now - 1.0, 5)]
+    assert q.retry_after_hint() == DEFAULT_RETRY_AFTER_S
+    # fast window: huge throughput must floor at 0.01, never 0 — a 0
+    # hint invites an instant re-submit hammer
+    fast = RequestQueue()
+    fast.submit(Request(request_id="f0"))
+    fast._done_marks[:] = [(now - 2.0, 1000), (now - 1.0, 1000)]
+    assert fast.retry_after_hint() == 0.01
+
+
+def test_expire_overdue_uses_the_caller_clock():
+    """The fleet router's single-writer wall-clock hook: a replica
+    queue runs wall_slo=False (no local clock makes SLO decisions),
+    and expire_overdue(now=...) expires with the ROUTER's clock — no
+    sleeping, the caller just says what time it is."""
+    q = RequestQueue()
+    q.wall_slo = False
+    slow = q.submit(Request(request_id="slow", deadline_s=5.0))
+    fresh = q.submit(Request(request_id="fresh", deadline_s=3600.0))
+    expired = q.expire_overdue(now=slow.submitted_mono + 10.0)
+    assert [t.request.request_id for t in expired] == ["slow"]
+    assert slow.state == "expired" and "router clock" in slow.error
+    with pytest.raises(RuntimeError, match="deadline-exceeded"):
+        slow.result(timeout=5)
+    # wall_slo off: pop skips the local deadline check entirely — the
+    # fresh ticket serves, and nothing else expired behind our back
+    assert [t.request.request_id for t in q.pop_pending()] == ["fresh"]
+    c = q.counters()
+    assert c["expired"] == 1
+    assert [t.request.request_id for t in q.take_expired()] == ["slow"]
+    assert q.check_accounting(in_flight=1) == []
+    del fresh
+
+
 def test_requeue_preserves_original_relative_order():
     """Satellite: requeue-at-front is ORDER-PINNED by submission
     ordinal — a 3-ticket preemption requeue (and any sequence of
@@ -955,6 +1035,34 @@ def test_service_preemption_requeues_and_reports(monkeypatch):
     assert report.served == 1
     assert report.requeued == 2
     assert svc.queue.depth() == 2  # parked for the next service
+
+
+def test_serve_forever_notices_preempt_between_drains():
+    """Satellite: a preemption notice that lands while the daemon is
+    IDLE-POLLING (between drain passes, nothing popped) must stop the
+    loop immediately — requeue nothing, report preempted — instead of
+    polling straight through its grace window to the scheduler's
+    SIGKILL. Before the fix an idle daemon ignored the notice until
+    idle_exit_s elapsed and then reported preempted=False."""
+    from rocm_mpi_tpu.resilience import preempt
+
+    svc = SimulationService(config=ServeConfig(max_width=2))
+    warm = svc.run_trace([Request(
+        request_id="warm", workload="diffusion",
+        global_shape=(16, 16), dtype="f64", nt=2,
+    )])
+    assert warm.served == 1
+    preempt.request()
+    try:
+        t0 = time.monotonic()
+        report = svc.serve_forever(idle_exit_s=30.0)
+        elapsed = time.monotonic() - t0
+    finally:
+        preempt.reset()
+    assert report.preempted is True
+    assert report.served == 0 and report.requeued == 0
+    assert svc.queue.depth() == 0
+    assert elapsed < 5.0  # noticed at the loop top, not after idle_exit_s
 
 
 def test_service_elastic_grow_and_shrink():
@@ -1624,6 +1732,60 @@ def test_serve_app_50_request_acceptance(tmp_path):
     assert len(doc["programs"]) == widths
     shapes = {row["key"].split("|")[1] for row in doc["bins"]}
     assert len(shapes) >= 3
+
+    from rocm_mpi_tpu.telemetry.regress import check_schema
+
+    assert check_schema([out / "serve-manifest.json",
+                         out / "serve-requests.jsonl"]) == []
+
+
+def test_serve_daemon_sigterm_while_idle_exits_75(tmp_path):
+    """Satellite: THE missing daemon drill — apps/serve.py --serve
+    drains its trace, idles, and then a real SIGTERM lands BETWEEN
+    drain passes. The daemon must exit rc 75 promptly (not poll
+    through its grace window), requeue nothing (nothing was popped),
+    and still bank a schema-valid manifest on the way out."""
+    out = tmp_path / "out"
+    tele = tmp_path / "tele"
+    proc = subprocess.Popen(
+        [sys.executable, str(REPO / "apps" / "serve.py"),
+         "--serve", "--idle-exit-s", "300",
+         "--synthetic", "3", "--seed", "7", "--nt-max", "3",
+         "--max-width", "4", "--cpu-devices", "1",
+         "--telemetry", str(tele), "--out", str(out)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "RMT_PREEMPT_GRACE_S": "30"},
+    )
+    try:
+        # wait until the trace is fully drained (the daemon is now
+        # idle-polling) by watching the live telemetry stream
+        stream = tele / "telemetry-rank0.jsonl"
+        deadline = time.monotonic() + 300.0
+        while time.monotonic() < deadline:
+            done = 0
+            if stream.is_file():
+                done = stream.read_text(errors="replace").count(
+                    "serve.request.done")
+            if done >= 3:
+                break
+            assert proc.poll() is None, proc.communicate()
+            time.sleep(0.2)
+        else:
+            raise AssertionError("daemon never drained its trace")
+        proc.send_signal(signal.SIGTERM)
+        stdout, stderr = proc.communicate(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    assert proc.returncode == 75, (proc.returncode, stdout[-2000:],
+                                   stderr[-2000:])
+    assert "rc 75" in stdout
+    assert "0 requeued" in stdout  # idle notice: nothing was popped
+    doc = json.loads((out / "serve-manifest.json").read_text())
+    assert doc["preempted"] is True and doc["served"] == 3
 
     from rocm_mpi_tpu.telemetry.regress import check_schema
 

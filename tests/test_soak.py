@@ -176,11 +176,20 @@ def test_bounded_soak_acceptance(tmp_path):
 
     names = {ep["name"]: ep for ep in doc["episodes"]}
     assert set(names) == {"serve-chaos", "pipeline", "breaker",
-                          "storage", "evict", "gloo-serve", "gloo-kill"}
+                          "storage", "evict", "fleet", "gloo-serve",
+                          "gloo-kill"}
     # the pipeline episode proved overlap does not reorder accounting
     assert "bubble" in names["pipeline"], names["pipeline"]
+    # the fleet episode killed replica 1 mid-traffic and re-routed
+    assert names["fleet"]["killed"] == [1], names["fleet"]
+    assert names["fleet"]["rerouted"] >= 1, names["fleet"]
     assert all(ep["ok"] for ep in doc["episodes"]), doc["episodes"]
     assert doc["accounting_ok"] is True
+
+    # the fleet sidecars banked schema-valid (the merged report's
+    # inner structure is validated by check_schema's dispatch)
+    assert check_schema([out / "fleet-report.json",
+                         out / "fleet-journal.jsonl"]) == []
 
     # the SLO block is populated from REAL telemetry
     assert doc["slo"]["latency_s"]["n"] >= 8
@@ -191,10 +200,10 @@ def test_bounded_soak_acceptance(tmp_path):
     assert doc["slo"]["retries"] >= 1
     assert 0.0 < doc["slo"]["deadline_miss_rate"] < 1.0
 
-    # every plane actually composed
+    # every plane actually composed — the fleet plane included
     assert {"lane-nan", "batch-error", "slow-batch", "queue-flood",
             "io-error", "io-slow", "enospc", "sigterm",
-            "kill"} <= set(doc["fault_kinds"])
+            "kill", "replica-kill"} <= set(doc["fault_kinds"])
 
     # the poison ledger carries a reproducible full record
     from rocm_mpi_tpu.serving.queue import (
