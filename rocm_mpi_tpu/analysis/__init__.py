@@ -1,6 +1,6 @@
 """graftlint — whole-program shard-safety static analysis for this repo.
 
-Nine rule families, each grounded in a bug class this codebase has
+Ten rule families, each grounded in a bug class this codebase has
 actually shipped (rule catalog: docs/ANALYSIS.md):
 
     GL01 donation-safety        read-after-donate / async-save overlap
@@ -17,11 +17,17 @@ actually shipped (rule catalog: docs/ANALYSIS.md):
                                 program engine: analysis/engine.py)
     GL09 sidecar-atomicity      schema-versioned artifacts written without
                                 tmp+rename / append-only discipline
+    GL10 concurrency-discipline lock-guarded attrs accessed unlocked,
+                                *_locked without the lock, lock-order
+                                cycles, blocking under locks, serving
+                                clock/sidecar-writer ownership (whole-
+                                program engine: rules_concurrency.py)
 
 Run the gate:  python -m rocm_mpi_tpu.analysis rocm_mpi_tpu apps bench.py
 Suppress:      # graftlint: disable=GL01   (also disable-next=, disable-file=)
 Baseline:      --baseline / --baseline-write (analysis/baseline.json)
 Fast mode:     --changed (git-dirty files + import-graph neighbors)
+Audit:         --strict-suppressions (dead disable directives -> GL99)
 
 The AST side is paired with a ground-truth lowered-program audit
 (`python -m rocm_mpi_tpu.analysis.lowered`): it compiles the steady-state

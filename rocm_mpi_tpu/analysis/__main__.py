@@ -7,12 +7,13 @@ findings, 2 usage / missing path / unreadable baseline. Parse failures
 The repo gate (scripts/lint.sh) runs:
 
     python -m rocm_mpi_tpu.analysis rocm_mpi_tpu apps bench.py \
-        --baseline --output output/lint/findings.json
+        --baseline --strict-suppressions \
+        --output output/lint/findings.json
 
 which is the whole-program interprocedural pass (per-file rules + the
-GL08/GL01 engine), compared against the committed baseline, with the
-machine-readable findings artifact published atomically for
-chip_watcher to archive. `--changed` restricts the reported scope to
+GL08/GL10/GL01 engine), compared against the committed baseline, with
+the stale-suppression audit on and the machine-readable findings
+artifact published atomically for chip_watcher to archive. `--changed` restricts the reported scope to
 git-dirty files plus their import-graph neighbors — the fast dev loop.
 """
 
@@ -62,6 +63,12 @@ def main(argv=None) -> int:
                         help="fast mode: lint only git-dirty files plus "
                         "their import-graph neighbors (falls back to a "
                         "full run when git state is unavailable)")
+    parser.add_argument("--strict-suppressions", action="store_true",
+                        help="audit suppression directives: a "
+                        "`# graftlint: disable…` comment that covers no "
+                        "finding at all becomes a GL99 error (dead "
+                        "directives silently bless the next finding at "
+                        "that site)")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -110,6 +117,16 @@ def main(argv=None) -> int:
     except FileNotFoundError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+
+    if args.strict_suppressions:
+        # Audit against the FULL findings list (suppressed included) —
+        # a directive is stale only when it covers nothing at all.
+        # Runs before baseline handling so GL99 findings ride the
+        # reports and gate like any other error.
+        findings.extend(core.audit_suppressions(
+            args.paths, findings, restrict=restrict,
+        ))
+        findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
 
     if args.baseline_write is not None:
         baseline_mod.write_baseline(args.baseline_write, findings)

@@ -118,9 +118,13 @@ def all_rules() -> list[Rule]:
 def catalog_rules() -> list[Rule]:
     """Every rule family for reports and --list-rules: the per-file
     rules plus the interprocedural-only ones, GL-id order."""
+    from rocm_mpi_tpu.analysis.rules_concurrency import ConcurrencyRule
     from rocm_mpi_tpu.analysis.rules_divergence import DivergenceRule
 
-    return sorted(all_rules() + [DivergenceRule()], key=lambda r: r.id)
+    return sorted(
+        all_rules() + [DivergenceRule(), ConcurrencyRule()],
+        key=lambda r: r.id,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -434,6 +438,77 @@ def lint_paths(paths, select=None, restrict=None,
     findings = _dedupe(findings)
     findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
     return findings, scanned
+
+
+# ---------------------------------------------------------------------------
+# Stale-suppression audit (--strict-suppressions)
+# ---------------------------------------------------------------------------
+
+STALE_RULE = "GL99"  # pseudo-rule for directives that suppress nothing
+
+
+def _directive_is_live(directive: str, lineno: int, rules: set,
+                       file_findings: list) -> bool:
+    """Does this suppression directive cover at least one finding the
+    analyzer actually produced? (Suppressed findings are still in the
+    list — that is what makes this audit possible.)"""
+    def covers(f) -> bool:
+        return "ALL" in rules or f.rule in rules
+
+    if directive == "disable-file":
+        return any(covers(f) for f in file_findings)
+    target = lineno + 1 if directive == "disable-next" else lineno
+    return any(f.line == target and covers(f) for f in file_findings)
+
+
+def audit_suppressions(paths, findings, restrict=None) -> list[Finding]:
+    """One GL99 error per `# graftlint: disable…` directive under
+    `paths` that covers no finding at all (rule renamed, code moved,
+    fix landed): a dead directive is worse than none — it silently
+    blesses the NEXT finding at that site. `findings` is the full
+    (suppressed included) output of lint_paths over the same paths;
+    `restrict` mirrors lint_paths' --changed semantics."""
+    by_file: dict[str, list[Finding]] = {}
+    for f in findings:
+        by_file.setdefault(f.file, []).append(f)
+    out: list[Finding] = []
+    for path in iter_python_files(paths):
+        if restrict is not None:
+            resolved = Path(
+                os.path.normpath(os.path.abspath(path))
+            ).as_posix()
+            if resolved not in restrict:
+                continue
+        source, _, err = _read_source(path)
+        if err is not None:
+            continue
+        display = str(path)
+        file_findings = by_file.get(display, [])
+        for lineno, comment in _comment_tokens(source):
+            m = _SUPPRESS_RE.search(comment)
+            if not m:
+                continue
+            directive = m.group(1)
+            rules = {
+                r.strip().upper()
+                for r in m.group(2).split(",") if r.strip()
+            }
+            if _directive_is_live(directive, lineno, rules,
+                                  file_findings):
+                continue
+            listed = ",".join(sorted(rules))
+            out.append(Finding(
+                file=display, line=lineno, col=1,
+                rule=STALE_RULE, severity="error",
+                message=f"stale suppression: `# graftlint: "
+                        f"{directive}={listed}` covers no finding "
+                        f"(rule renamed, code moved, or the fix "
+                        f"landed) — a dead directive silently blesses "
+                        f"the next finding at this site",
+                hint="delete the directive; re-add it only with a live "
+                     "finding to point at",
+            ))
+    return out
 
 
 def gate_exit_code(findings) -> int:

@@ -148,6 +148,18 @@ class FunctionSummary:
     returns_rank: bool = False
     returns_file: bool = False
     donates_params: frozenset = frozenset()  # positions donated inside
+    # Concurrency effects (GL10, rules_concurrency.py): `self.<attr>`
+    # names this function acquires as context managers or via
+    # `.acquire()` — candidate lock acquisitions; the concurrency
+    # checker intersects them with the owning class's known lock
+    # attributes (a `with self._file:` here is harmless noise, never a
+    # finding by itself).
+    acquires_locks: frozenset = frozenset()
+    # Blocking operation tails this function may perform, its own plus
+    # its resolvable callees' (transitively, to the fixpoint): sleep /
+    # Event.wait / Ticket.result / block_until_ready / file I/O /
+    # subprocess. Consumed by GL10d (blocking-under-lock).
+    blocking: frozenset = frozenset()
 
 
 _EMPTY = FunctionSummary()
@@ -323,6 +335,38 @@ def _collective_tail(callee: str) -> str | None:
     return tail if tail in COLLECTIVE_TAILS else None
 
 
+# Call tails treated as blocking for GL10d (blocking-under-lock).
+# Deliberately narrow: "join" (str.join) and "run" (model.run) are
+# common non-blocking tails in this codebase and stay out; dotted
+# subprocess.* calls are caught by the head check below instead.
+BLOCKING_TAILS = frozenset({
+    "sleep", "wait", "result", "block_until_ready", "open",
+    "communicate", "check_call", "check_output", "Popen",
+})
+
+_SUBPROCESS_TAILS = frozenset({"run", "call", "check_call",
+                               "check_output", "Popen"})
+
+
+def blocking_tail(callee: str) -> str | None:
+    """The blocking-op tail for a callee name, or None."""
+    tail = astutil.tail_name(callee)
+    if tail in BLOCKING_TAILS:
+        return tail
+    head = callee.partition(".")[0]
+    if head == "subprocess" and tail in _SUBPROCESS_TAILS:
+        return f"subprocess.{tail}"
+    return None
+
+
+def _self_attr(node) -> str | None:
+    """`self.X` / `cls.X` -> "X" for a bare Attribute node."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id in ("self", "cls"):
+        return node.attr
+    return None
+
+
 def _source_order(node: ast.AST):
     """DFS pre-order = source order (ast.walk is breadth-first, which
     would scramble collective sequences and assign-before-return taint)."""
@@ -343,6 +387,8 @@ def _summarize(program: Program, mod: ModuleInfo,
     taint: dict[str, str] = {}
     returns_rank = False
     returns_file = False
+    acquires: set[str] = set()
+    blocking: set[str] = set()
 
     def expr_taint(node) -> str | None:
         return _expr_taint(program, mod, node, taint)
@@ -365,15 +411,27 @@ def _summarize(program: Program, mod: ModuleInfo,
     # shard_map/pallas local invoked right there — its collectives
     # belong to this function's execution).
     for node in nodes:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                attr = _self_attr(item.context_expr)
+                if attr is not None:
+                    acquires.add(attr)
         if isinstance(node, ast.Call):
             callee = astutil.call_name(node)
+            if callee.startswith(("self.", "cls.")):
+                parts = callee.split(".")
+                if len(parts) == 3 and parts[2] == "acquire":
+                    acquires.add(parts[1])
+            btail = blocking_tail(callee)
+            if btail is not None:
+                blocking.add(btail)
             tail = _collective_tail(callee)
             if tail is not None:
                 collectives.append(tail)
             else:
-                collectives.extend(
-                    program.summary_for_call(mod, callee).collectives
-                )
+                callee_summary = program.summary_for_call(mod, callee)
+                collectives.extend(callee_summary.collectives)
+                blocking |= callee_summary.blocking
             spec = program.donation_spec(mod, callee)
             if spec is not None:
                 nums, names = spec
@@ -398,6 +456,8 @@ def _summarize(program: Program, mod: ModuleInfo,
         returns_rank=returns_rank,
         returns_file=returns_file,
         donates_params=frozenset(donates),
+        acquires_locks=frozenset(acquires),
+        blocking=frozenset(blocking),
     )
 
 
@@ -756,9 +816,12 @@ def check_donation_interprocedural(rule, ctx: ModuleContext,
 
 
 def analyze_modules(modules: list[ModuleInfo], select=None) -> list:
-    """Whole-program findings (GL08 + interprocedural GL01) over the
-    given modules. Suppressions apply per module; findings come back
-    sorted like the per-file pass."""
+    """Whole-program findings (GL08 + interprocedural GL01 + GL10
+    concurrency) over the given modules. Suppressions apply per module;
+    findings come back sorted like the per-file pass."""
+    from rocm_mpi_tpu.analysis.rules_concurrency import (
+        ConcurrencyRule, check_concurrency,
+    )
     from rocm_mpi_tpu.analysis.rules_divergence import DivergenceRule
     from rocm_mpi_tpu.analysis.rules_donation import DonationSafetyRule
 
@@ -769,6 +832,7 @@ def analyze_modules(modules: list[ModuleInfo], select=None) -> list:
     findings = []
     gl08 = DivergenceRule()
     gl01 = DonationSafetyRule()
+    gl10 = ConcurrencyRule()
     for mod in program.modules.values():
         ctx = ModuleContext(
             path=mod.path, posix_path=mod.path, source=mod.source,
@@ -781,6 +845,8 @@ def analyze_modules(modules: list[ModuleInfo], select=None) -> list:
             batch.extend(
                 check_donation_interprocedural(gl01, ctx, program, mod)
             )
+        if wanted is None or gl10.id in wanted:
+            batch.extend(check_concurrency(gl10, ctx, program, mod))
         for f in batch:
             f.suppressed = mod.suppressions.covers(f)
             findings.append(f)

@@ -2,11 +2,12 @@
 versioned JSON document for tooling (tests/test_analysis_rules.py pins the
 schema; `telemetry regress --check-schema` recognizes the artifact).
 
-JSON schema (version 2 — v1 plus the schema marker, the interprocedural
-rules in counts, and per-finding/total baselined flags):
+JSON schema (version 3 — v2 plus the GL10 concurrency family's zero
+row in counts and possible GL99 stale-suppression rows from the
+--strict-suppressions audit):
 
     {"schema": "rmt-lint-findings",
-     "version": 2,
+     "version": 3,
      "files_scanned": int,
      "counts": {"GL01": int, ...},          # live (not suppressed, not
                                             # baselined), per rule
@@ -28,10 +29,12 @@ import json
 import os
 import pathlib
 
-from rocm_mpi_tpu.analysis.core import PARSE_RULE, Finding, catalog_rules
+from rocm_mpi_tpu.analysis.core import (
+    PARSE_RULE, STALE_RULE, Finding, catalog_rules,
+)
 
 FINDINGS_SCHEMA = "rmt-lint-findings"
-FINDINGS_VERSION = 2
+FINDINGS_VERSION = 3
 
 
 def counts_by_rule(findings) -> dict[str, int]:
@@ -137,6 +140,7 @@ def rule_table(findings) -> str:
     counts = counts_by_rule(findings)
     names = {r.id: r.name for r in catalog_rules()}
     names[PARSE_RULE] = "parse-warning"
+    names[STALE_RULE] = "stale-suppression"
     width = max(len(n) for n in names.values()) + 2
     lines = ["rule   " + "name".ljust(width) + "findings"]
     for rule_id in sorted(counts):

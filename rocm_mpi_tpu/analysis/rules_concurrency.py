@@ -1,0 +1,742 @@
+"""GL10 — concurrency-discipline (racecheck).
+
+PR 16 made the serving control plane a genuinely concurrent stdlib
+program: a threaded `RequestQueue`, the fleet router, the ticket
+journal — ~30 `with self._lock:` regions whose correctness rests on
+hand-enforced conventions none of GL01–GL09 can see. Two shipped bug
+classes prove the gap, and both were caught by review, not by the lint
+gate:
+
+* **PR 14 (N-writer quarantine append):** every rank appended to the
+  same quarantine.jsonl — N identical writers, interleave risk. The fix
+  was a rank-0 ownership guard; nothing policed it statically.
+* **PR 15 (busy-mark ordering):** the drain pipeline marked
+  `_inflight_n` busy BEFORE invoking the raising stage hook — one hook
+  exception and the bubble gauge read 1.0 forever. A lock held across a
+  raising call is the same shape with worse consequences: the lock
+  leaks and every thread wedges.
+
+Six facets, all flow-sensitive, the interprocedural ones riding the
+GL08 engine's summaries (extended with acquire/blocking effects):
+
+* **(a) guarded-attribute inference** — for a class owning a
+  `threading.Lock/RLock/Condition`, an attribute mutated under the lock
+  in ≥2 distinct regions is inferred lock-guarded; any read/write of it
+  outside a lock region (and outside `__init__`) fires.
+* **(b) the `*_locked` convention** — a `_retry_after_locked`-style
+  method called on a path where no class lock is held; plus the
+  explicit-acquire balance check: `self._lock.acquire()` with call
+  sites before the matching `release()` outside try/finally (the PR-15
+  shape — a raising call leaks the lock), or with no release at all.
+* **(c) lock-order cycles** — the per-class lock-acquisition graph
+  (direct `with` nesting plus self-call summaries); opposite
+  acquisition orders across methods deadlock. Re-acquiring a held
+  non-reentrant `Lock` is the degenerate cycle (self-deadlock);
+  `RLock` is exempt.
+* **(d) blocking-under-lock** — a call summarized as blocking
+  (`time.sleep`, `Event.wait`, `Ticket.result`, `block_until_ready`,
+  file I/O, `subprocess.*`) while a lock is held: every contending
+  thread stalls behind the I/O. `self._cond.wait()` on the HELD
+  Condition itself is the one blessed blocking call (that is what a
+  Condition is for).
+* **(e) single-clock-writer** — wall-clock reads (`time.time`,
+  `time.monotonic`) in `serving/*` outside the designated clock
+  chokepoints: the queue and router own the clock (the
+  `poll_health(now=None)` / `expire_overdue(now=None)` injection
+  seams); everyone else takes `now` as data. The `x if now is None
+  else now` injection idiom and direct dict-literal stamp values
+  (`{"t": time.time()}`) are exempt — those ARE the chokepoint shapes.
+* **(f) single-writer appenders** — an append-mode open of a
+  journal/quarantine/ticket sidecar path outside the owning writer
+  (an `append_*`/`*_append` function or a `*Journal/*Ledger/*Writer`
+  class). Promotes GL09's artifact regex into writer ownership: the
+  PR-14 bug was N owners, not a torn write.
+
+What never fires: module-level locks (no `self.` owner — out of scope
+by design), attributes mutated under the lock in only one region (one
+region is initialization discipline, not a guard contract), anything
+reached through a receiver the resolver cannot see (`t._mark(...)` —
+a miss is never a false positive), and `*_locked` methods themselves
+(they hold the lock by contract; facet (b) polices their callers).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from rocm_mpi_tpu.analysis import astutil, engine
+from rocm_mpi_tpu.analysis.core import ModuleContext, Rule
+from rocm_mpi_tpu.analysis.rules_sidecar import (
+    _chase, _literal_strings, _open_mode,
+)
+
+_LOCK_CTOR_TAILS = ("Lock", "RLock", "Condition")
+
+# list/set/dict mutations through a method call on a self attribute —
+# these are writes for guarded-attribute inference (self._front.sort()
+# mutates _front as surely as assignment does).
+_MUTATOR_TAILS = frozenset({
+    "append", "extend", "insert", "remove", "pop", "popleft", "clear",
+    "sort", "reverse", "add", "discard", "update", "setdefault",
+    "popitem", "appendleft",
+})
+
+# GL10e scope and owners. The substring (not prefix) match works for
+# both the relative gate invocation and absolute test paths.
+_SERVING_MARK = "rocm_mpi_tpu/serving/"
+_CLOCK_OWNER_FILES = (
+    "rocm_mpi_tpu/serving/queue.py",
+    "rocm_mpi_tpu/serving/router.py",
+)
+_CLOCK_TAILS = frozenset({"time", "monotonic", "time_ns", "monotonic_ns"})
+
+# GL10f: the single-writer sidecar families and their owner spellings.
+_WRITER_PATH_RE = re.compile(r"(quarantine|journal|ticket)[-\w.]*\.jsonl\b")
+_WRITER_CLASS_RE = re.compile(r"(Journal|Ledger|Writer)")
+
+
+def _is_none_test(node: ast.AST) -> bool:
+    """`x is None` / `x is not None` (the injectable-clock idiom test)."""
+    if not isinstance(node, ast.Compare) or len(node.ops) != 1:
+        return False
+    if not isinstance(node.ops[0], (ast.Is, ast.IsNot)):
+        return False
+    sides = [node.left] + node.comparators
+    return any(
+        isinstance(s, ast.Constant) and s.value is None for s in sides
+    )
+
+
+def _lock_ctor_kind(value: ast.AST, imports) -> str | None:
+    """"Lock"/"RLock"/"Condition" when `value` is a threading lock
+    constructor call under the module's import table, else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    callee = astutil.call_name(value)
+    head, _, tail = callee.rpartition(".")
+    if head:
+        if imports.module_aliases.get(head) == "threading" \
+                and tail in _LOCK_CTOR_TAILS:
+            return tail
+        return None
+    origin = imports.from_imports.get(callee)
+    if origin and origin.startswith("threading."):
+        kind = origin.rpartition(".")[2]
+        return kind if kind in _LOCK_CTOR_TAILS else None
+    return None
+
+
+class _ClassInfo:
+    """One class's lock attrs and direct methods."""
+
+    def __init__(self, node: ast.ClassDef, imports):
+        self.node = node
+        self.methods = {
+            n.name: n for n in node.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        self.locks: dict[str, str] = {}  # attr -> Lock/RLock/Condition
+        for fn in self.methods.values():
+            for st in astutil.walk_no_nested_functions(fn):
+                if isinstance(st, ast.Assign) and len(st.targets) == 1:
+                    attr = engine._self_attr(st.targets[0])
+                    if attr is None:
+                        continue
+                    kind = _lock_ctor_kind(st.value, imports)
+                    if kind is not None:
+                        self.locks[attr] = kind
+
+
+def _target_attrs(target: ast.AST):
+    """(node, attr) for every `self.Y`-rooted store in an assign target
+    (tuple unpack, starred, and `self.Y[k] = ...` included)."""
+    if isinstance(target, ast.Attribute):
+        attr = engine._self_attr(target)
+        if attr is not None:
+            yield target, attr
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            yield from _target_attrs(elt)
+    elif isinstance(target, ast.Starred):
+        yield from _target_attrs(target.value)
+    elif isinstance(target, ast.Subscript):
+        attr = engine._self_attr(target.value)
+        if attr is not None:
+            yield target, attr
+
+
+def _expr_walk(node: ast.AST):
+    """ast.walk minus deferred scopes (lambdas, nested defs): their
+    bodies do not execute at this program point."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.Lambda, ast.FunctionDef,
+                          ast.AsyncFunctionDef)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+class _MethodScan:
+    """One method's lock-flow events: attribute accesses, calls, and
+    lock acquisitions, each tagged with the set of class locks held at
+    that program point (held = {lock attr: region id})."""
+
+    def __init__(self, cls: _ClassInfo, fn: ast.FunctionDef):
+        self.cls = cls
+        self.fn = fn
+        self.attr_events: list = []     # (node, attr, is_write, held)
+        self.call_events: list = []     # (node, callee str, held)
+        self.acquire_events: list = []  # (node, lock attr, held-before)
+        self.balance: list = []         # (node, message) — facet (b2)
+        held: dict[str, object] = {}
+        if fn.name.endswith("_locked"):
+            # The convention IS the contract: the caller holds the lock.
+            held = {lock: id(fn) for lock in cls.locks}
+        self._stmts(fn.body, held)
+
+    # -- statement walk ---------------------------------------------------
+
+    def _stmts(self, body: list, held: dict) -> None:
+        held = dict(held)
+        for idx, st in enumerate(body):
+            got = self._lock_method_stmt(st, "acquire")
+            if got is not None:
+                attr, call = got
+                self.acquire_events.append((call, attr, dict(held)))
+                self._check_balance(body, idx, attr, call)
+                held[attr] = id(call)
+                continue
+            got = self._lock_method_stmt(st, "release")
+            if got is not None:
+                held.pop(got[0], None)
+                continue
+            self._stmt(st, held)
+
+    def _stmt(self, st: ast.AST, held: dict) -> None:
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            inner = dict(held)
+            for item in st.items:
+                attr = engine._self_attr(item.context_expr)
+                if attr is not None and attr in self.cls.locks:
+                    self.acquire_events.append((st, attr, dict(inner)))
+                    inner[attr] = id(st)
+                else:
+                    self._expr(item.context_expr, held)
+            self._stmts(st.body, inner)
+        elif isinstance(st, (ast.If, ast.While)):
+            self._expr(st.test, held)
+            self._stmts(st.body, held)
+            self._stmts(st.orelse, held)
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            self._expr(st.iter, held)
+            for node, attr in _target_attrs(st.target):
+                self._attr(node, attr, True, held)
+            self._stmts(st.body, held)
+            self._stmts(st.orelse, held)
+        elif isinstance(st, (ast.Try, getattr(ast, "TryStar", ast.Try))):
+            self._stmts(st.body, held)
+            for handler in st.handlers:
+                self._stmts(handler.body, held)
+            self._stmts(st.orelse, held)
+            self._stmts(st.finalbody, held)
+        elif isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = st.targets if isinstance(st, ast.Assign) \
+                else [st.target]
+            for t in targets:
+                for node, attr in _target_attrs(t):
+                    self._attr(node, attr, True, held)
+                    if isinstance(st, ast.AugAssign):
+                        self._attr(node, attr, False, held)
+                # subscript keys and chained receivers still read
+                if isinstance(t, ast.Subscript):
+                    self._expr(t.slice, held)
+            if getattr(st, "value", None) is not None:
+                self._expr(st.value, held)
+        elif isinstance(st, ast.Delete):
+            for t in st.targets:
+                for node, attr in _target_attrs(t):
+                    self._attr(node, attr, True, held)
+                if isinstance(t, ast.Subscript):
+                    self._expr(t.slice, held)
+        elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # deferred scope
+        else:
+            for child in ast.iter_child_nodes(st):
+                if isinstance(child, ast.expr):
+                    self._expr(child, held)
+                elif isinstance(child, ast.stmt):
+                    self._stmt(child, held)
+
+    # -- expression walk --------------------------------------------------
+
+    def _expr(self, node: ast.AST, held: dict) -> None:
+        if node is None:
+            return
+        for n in _expr_walk(node):
+            if isinstance(n, ast.Attribute):
+                attr = engine._self_attr(n)
+                if attr is not None:
+                    self._attr(n, attr, False, held)
+            elif isinstance(n, ast.Call):
+                callee = astutil.call_name(n)
+                self.call_events.append((n, callee, dict(held)))
+                # self.Y.append(...) mutates Y
+                parts = callee.split(".")
+                if len(parts) == 3 and parts[0] in ("self", "cls") \
+                        and parts[2] in _MUTATOR_TAILS:
+                    self._attr(n, parts[1], True, held)
+
+    def _attr(self, node, attr: str, is_write: bool, held: dict) -> None:
+        if attr in self.cls.locks:
+            return  # the locks themselves are accessed unlocked by design
+        self.attr_events.append((node, attr, is_write, dict(held)))
+
+    # -- explicit acquire/release (facet b2) ------------------------------
+
+    def _lock_method_stmt(self, st, which: str):
+        """`self.X.acquire()` / `.release()` as a bare statement, X a
+        class lock -> (X, call node)."""
+        if not isinstance(st, ast.Expr) or not isinstance(
+            st.value, ast.Call
+        ):
+            return None
+        callee = astutil.call_name(st.value)
+        parts = callee.split(".")
+        if len(parts) == 3 and parts[0] in ("self", "cls") \
+                and parts[2] == which and parts[1] in self.cls.locks:
+            return parts[1], st.value
+        return None
+
+    def _release_in_finally(self, st, attr: str) -> bool:
+        if not isinstance(st, ast.Try):
+            return False
+        for node in ast.walk(ast.Module(body=st.finalbody,
+                                        type_ignores=[])):
+            if isinstance(node, ast.Call):
+                parts = astutil.call_name(node).split(".")
+                if len(parts) == 3 and parts[0] in ("self", "cls") \
+                        and parts[1] == attr and parts[2] == "release":
+                    return True
+        return False
+
+    def _check_balance(self, body, idx: int, attr: str, call) -> None:
+        rest = body[idx + 1:]
+        release_at = None
+        for j, st in enumerate(rest):
+            if self._release_in_finally(st, attr):
+                return  # acquire; try: ... finally: release — disciplined
+            got = self._lock_method_stmt(st, "release")
+            if got is not None and got[0] == attr:
+                release_at = j
+                break
+        if release_at is None:
+            self.balance.append((call, (
+                f"`self.{attr}.acquire()` is never released on this "
+                f"path — any exception (or plain fallthrough) leaks the "
+                f"lock and wedges every other thread"
+            )))
+            return
+        between = rest[:release_at]
+        for st in between:
+            for node in ast.walk(st):
+                if isinstance(node, ast.Call):
+                    self.balance.append((call, (
+                        f"call site between `self.{attr}.acquire()` and "
+                        f"its release outside try/finally — a raising "
+                        f"call leaks the lock (the PR-15 busy-mark-"
+                        f"before-hook bug shape)"
+                    )))
+                    return
+
+
+# ---------------------------------------------------------------------------
+# Facets a-d: per lock-owning class
+# ---------------------------------------------------------------------------
+
+
+def _class_call_summary(program, mod, cls: _ClassInfo, callee: str):
+    """The engine summary for a call, identity-checked for self-calls
+    (the module-wide bare-name index is last-wins; another class's
+    same-named method must contribute no facts)."""
+    if callee.startswith(("self.", "cls.")):
+        parts = callee.split(".")
+        if len(parts) == 2 and parts[1] in cls.methods \
+                and mod.functions.get(parts[1]) is cls.methods[parts[1]]:
+            return program.summary_for_call(mod, callee)
+        return None
+    if "." not in callee:
+        return program.summary_for_call(mod, callee)
+    return None
+
+
+def _check_class(rule, ctx, program, mod, cls: _ClassInfo) -> list:
+    findings = []
+    scans = {name: _MethodScan(cls, fn)
+             for name, fn in cls.methods.items()}
+
+    # -- (a) guarded-attribute inference ---------------------------------
+    regions: dict = {}  # attr -> lock -> set(region ids)
+    for name, scan in scans.items():
+        if name == "__init__":
+            continue
+        for _node, attr, is_write, held in scan.attr_events:
+            if not is_write:
+                continue
+            for lock, region in held.items():
+                regions.setdefault(attr, {}).setdefault(
+                    lock, set()
+                ).add(region)
+    guarded: dict[str, list] = {}  # attr -> owner locks
+    for attr, by_lock in regions.items():
+        owners = [lock for lock, regs in by_lock.items()
+                  if len(regs) >= 2]
+        if owners:
+            guarded[attr] = owners
+
+    seen_a = set()
+    for name, scan in scans.items():
+        if name == "__init__":
+            continue
+        for node, attr, is_write, held in scan.attr_events:
+            owners = guarded.get(attr)
+            if not owners or any(lock in held for lock in owners):
+                continue
+            key = (node.lineno, attr)
+            if key in seen_a:
+                continue
+            seen_a.add(key)
+            lock = owners[0]
+            n_regions = len(regions[attr][lock])
+            verb = "written" if is_write else "read"
+            findings.append(ctx.finding(
+                node, rule,
+                f"`self.{attr}` is lock-guarded (mutated under "
+                f"`self.{lock}` in {n_regions} regions of "
+                f"{cls.node.name}) but {verb} here without the lock",
+                f"take `with self.{lock}:` around the access, or make "
+                f"this a `*_locked` method and hold the lock at every "
+                f"call site",
+            ))
+
+    # -- (b1) *_locked called without the lock ---------------------------
+    for name, scan in scans.items():
+        if name == "__init__" or name.endswith("_locked"):
+            continue
+        for node, callee, held in scan.call_events:
+            parts = callee.split(".")
+            if len(parts) != 2 or parts[0] not in ("self", "cls") \
+                    or not parts[1].endswith("_locked"):
+                continue
+            if held:
+                continue
+            findings.append(ctx.finding(
+                node, rule,
+                f"`self.{parts[1]}()` follows the *_locked convention "
+                f"but no {cls.node.name} lock is held on this path",
+                f"call it inside `with self.{next(iter(cls.locks))}:`, "
+                f"or rename the helper if it genuinely needs no lock",
+            ))
+
+    # -- (b2) explicit acquire/release balance ---------------------------
+    for scan in scans.values():
+        for node, message in scan.balance:
+            findings.append(ctx.finding(
+                node, rule, message,
+                "prefer `with self.<lock>:`; if acquire/release must be "
+                "explicit, release in a `finally:`",
+            ))
+
+    # -- (c) lock-order graph + self-deadlock ----------------------------
+    graph: dict[str, dict] = {}  # lock -> {lock: witness node}
+    for scan in scans.values():
+        for node, attr, held in scan.acquire_events:
+            for h in held:
+                if h == attr:
+                    if cls.locks[attr] != "RLock":
+                        findings.append(ctx.finding(
+                            node, rule,
+                            f"re-acquires non-reentrant `self.{attr}` "
+                            f"already held on this path — "
+                            f"self-deadlock",
+                            f"make `self.{attr}` an RLock or restructure "
+                            f"so the lock is taken once",
+                        ))
+                else:
+                    graph.setdefault(h, {}).setdefault(attr, node)
+        for node, callee, held in scan.call_events:
+            if not held:
+                continue
+            summary = _class_call_summary(program, mod, cls, callee)
+            if summary is None:
+                continue
+            for l2 in sorted(summary.acquires_locks & set(cls.locks)):
+                if l2 in held:
+                    if cls.locks[l2] != "RLock":
+                        findings.append(ctx.finding(
+                            node, rule,
+                            f"`{callee}` re-acquires non-reentrant "
+                            f"`self.{l2}` already held here — "
+                            f"self-deadlock",
+                            f"make `self.{l2}` an RLock, or split a "
+                            f"`*_locked` variant that assumes the lock",
+                        ))
+                    continue
+                for h in held:
+                    if h != l2:
+                        graph.setdefault(h, {}).setdefault(l2, node)
+
+    cycle = _find_cycle({a: set(bs) for a, bs in graph.items()})
+    if cycle:
+        order = " -> ".join(f"self.{lock}" for lock in cycle)
+        witness = graph[cycle[0]][cycle[1]]
+        findings.append(ctx.finding(
+            witness, rule,
+            f"lock-order cycle in {cls.node.name}: {order} — two "
+            f"threads taking the locks in opposite orders deadlock",
+            "pick one global acquisition order for the class's locks "
+            "and take them in that order everywhere",
+        ))
+
+    # -- (d) blocking under a held lock ----------------------------------
+    seen_d = set()
+    for scan in scans.values():
+        for node, callee, held in scan.call_events:
+            if not held:
+                continue
+            parts = callee.split(".")
+            btail = engine.blocking_tail(callee)
+            if btail is not None:
+                # self._cond.wait() on the held Condition is the point
+                # of a Condition — the one blessed blocking call.
+                if len(parts) == 3 and parts[0] in ("self", "cls") \
+                        and parts[2] in ("wait", "wait_for") \
+                        and parts[1] in held \
+                        and cls.locks.get(parts[1]) == "Condition":
+                    continue
+                key = (node.lineno, node.col_offset)
+                if key in seen_d:
+                    continue
+                seen_d.add(key)
+                lock = next(iter(held))
+                findings.append(ctx.finding(
+                    node, rule,
+                    f"blocking call `{callee}` while holding "
+                    f"`self.{lock}` — every thread contending the lock "
+                    f"stalls behind it",
+                    "move the blocking work outside the lock region; "
+                    "snapshot state under the lock, block after",
+                ))
+                continue
+            summary = _class_call_summary(program, mod, cls, callee)
+            if summary is not None and summary.blocking:
+                key = (node.lineno, node.col_offset)
+                if key in seen_d:
+                    continue
+                seen_d.add(key)
+                lock = next(iter(held))
+                ops = ", ".join(sorted(summary.blocking))
+                findings.append(ctx.finding(
+                    node, rule,
+                    f"`{callee}` is summarized as blocking ({ops}) and "
+                    f"is called while holding `self.{lock}`",
+                    "move the blocking work outside the lock region; "
+                    "snapshot state under the lock, block after",
+                ))
+    return findings
+
+
+def _find_cycle(graph: dict) -> list | None:
+    """A directed cycle [a, b, ..., a] in the lock graph, or None."""
+    color: dict = {}
+    path: list = []
+
+    def dfs(u):
+        color[u] = 1
+        path.append(u)
+        for v in sorted(graph.get(u, ())):
+            if color.get(v) == 1:
+                return path[path.index(v):] + [v]
+            if color.get(v, 0) == 0:
+                found = dfs(v)
+                if found:
+                    return found
+        color[u] = 2
+        path.pop()
+        return None
+
+    for start in sorted(graph):
+        if color.get(start, 0) == 0:
+            found = dfs(start)
+            if found:
+                return found
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Facet e: single-clock-writer (serving scope only)
+# ---------------------------------------------------------------------------
+
+
+def _clock_findings(rule, ctx, tree, imports) -> list:
+    posix = ctx.posix_path
+    if _SERVING_MARK not in posix or posix.endswith(_CLOCK_OWNER_FILES):
+        return []
+    time_aliases = {
+        local for local, m in imports.module_aliases.items()
+        if m == "time"
+    }
+    clock_origins = {f"time.{t}" for t in _CLOCK_TAILS}
+    from_clocks = {
+        local for local, origin in imports.from_imports.items()
+        if origin in clock_origins
+    }
+    exempt: set[int] = set()
+    for n in ast.walk(tree):
+        if isinstance(n, ast.IfExp) and _is_none_test(n.test):
+            # the injection seam: `time.monotonic() if now is None
+            # else now` (either arm may carry the clock)
+            exempt.add(id(n.body))
+            exempt.add(id(n.orelse))
+        elif isinstance(n, ast.Dict):
+            # direct dict-literal stamp values ({"t": time.time()})
+            # are record fields, not control-flow clocks
+            for v in n.values:
+                exempt.add(id(v))
+    findings = []
+    for n in ast.walk(tree):
+        if not isinstance(n, ast.Call) or id(n) in exempt:
+            continue
+        callee = astutil.call_name(n)
+        head, _, tail = callee.rpartition(".")
+        is_clock = (head in time_aliases and tail in _CLOCK_TAILS) \
+            or (not head and callee in from_clocks)
+        if not is_clock:
+            continue
+        findings.append(ctx.finding(
+            n, rule,
+            "wall-clock read outside the serving clock chokepoints — "
+            "the queue/router own time (wall_slo gate, "
+            "poll_health/expire_overdue(now) seams); a second clock "
+            "owner is the multi-controller divergence hazard the "
+            "fleet design forbids",
+            "accept `now` as a parameter with the `x if now is None "
+            "else now` seam, or route through the owning component",
+        ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Facet f: single-writer appenders
+# ---------------------------------------------------------------------------
+
+
+def _is_writer_owner(cls_name, fn_name) -> bool:
+    if fn_name and (fn_name.startswith("append_")
+                    or fn_name.endswith("_append")):
+        return True
+    return bool(cls_name and _WRITER_CLASS_RE.search(cls_name))
+
+
+def _writer_findings(rule, ctx, tree) -> list:
+    scopes = [(tree, None, None)]
+
+    def collect(node, cls_name):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                collect(child, child.name)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                scopes.append((child, cls_name, child.name))
+                collect(child, cls_name)
+
+    collect(tree, None)
+    findings = []
+    for scope, cls_name, fn_name in scopes:
+        if _is_writer_owner(cls_name, fn_name):
+            continue
+        assignments: dict = {}
+        opens: list = []
+        for node in astutil.walk_no_nested_functions(scope):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                assignments[node.targets[0].id] = node.value
+            if isinstance(node, ast.Call):
+                mode = _open_mode(node)
+                if not mode or mode[0] != "a":
+                    continue
+                if isinstance(node.func, ast.Attribute):
+                    path_expr = node.func.value
+                else:
+                    path_expr = node.args[0] if node.args else None
+                if path_expr is None:
+                    continue
+                opens.append((node, path_expr))
+        for node, path_expr in opens:
+            chased = _chase(path_expr, assignments)
+            if not any(_WRITER_PATH_RE.search(s)
+                       for s in _literal_strings(chased)):
+                continue
+            findings.append(ctx.finding(
+                node, rule,
+                "append-mode open of a journal/quarantine sidecar "
+                "outside its owning writer — N appenders interleave "
+                "records and the ledger stops being a ledger (the "
+                "PR-14 N-rank quarantine bug shape)",
+                "route the append through the owning writer (an "
+                "`append_*` helper or the *Journal/*Ledger class) "
+                "behind a single-writer guard",
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+
+def check_concurrency(rule, ctx: ModuleContext, program, mod) -> list:
+    """All six facets over one module, with `program` supplying the
+    interprocedural acquire/blocking summaries."""
+    findings = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        cls = _ClassInfo(node, mod.imports)
+        if not cls.locks:
+            continue
+        findings.extend(_check_class(rule, ctx, program, mod, cls))
+    findings.extend(_clock_findings(rule, ctx, mod.tree, mod.imports))
+    findings.extend(_writer_findings(rule, ctx, mod.tree))
+    return findings
+
+
+class ConcurrencyRule(Rule):
+    id = "GL10"
+    name = "concurrency-discipline"
+    severity = "error"
+    rationale = (
+        "the serving control plane's thread-safety rests on conventions "
+        "(guarded attrs, *_locked, lock order, no blocking under locks, "
+        "one clock owner, one sidecar writer) that shipped-bug history "
+        "(PR-14 N-writer append, PR-15 busy-mark ordering) proves are "
+        "violated silently without a static gate"
+    )
+    hint = "see docs/ANALYSIS.md#gl10"
+
+    def check(self, ctx: ModuleContext):
+        """Single-module fallback (the whole-program pass in
+        engine.analyze_modules is the real engine; this treats the one
+        file as a one-module program so fixtures and ad-hoc
+        lint_source calls still get the rule)."""
+        mod = engine.ModuleInfo(
+            path=ctx.path,
+            name=engine.module_name_for_path(ctx.path),
+            source=ctx.source,
+            tree=ctx.tree,
+        )
+        program = engine.Program([mod])
+        return check_concurrency(self, ctx, program, mod)

@@ -738,12 +738,20 @@ class SimulationService:
         if fn is not None:
             fn(stage, info)
 
+    def _now(self, now: float | None = None) -> float:
+        """The service's single clock seam (graftlint GL10e): every
+        monotonic read in the drain/pipeline path routes through here,
+        so a fleet controller can inject its clock the same way the
+        router's poll_health/expire_overdue(now) seams do and the
+        serving plane keeps exactly one clock owner per process."""
+        return time.monotonic() if now is None else now
+
     def _note_dispatched(self) -> None:
         """A batch entered flight (dispatched, unfetched): the device
         is busy while >= 1 batch is in flight — the complement is the
         bubble the serve.device_bubble gauge reports."""
         if self._inflight_n == 0:
-            self._inflight_since = time.monotonic()
+            self._inflight_since = self._now()
         self._inflight_n += 1
 
     def _note_fetched(self) -> None:
@@ -751,7 +759,7 @@ class SimulationService:
             self._inflight_n -= 1
             if self._inflight_n == 0 and self._inflight_since is not None:
                 self._pipe["busy_s"] += (
-                    time.monotonic() - self._inflight_since
+                    self._now() - self._inflight_since
                 )
                 self._inflight_since = None
 
@@ -816,7 +824,7 @@ class SimulationService:
         # nt behind the saved step) fails ITS ticket only — the
         # co-batched neighbors keep their lanes; the failed lane stays
         # idle padding.
-        t0 = time.monotonic()
+        t0 = self._now()
         live: list[Ticket] = []
         starts: list[int] = []
         with telemetry.span("serve.assemble", phase="serve",
@@ -871,14 +879,14 @@ class SimulationService:
                             l * float("nan") for l in lanes[j]
                         )
                 t.start_step = start
-        self._pipe["assemble_s"] += time.monotonic() - t0
+        self._pipe["assemble_s"] += self._now() - t0
         self._stage_hook("assemble", key=key.key_str(), width=width,
                          seq=seq, live=len(live))
         if not live:
             return None
         n = int(lane_steps.max())
 
-        t0 = time.monotonic()
+        t0 = self._now()
         with telemetry.span(
             "serve.dispatch", phase="serve",
             bin=key.key_str(), width=width, live=len(live), steps=n,
@@ -925,7 +933,7 @@ class SimulationService:
                     if copy_async is None:
                         break
                     copy_async()
-        self._pipe["dispatch_s"] += time.monotonic() - t0
+        self._pipe["dispatch_s"] += self._now() - t0
         self._stage_hook("dispatch", key=key.key_str(), width=width,
                          seq=seq, live=len(live))
         fl = _InFlight(
@@ -961,7 +969,7 @@ class SimulationService:
         prog, live, starts = fl.prog, fl.live, fl.starts
         lane_steps = fl.lane_steps
         n = int(lane_steps.max())
-        t0 = time.monotonic()
+        t0 = self._now()
         try:
             with telemetry.span("serve.fetch", phase="serve",
                                 bin=key.key_str(), width=width):
@@ -1002,12 +1010,12 @@ class SimulationService:
             # finished (or failed), so dropping the last references no
             # longer blocks the host (_InFlight.anchors has the why).
             fl.anchors = ()
-            self._pipe["fetch_s"] += time.monotonic() - t0
+            self._pipe["fetch_s"] += self._now() - t0
             self._note_fetched()
         self._stage_hook("fetch", key=key.key_str(), width=width,
                          seq=fl.seq, live=len(live))
 
-        t0 = time.monotonic()
+        t0 = self._now()
         done = 0
         with telemetry.span("serve.resolve", phase="serve",
                             bin=key.key_str(), width=width,
@@ -1064,7 +1072,7 @@ class SimulationService:
             st.note_batch(width,
                           [int(s) for s in lane_steps[:len(live)]],
                           n, split=fl.split)
-        self._pipe["resolve_s"] += time.monotonic() - t0
+        self._pipe["resolve_s"] += self._now() - t0
         self._pipe["batches"] += 1
         self._stage_hook("resolve", key=key.key_str(), width=width,
                          seq=fl.seq, live=len(live))
@@ -1147,7 +1155,7 @@ class SimulationService:
             t.retries += 1
             self.retries_total += 1
             if self.queue.wall_slo:
-                t.not_before = time.monotonic() \
+                t.not_before = self._now() \
                     + self._retry.backoff_s(t.retries)
             # wake=False: the submitter keeps waiting for the retried
             # batch's real resolution (unlike a preemption park).
@@ -1329,7 +1337,7 @@ class SimulationService:
         preempted = False
         depth = max(1, int(self.config.pipeline_depth))
         inflight: list[tuple] = []  # FIFO: (key, tickets, width, fl)
-        exec_t0 = time.monotonic()
+        exec_t0 = self._now()
         busy0 = self._pipe["busy_s"]
 
         def _finish(entry) -> None:
@@ -1403,7 +1411,7 @@ class SimulationService:
             _finish(entry)
 
         if pending:
-            d_wall = time.monotonic() - exec_t0
+            d_wall = self._now() - exec_t0
             self._pipe["wall_s"] += d_wall
             d_busy = self._pipe["busy_s"] - busy0
             bubble = (
@@ -1542,7 +1550,7 @@ class SimulationService:
                 report.preempted = True
                 break
             if self.queue.depth() == 0:
-                now = time.monotonic()
+                now = self._now()
                 if idle_since is None:
                     idle_since = now
                 elif idle_exit_s is not None \

@@ -145,7 +145,11 @@ def install_compile_listener(on_interval, on_event=None) -> str | None:
         # The install-once seam this function exists for — not a
         # trace-time knob (GL02's hazard); cached programs are
         # unaffected, only future compiles pass through the tap.
-        _dispatch.log_elapsed_time = _tapped_log_elapsed_time  # graftlint: disable=GL02
+        # (carried a GL02 inline suppression until the
+        # --strict-suppressions audit proved it dead: the purity rule
+        # only flags module-state writes reachable from traced bodies,
+        # and this install-once seam never was)
+        _dispatch.log_elapsed_time = _tapped_log_elapsed_time
         mode = "named"
     except Exception:  # noqa: BLE001 — private-module drift: fall back
         try:

@@ -3,10 +3,10 @@
 # (rule catalog: docs/ANALYSIS.md; engine: rocm_mpi_tpu/analysis/).
 #
 # Run it BEFORE the test suite: the whole-program interprocedural pass
-# (GL08 collective divergence, cross-module GL01 donation, GL09 sidecar
-# atomicity, plus the per-file families) catches the bug classes unit
-# tests only see under the exact interleaving — or the exact multi-host
-# topology — that bites. Compared against the committed baseline
+# (GL08 collective divergence, GL10 concurrency discipline, cross-module
+# GL01 donation, GL09 sidecar atomicity, plus the per-file families)
+# catches the bug classes unit tests only see under the exact
+# interleaving — or the exact multi-host topology — that bites. Compared against the committed baseline
 # (analysis/baseline.json: accepted findings never gate, NEW findings
 # always do), and the machine-readable artifact is banked at
 # output/lint/findings.json (schema-checked below; chip_watcher
@@ -28,9 +28,13 @@
 set -u
 cd "$(dirname "$0")/.."
 # The gate never needs a device and must not hang on a flaky chip tunnel.
+# --strict-suppressions: a `# graftlint: disable…` directive that
+# covers no finding is itself a GL99 error (a dead directive silently
+# blesses the next finding at its site).
 env JAX_PLATFORMS=cpu python -m rocm_mpi_tpu.analysis \
   rocm_mpi_tpu apps bench.py \
-  --baseline --output output/lint/findings.json "$@" || exit $?
+  --baseline --strict-suppressions \
+  --output output/lint/findings.json "$@" || exit $?
 # Schema stage's ok-line goes to stderr so `scripts/lint.sh --json | jq`
 # (the documented analyzer usage) still receives pure JSON on stdout;
 # problems already print to stderr.

@@ -1,0 +1,27 @@
+"""Twin of the PR-15 busy-mark bug, shipped-fix shape (GL10-clean).
+
+The fix ordering: the raising stage hook runs BEFORE the busy-mark,
+and the mark itself sits in a plain `with` region (no explicit
+acquire/release to leak).
+"""
+
+import threading
+
+
+class DrainPipeline:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight_n = 0
+
+    def _note_fetched(self):
+        with self._lock:
+            self._inflight_n -= 1
+
+    def _note_aborted(self):
+        with self._lock:
+            self._inflight_n = 0
+
+    def _prepare_batch(self, stage_hook, tickets):
+        stage_hook("dispatch", n=len(tickets))  # hook first: a raise
+        with self._lock:                        # leaves nothing marked
+            self._inflight_n += len(tickets)

@@ -1,0 +1,30 @@
+"""Twin of the PR-15 busy-mark bug, pre-fix shape (must fire GL10).
+
+The shipped bug: the drain pipeline marked `_inflight_n` busy BEFORE
+invoking the raising stage hook — one hook exception and the device-
+bubble gauge read 1.0 forever. Re-staged here with the mark under an
+explicit lock acquire: the raising hook now leaks the LOCK too, which
+is the same ordering mistake with a worse blast radius.
+"""
+
+import threading
+
+
+class DrainPipeline:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight_n = 0
+
+    def _note_fetched(self):
+        with self._lock:
+            self._inflight_n -= 1
+
+    def _note_aborted(self):
+        with self._lock:
+            self._inflight_n = 0
+
+    def _prepare_batch(self, stage_hook, tickets):
+        self._lock.acquire()
+        self._inflight_n += len(tickets)  # busy-mark FIRST
+        stage_hook("dispatch", n=len(tickets))  # raising hook: lock leaks
+        self._lock.release()

@@ -1,0 +1,105 @@
+"""GL10 true negatives: the same shapes as gl10_pos.py, disciplined.
+
+Locked accesses everywhere, *_locked called under the lock, one global
+lock order, blocking moved outside lock regions, explicit acquire
+released in a finally, Condition.wait on the held Condition (the one
+blessed blocking call), and sidecar appends routed through owners.
+"""
+
+import json
+import threading
+import time
+
+
+class Gauge:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def inc(self):
+        with self._lock:
+            self._n += 1
+
+    def dec(self):
+        with self._lock:
+            self._n -= 1
+
+    def peek(self):
+        with self._lock:
+            return self._n
+
+    def _drain_locked(self):
+        return self._n
+
+    def snapshot(self):
+        with self._lock:
+            return self._drain_locked()
+
+    def slow_inc(self):
+        time.sleep(0.01)  # blocking OUTSIDE the lock region
+        with self._lock:
+            self._n += 1
+
+    def marked(self, hook):
+        hook("dispatch")  # the raising call runs before the lock
+        self._lock.acquire()
+        try:
+            self._n += 1
+        finally:
+            self._lock.release()
+
+
+class OrderedRight:
+    """One global acquisition order: _a before _b, everywhere."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def ab(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def also_ab(self):
+        with self._a:
+            with self._b:
+                pass
+
+
+class Waiter:
+    """Condition.wait on the HELD Condition is what a Condition is
+    for — never a blocking-under-lock finding."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._ready = False
+
+    def wait_ready(self):
+        with self._cond:
+            while not self._ready:
+                self._cond.wait()
+            return True
+
+    def set_ready(self):
+        with self._cond:
+            self._ready = True
+            self._cond.notify_all()
+
+
+def append_ticket_line(path, doc):
+    """An `append_*` function owns its sidecar append."""
+    with open(path, "a") as fh:
+        fh.write(json.dumps(doc) + "\n")
+
+
+class PoisonLedgerWriter:
+    """A *Writer class owns its append; the path is data, not a second
+    hardcoded writer."""
+
+    def __init__(self, path):
+        self.path = path
+
+    def bank(self, doc):
+        with open(self.path, "a") as fh:
+            fh.write(json.dumps(doc) + "\n")

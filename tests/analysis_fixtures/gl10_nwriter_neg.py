@@ -1,0 +1,25 @@
+"""Twin of the PR-14 N-writer quarantine bug, shipped-fix shape
+(GL10-clean).
+
+The fix: ONE owning `append_*` helper does the append, and callers
+route through it behind a single-writer guard (rank 0 in the shipped
+code) — the ledger keeps exactly one writer.
+"""
+
+import json
+
+
+def append_quarantine(path, doc):
+    """The owning writer: the only place the sidecar is appended."""
+    with open(path, "a") as fh:
+        fh.write(json.dumps(doc, sort_keys=True) + "\n")
+
+
+class ServiceRank:
+    def __init__(self, out_dir, rank):
+        self.out_dir = out_dir
+        self.rank = rank
+
+    def quarantine(self, doc):
+        if self.rank == 0:  # single-writer guard
+            append_quarantine(self.out_dir + "/quarantine.jsonl", doc)

@@ -1,0 +1,21 @@
+"""Twin of the PR-14 N-writer quarantine bug, pre-fix shape (must
+fire GL10).
+
+The shipped bug: every rank of a multi-controller service appended its
+own copy of each poison record to the same quarantine.jsonl — N
+identical writers interleaving a ledger that is only a ledger with one
+writer. The append here lives in an ordinary service method, outside
+any owning `append_*` helper or *Journal/*Ledger/*Writer class.
+"""
+
+import json
+
+
+class ServiceRank:
+    def __init__(self, out_dir):
+        self.out_dir = out_dir
+
+    def quarantine(self, doc):
+        # every rank executes this — N appenders on one sidecar
+        with open(self.out_dir + "/quarantine.jsonl", "a") as fh:
+            fh.write(json.dumps(doc, sort_keys=True) + "\n")

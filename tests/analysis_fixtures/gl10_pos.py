@@ -1,0 +1,102 @@
+"""GL10 true positives: every concurrency-discipline facet violated.
+
+Each class below breaks exactly one of the conventions the serving
+control plane hand-enforces (docs/ANALYSIS.md#gl10). Nothing here may
+trip another rule family — the fixture harness asserts GL10 fires
+alone (time.monotonic/sleep are GL06-clean on purpose).
+"""
+
+import json
+import threading
+import time
+
+
+class LeakyGauge:
+    """(a) guarded-attribute read outside the lock, (b1) *_locked
+    helper called without the lock, (d) blocking under the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def inc(self):
+        with self._lock:
+            self._n += 1
+
+    def dec(self):
+        with self._lock:
+            self._n -= 1
+
+    def peek(self):
+        # _n is mutated under _lock in two regions above: it is
+        # lock-guarded, and this unlocked read races both writers.
+        return self._n
+
+    def _drain_locked(self):
+        return self._n
+
+    def snapshot(self):
+        # the *_locked convention says the caller holds the lock; no
+        # lock is held on this path.
+        return self._drain_locked()
+
+    def slow_inc(self):
+        with self._lock:
+            time.sleep(0.01)  # blocking while every inc()/dec() waits
+            self._n += 1
+
+
+class OrderedWrong:
+    """(c) lock-order cycle: ab() takes _a then _b, ba() takes _b
+    then _a — two threads deadlock."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def ab(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def ba(self):
+        with self._b:
+            with self._a:
+                pass
+
+
+class BusyMark:
+    """(b2) explicit acquire with a call site before the release and
+    no try/finally — a raising hook leaks the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._spill = 0
+
+    def mark(self, hook):
+        self._lock.acquire()
+        self._inflight += 1
+        hook("dispatch")
+        self._lock.release()
+
+    def unmark(self):
+        with self._lock:
+            self._inflight -= 1
+
+    def spill(self):
+        with self._lock:
+            self._spill += 1
+
+    def respill(self):
+        with self._lock:
+            self._spill += 1
+
+
+class FleetFrontend:
+    """(f) append-mode open of a quarantine sidecar outside any owning
+    writer — N of these interleave records (the PR-14 shape)."""
+
+    def bank_poison(self, root, doc):
+        with open(root + "/quarantine.jsonl", "a") as fh:
+            fh.write(json.dumps(doc) + "\n")

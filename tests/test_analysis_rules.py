@@ -42,7 +42,7 @@ def live_rules(findings) -> set[str]:
 
 ALL_RULE_IDS = [
     "GL01", "GL02", "GL03", "GL04", "GL05", "GL06", "GL07", "GL08",
-    "GL09",
+    "GL09", "GL10",
 ]
 
 
@@ -410,6 +410,195 @@ def test_gl09_fleet_sidecar_twins():
             [(f.line, f.message) for f in real_findings
              if f.rule == "GL09"],
         )
+
+
+# ---------------------------------------------------------------------------
+# GL10 — concurrency discipline (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+
+def test_gl10_all_facets_fire():
+    """One finding per facet on the positive fixture: guarded-attr
+    read, *_locked without the lock, blocking under the lock, the
+    lock-order cycle, the acquire/release balance, and the non-owner
+    sidecar append."""
+    findings = [f for f in lint_fixture("gl10_pos.py")
+                if f.rule == "GL10"]
+    messages = " | ".join(f.message for f in findings)
+    assert "lock-guarded" in messages
+    assert "*_locked convention" in messages
+    assert "blocking call `time.sleep`" in messages
+    assert "lock-order cycle" in messages
+    assert "outside try/finally" in messages
+    assert "append-mode open" in messages
+    assert len(findings) == 6, [(f.line, f.message) for f in findings]
+
+
+def test_gl10_busy_mark_twins():
+    """The PR-15 busy-mark ordering bug: the pre-fix twin (mark under
+    an explicit acquire, raising hook before the release) fires; the
+    shipped ordering (hook first, mark in a `with` region) is clean —
+    and so are the REAL pipelined-drain files under their real
+    serving paths (GL10e included)."""
+    pos = [f for f in lint_fixture("gl10_busy_mark_pos.py")
+           if f.rule == "GL10"]
+    assert len(pos) == 1 and "busy-mark" in pos[0].message, [
+        (f.line, f.message) for f in pos
+    ]
+    neg = lint_fixture("gl10_busy_mark_neg.py")
+    assert "GL10" not in live_rules(neg), [
+        (f.line, f.message) for f in neg if f.rule == "GL10"
+    ]
+    repo = pathlib.Path(__file__).parent.parent
+    for mod in ("serving/service.py", "serving/queue.py"):
+        real = (repo / "rocm_mpi_tpu" / mod).read_text()
+        real_findings = lint_source(real, f"rocm_mpi_tpu/{mod}")
+        assert "GL10" not in live_rules(real_findings), (
+            mod,
+            [(f.line, f.message) for f in real_findings
+             if f.rule == "GL10"],
+        )
+
+
+def test_gl10_nwriter_twins():
+    """The PR-14 N-writer quarantine bug: the pre-fix twin (every rank
+    appends the sidecar from an ordinary method) fires; the shipped
+    single-writer shape (one `append_*` owner behind a rank guard) is
+    clean — and so are the REAL journal/quarantine writers."""
+    pos = [f for f in lint_fixture("gl10_nwriter_pos.py")
+           if f.rule == "GL10"]
+    assert len(pos) == 1 and "N appenders" in pos[0].message, [
+        (f.line, f.message) for f in pos
+    ]
+    neg = lint_fixture("gl10_nwriter_neg.py")
+    assert "GL10" not in live_rules(neg), [
+        (f.line, f.message) for f in neg if f.rule == "GL10"
+    ]
+    repo = pathlib.Path(__file__).parent.parent
+    for mod in ("serving/journal.py", "serving/router.py"):
+        real = (repo / "rocm_mpi_tpu" / mod).read_text()
+        real_findings = lint_source(real, f"rocm_mpi_tpu/{mod}")
+        assert "GL10" not in live_rules(real_findings), (
+            mod,
+            [(f.line, f.message) for f in real_findings
+             if f.rule == "GL10"],
+        )
+
+
+def test_gl10_serving_clock_chokepoints():
+    """GL10e single-clock-writer: a raw wall-clock read in serving/*
+    fires; the injection idiom (`x if now is None else now`), direct
+    dict-literal stamps, the owner files (queue/router), and
+    non-serving paths are all exempt — and every REAL serving module
+    is clean under its real path (the dogfood fix)."""
+    raw = "import time\n\ndef age():\n    return time.monotonic()\n"
+    fs = lint_source(raw, "rocm_mpi_tpu/serving/widget.py")
+    assert "GL10" in live_rules(fs)
+    assert "clock chokepoints" in [
+        f for f in fs if f.rule == "GL10"
+    ][0].message
+    # the injection seam is the blessed shape
+    seam = ("import time\n\ndef age(now=None):\n"
+            "    now = time.monotonic() if now is None else now\n"
+            "    return now\n")
+    assert "GL10" not in live_rules(
+        lint_source(seam, "rocm_mpi_tpu/serving/widget.py")
+    )
+    # a dict-literal stamp is a record field, not a control-flow clock
+    stamp = ("import time\n\ndef doc():\n"
+             "    return {\"t\": time.time()}\n")
+    assert "GL10" not in live_rules(
+        lint_source(stamp, "rocm_mpi_tpu/serving/widget.py")
+    )
+    # the owners and everything outside serving/* stay unflagged
+    assert "GL10" not in live_rules(
+        lint_source(raw, "rocm_mpi_tpu/serving/queue.py")
+    )
+    assert "GL10" not in live_rules(
+        lint_source(raw, "rocm_mpi_tpu/telemetry/widget.py")
+    )
+    repo = pathlib.Path(__file__).parent.parent
+    for mod in ("serving/service.py", "serving/bins.py",
+                "serving/slo.py", "serving/journal.py",
+                "serving/sessions.py", "serving/scheduler.py"):
+        path = repo / "rocm_mpi_tpu" / mod
+        if not path.is_file():
+            continue
+        real_findings = lint_source(
+            path.read_text(), f"rocm_mpi_tpu/{mod}"
+        )
+        assert "GL10" not in live_rules(real_findings), (
+            mod,
+            [(f.line, f.message) for f in real_findings
+             if f.rule == "GL10"],
+        )
+
+
+def test_gl10_interprocedural_lock_effects():
+    """The engine-summary facets: a lock-order cycle closed through a
+    self-call (the callee's acquire effect), and transitive blocking
+    (a helper summarized as file I/O called under the lock)."""
+    cycle = (
+        "import threading\n\n\n"
+        "class Pair:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n\n"
+        "    def _grab_b(self):\n"
+        "        with self._b:\n"
+        "            pass\n\n"
+        "    def ab(self):\n"
+        "        with self._a:\n"
+        "            self._grab_b()\n\n"
+        "    def ba(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n"
+    )
+    fs = [f for f in lint_source(cycle, "cycle.py") if f.rule == "GL10"]
+    assert any("lock-order cycle" in f.message for f in fs), [
+        (f.line, f.message) for f in fs
+    ]
+    blocking = (
+        "import threading\n\n\n"
+        "class Spiller:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.rows = []\n\n"
+        "    def _flush(self, path):\n"
+        "        with open(path, \"w\") as fh:\n"
+        "            fh.write(\"x\")\n\n"
+        "    def spill(self, path):\n"
+        "        with self._lock:\n"
+        "            self._flush(path)\n"
+    )
+    fs = [f for f in lint_source(blocking, "spill.py")
+          if f.rule == "GL10"]
+    assert any("summarized as blocking" in f.message for f in fs), [
+        (f.line, f.message) for f in fs
+    ]
+    # re-acquiring a held non-reentrant Lock is the degenerate cycle;
+    # the same shape on an RLock is legal reentrancy
+    reacquire = (
+        "import threading\n\n\n"
+        "class Nest:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.{kind}()\n\n"
+        "    def outer(self):\n"
+        "        with self._lock:\n"
+        "            self.inner()\n\n"
+        "    def inner(self):\n"
+        "        with self._lock:\n"
+        "            pass\n"
+    )
+    fs = [f for f in lint_source(reacquire.format(kind="Lock"),
+                                 "nest.py") if f.rule == "GL10"]
+    assert any("self-deadlock" in f.message for f in fs), [
+        (f.line, f.message) for f in fs
+    ]
+    fs = [f for f in lint_source(reacquire.format(kind="RLock"),
+                                 "nest.py") if f.rule == "GL10"]
+    assert fs == [], [(f.line, f.message) for f in fs]
 
 
 def test_serving_fault_kinds_parse_and_consume():
@@ -805,7 +994,7 @@ def test_missing_path_fails_loudly():
 
 
 # ---------------------------------------------------------------------------
-# JSON reporter schema (version 2 — pinned; regress --check-schema reads it)
+# JSON reporter schema (version 3 — pinned; regress --check-schema reads it)
 # ---------------------------------------------------------------------------
 
 
@@ -819,13 +1008,14 @@ def test_json_reporter_schema():
     findings = lint_fixture("gl03_pos.py") + lint_fixture("suppressions.py")
     doc = json.loads(to_json(findings, files_scanned=2))
     assert doc["schema"] == FINDINGS_SCHEMA
-    assert doc["version"] == FINDINGS_VERSION == 2
+    assert doc["version"] == FINDINGS_VERSION == 3
     assert doc["files_scanned"] == 2
     assert isinstance(doc["suppressed"], int) and doc["suppressed"] == 2
     assert doc["baselined"] == 0
-    # counts: every cataloged rule id present (GL08/GL09 included), GL00 too
+    # counts: every cataloged rule id present (GL08/GL09/GL10 included),
+    # GL00 too
     rule_ids = {r.id for r in catalog_rules()} | {PARSE_RULE}
-    assert {"GL08", "GL09"} <= rule_ids
+    assert {"GL08", "GL09", "GL10"} <= rule_ids
     assert set(doc["counts"]) == rule_ids
     assert doc["counts"]["GL03"] == len(
         [f for f in findings if not f.suppressed]
@@ -897,7 +1087,7 @@ def test_cli_list_rules(capsys):
     assert cli_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rule_id in ("GL01", "GL02", "GL03", "GL04", "GL05", "GL06",
-                    "GL07", "GL08", "GL09"):
+                    "GL07", "GL08", "GL09", "GL10"):
         assert rule_id in out
 
 
@@ -937,3 +1127,61 @@ def test_cli_output_artifact(tmp_path, capsys):
     assert validate_findings_doc(doc) == []
     assert doc["counts"]["GL03"] >= 1
     capsys.readouterr()
+
+
+def test_strict_suppressions_flags_stale_directive(tmp_path, capsys):
+    """A directive that covers no finding is itself a GL99 error under
+    --strict-suppressions — and invisible without the flag (the default
+    lane stays byte-identical for downstream tooling)."""
+    stale = tmp_path / "stale.py"
+    stale.write_text("x = 1  # graftlint: disable=GL03\n")
+    assert cli_main([str(stale)]) == 0
+    capsys.readouterr()
+    assert cli_main([str(stale), "--strict-suppressions"]) == 1
+    out = capsys.readouterr().out
+    assert "GL99" in out and "stale suppression" in out
+    assert "disable=GL03" in out  # names the dead directive verbatim
+
+
+def test_strict_suppressions_keeps_live_directive(tmp_path, capsys):
+    """A directive that still suppresses a finding survives the audit:
+    same exit code with and without the flag."""
+    live = tmp_path / "live.py"
+    live.write_text(
+        "from jax import shard_map  # graftlint: disable=GL03\n"
+    )
+    assert cli_main([str(live)]) == 0
+    assert cli_main([str(live), "--strict-suppressions"]) == 0
+    # an ALL directive is live if ANY rule fires under it
+    blanket = tmp_path / "blanket.py"
+    blanket.write_text(
+        "from jax import shard_map  # graftlint: disable=ALL\n"
+    )
+    assert cli_main([str(blanket), "--strict-suppressions"]) == 0
+    capsys.readouterr()
+
+
+def test_audit_suppressions_unit_shapes(tmp_path):
+    """disable-next audits against the NEXT line's findings;
+    disable-file is live if anything in the file fired under it."""
+    from rocm_mpi_tpu.analysis.core import STALE_RULE, audit_suppressions
+
+    nxt = tmp_path / "nxt.py"
+    nxt.write_text(
+        "# graftlint: disable-next=GL03\n"
+        "from jax import shard_map\n"
+        "# graftlint: disable-next=GL03\n"
+        "x = 1\n"
+    )
+    findings, _ = lint_paths([str(nxt)])
+    stale = audit_suppressions([str(nxt)], findings)
+    assert [(f.rule, f.line) for f in stale] == [(STALE_RULE, 3)]
+    assert stale[0].severity == "error"
+
+    blanket = tmp_path / "blanket.py"
+    blanket.write_text(
+        "# graftlint: disable-file=GL03\n"
+        "from jax import shard_map\n"
+    )
+    findings, _ = lint_paths([str(blanket)])
+    assert audit_suppressions([str(blanket)], findings) == []

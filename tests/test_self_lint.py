@@ -152,6 +152,28 @@ def test_gl02_catches_restored_bench_global_mutation():
     assert after and any("mutates module" in f.message for f in after)
 
 
+def test_gate_scope_suppressions_all_live():
+    """The --strict-suppressions pin: every disable directive in the
+    gate scope still covers a finding.  A refactor that fixes (or
+    moves) the suppressed code must delete its directive in the same
+    change, or this test names the dead comment."""
+    from rocm_mpi_tpu.analysis.core import audit_suppressions
+
+    findings, _ = lint_paths(GATE_SCOPE)
+    stale = audit_suppressions(GATE_SCOPE, findings)
+    assert stale == [], "\n".join(
+        f"{f.location()}: {f.message}" for f in stale
+    )
+    # …and the accepted verdicts those directives exist for are still
+    # being produced (the audit is only meaningful against a lint run
+    # that actually exercises the suppressions).
+    suppressed = [f for f in findings if f.suppressed]
+    assert len(suppressed) >= 6, (
+        "the known accepted-verdict count shrank — if findings were "
+        "fixed for real, their directives should have been deleted too"
+    )
+
+
 def test_fixture_dir_is_excluded_from_directory_walks():
     # The deliberately-buggy fixtures must never leak into a `tests/`-wide
     # lint invocation (e.g. someone running the CLI over the whole repo).

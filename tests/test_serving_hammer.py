@@ -1,0 +1,204 @@
+"""Seeded two-thread hammer for the serving control plane (the dynamic
+complement to graftlint GL10's static racecheck, ISSUE 17).
+
+The invariants pinned here are exactly the ones the static rules
+guard: the queue's terminal accounting identity under concurrent
+submit/pop/requeue (the lock-guarded counters GL10a infers),
+exactly-one-terminal per journaled ticket under a mid-append replay
+(the single-writer ledger GL10f owns), and torn-tail tolerance when a
+replay races the appender. All schedules are seeded `random.Random`
+draws — a failure replays with the same interleaving pressure.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from rocm_mpi_tpu.serving.journal import (
+    TicketJournal,
+    exactly_one_terminal,
+    replay,
+)
+from rocm_mpi_tpu.serving.queue import Request, RequestQueue
+
+N_REQUESTS = 150
+HAMMER_DEADLINE_S = 30.0  # stall guard, not a perf target
+
+
+def test_queue_two_thread_hammer():
+    """Producer submits while the consumer pops, requeues (once per
+    ticket, bounded), fails a seeded slice, and resolves the rest.
+    At drain: the terminal accounting identity holds, every ticket is
+    in exactly one terminal state, and the counters reconstruct the
+    per-ticket truth."""
+    q = RequestQueue()
+    tickets: list = []  # producer-appended, read after join
+    requeued_once: set[str] = set()  # consumer-thread-local by design
+    barrier = threading.Barrier(2)
+    errors: list[BaseException] = []
+
+    def producer():
+        barrier.wait()
+        for i in range(N_REQUESTS):
+            tickets.append(q.submit(Request(request_id=f"r{i:04d}")))
+            if i % 17 == 0:
+                time.sleep(0)  # hand the GIL over: interleave pops
+
+    def consumer():
+        rng = random.Random(0x17)
+        barrier.wait()
+        done = 0
+        deadline = time.monotonic() + HAMMER_DEADLINE_S
+        while done < N_REQUESTS:
+            if time.monotonic() >= deadline:
+                raise AssertionError(
+                    f"hammer stalled at {done}/{N_REQUESTS} terminals"
+                )
+            batch = q.pop_pending(max_n=rng.randint(1, 8))
+            if not batch:
+                time.sleep(0)  # producer still filling
+                continue
+            park = [
+                t for t in batch
+                if t.request.request_id not in requeued_once
+                and rng.random() < 0.30
+            ]
+            requeued_once.update(t.request.request_id for t in park)
+            if park:
+                q.requeue(park)  # preemption: back to the front
+            resolved = failed = 0
+            for t in batch:
+                if t in park:
+                    continue
+                if rng.random() < 0.10:
+                    t._fail("hammer: injected failure")
+                    failed += 1
+                else:
+                    t._resolve({"ok": t.request.request_id})
+                    resolved += 1
+            if resolved or failed:
+                q.note_completed(resolved, failed=failed)
+            done += resolved + failed
+
+    def run(fn):
+        def wrapped():
+            try:
+                fn()
+            except BaseException as exc:  # surfaced after join
+                errors.append(exc)
+        th = threading.Thread(target=wrapped, name=fn.__name__)
+        th.start()
+        return th
+
+    threads = [run(producer), run(consumer)]
+    for th in threads:
+        th.join(timeout=HAMMER_DEADLINE_S + 5)
+        assert not th.is_alive(), f"{th.name} did not finish"
+    assert errors == [], errors
+
+    # THE identity: every submitted ticket terminally accounted.
+    assert q.check_accounting(in_flight=0) == []
+    assert len(tickets) == N_REQUESTS
+    states = [t.state for t in tickets]
+    assert all(s in ("done", "failed") for s in states), (
+        sorted(set(states))
+    )
+    c = q.counters()
+    assert c["submitted"] == N_REQUESTS
+    assert c["completed"] == states.count("done")
+    assert c["failed"] == states.count("failed")
+    assert c["depth"] == 0
+    assert c["requeued"] == len(requeued_once)
+    assert c["rejected"] == c["expired"] == c["quarantined"] == 0
+
+
+def test_journal_concurrent_append_and_replay(tmp_path):
+    """One writer appends submit/route/terminal triples while a reader
+    replays the live segment mid-append. Replay must never raise, the
+    observed ticket count is monotone (the ledger only grows), and the
+    drained journal balances to exactly one terminal per ticket."""
+    path = tmp_path / "ticket-journal.jsonl"
+    journal = TicketJournal(path)
+    n = 200
+    stop = threading.Event()
+    barrier = threading.Barrier(2)
+    errors: list[BaseException] = []
+    observed: list[int] = []
+
+    def writer():
+        try:
+            barrier.wait()
+            for i in range(n):
+                rid = f"t{i:04d}"
+                journal.record_submit(rid, bin_key="hammer")
+                journal.record_route(rid, replica=i % 3)
+                journal.record_terminal(
+                    rid, "done" if i % 7 else "failed", replica=i % 3
+                )
+        except BaseException as exc:
+            errors.append(exc)
+        finally:
+            stop.set()
+
+    def reader():
+        try:
+            barrier.wait()
+            while not stop.is_set():
+                state = replay([path])  # mid-append: must not raise
+                observed.append(len(state.tickets))
+                time.sleep(0.001)
+        except BaseException as exc:
+            errors.append(exc)
+
+    threads = [threading.Thread(target=writer),
+               threading.Thread(target=reader)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=HAMMER_DEADLINE_S)
+        assert not th.is_alive(), "journal hammer stalled"
+    assert errors == [], errors
+    journal.close()
+
+    assert observed == sorted(observed), (
+        "replay went backwards against an append-only ledger"
+    )
+    state = replay([path])
+    assert len(state.tickets) == n
+    assert state.torn_lines == 0  # writer finished: no torn tail left
+    assert exactly_one_terminal(state) == []
+    counts = state.terminal_counts()
+    assert counts.get("failed", 0) == sum(1 for i in range(n) if i % 7 == 0)
+
+
+def append_torn_tail(path) -> None:
+    # The owning append helper for this test's sidecar (GL10f shape):
+    # half a record, no newline — a writer killed mid-append.
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write('{"kind": "terminal", "seq": 9')
+
+
+def test_journal_torn_tail_replay(tmp_path):
+    """A half-written tail line (writer killed mid-append) is counted,
+    never parsed, and never poisons the completed records around it —
+    and a restarted journal resumes over it without raising."""
+    path = tmp_path / "ticket-journal.jsonl"
+    journal = TicketJournal(path)
+    for i in range(5):
+        rid = f"t{i}"
+        journal.record_submit(rid)
+        journal.record_terminal(rid, "done")
+    journal.close()
+    append_torn_tail(path)
+
+    state = replay([path])
+    assert state.torn_lines == 1
+    assert len(state.tickets) == 5
+    assert exactly_one_terminal(state) == []
+
+    # restart over the torn tail: seq resume replays the same segment
+    resumed = TicketJournal(path)
+    assert resumed._seq == state.seq_max + 1
+    resumed.close()
