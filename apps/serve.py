@@ -39,6 +39,11 @@ from apps._common import (  # noqa: E402
 SYNTH_SHAPES = ((16, 16), (24, 24), (32, 32))
 SYNTH_WORKLOADS = ("diffusion", "wave", "swe")
 
+# The heavy-tailed mix rides shapes a rung apart on purpose: with
+# --ladder, (30, 30) embeds into the (32, 32) rung and the two classes
+# consolidate into one compiled program; (16, 16) stays its own rung.
+HEAVY_SHAPES = ((30, 30), (32, 32), (16, 16))
+
 
 def synthetic_trace(n: int, seed: int, nt_max: int = 64,
                     dtype: str = "f32", sessions: bool = False,
@@ -68,6 +73,43 @@ def synthetic_trace(n: int, seed: int, nt_max: int = 64,
             physics=physics,
             ic_scale=1.0 + 0.01 * (i % 17),
             session=f"sess-{i:04d}" if sessions else None,
+            deadline_s=deadline_s,
+        ))
+    return reqs
+
+
+def heavy_tailed_trace(n: int, seed: int, nt_max: int = 64,
+                       dtype: str = "f32",
+                       deadline_s: float | None = None):
+    """Heavy-tailed mixed-shape synthetic mix — the continuous-batching
+    acceptance trace (docs/SERVING.md "Continuous batching"): most
+    requests finish in a handful of steps while a Pareto tail runs to
+    `nt_max`, so a batch-synchronous drain strands resolved lanes
+    behind the longest tenant where the segmented drain swaps queued
+    work into their slots at segment boundaries. Shapes mix off-rung
+    domains with their rung (HEAVY_SHAPES) so `--ladder` can
+    consolidate program classes on the same trace; the occasional SWE
+    request exercises the ladder's eligibility exclusion."""
+    from rocm_mpi_tpu.serving.queue import Request
+
+    rng = random.Random(seed)
+    reqs = []
+    for i in range(n):
+        # Diffusion-heavy (the ladder-eligible class), wave for the
+        # second eligible physics, SWE rarely (never laddered).
+        r = rng.random()
+        wl = "swe" if r < 0.1 else ("wave" if r < 0.35 else "diffusion")
+        shape = HEAVY_SHAPES[rng.randrange(len(HEAVY_SHAPES))]
+        nt = min(nt_max, 2 + int(2.0 * rng.paretovariate(1.2)))
+        reqs.append(Request(
+            request_id=f"heavy-{seed}-{i:04d}",
+            workload=wl,
+            global_shape=shape,
+            dtype=dtype,
+            nt=nt,
+            physics=(),
+            ic_scale=1.0 + 0.01 * (i % 17),
+            session=None,
             deadline_s=deadline_s,
         ))
     return reqs
@@ -133,6 +175,20 @@ def make_parser():
     p.add_argument("--quarantine", default=None, metavar="FILE.jsonl",
                    help="append-only poison-request ledger (default: "
                    "<--out>/quarantine.jsonl when --out is given)")
+    p.add_argument("--heavy-tailed", action="store_true",
+                   help="heavy-tailed mixed-shape synthetic mix: Pareto "
+                   "step counts + rung-apart shapes (the continuous-"
+                   "batching acceptance trace; needs --synthetic)")
+    p.add_argument("--segments", type=positive_int, default=None,
+                   help="continuous batching (docs/SERVING.md): run "
+                   "each batch as this many fixed-size step segments "
+                   "of ONE compiled program, swapping resolved lanes "
+                   "for queued same-class requests at the boundaries "
+                   "(default 1 = batch-synchronous)")
+    p.add_argument("--ladder", action="store_true",
+                   help="shape-padding ladder: pad eligible lanes up "
+                   "to their rung so rung-sharing shapes consolidate "
+                   "into one compiled program class")
     p.add_argument("--pipeline-depth", type=positive_int, default=None,
                    help="drain pipeline depth (docs/SERVING.md 'The "
                    "pipeline'): 1 = serial drain, 2 (default) = "
@@ -183,11 +239,21 @@ def main(argv=None) -> int:
             print("--synthetic-sessions needs --sessions DIR",
                   file=sys.stderr)
             return 2
-        requests = synthetic_trace(
-            n, args.seed, nt_max=args.nt_max, dtype=args.dtype,
-            sessions=args.synthetic_sessions,
-            deadline_s=args.deadline_s,
-        )
+        if args.heavy_tailed:
+            if args.synthetic_sessions:
+                print("--heavy-tailed is sessionless "
+                      "(drop --synthetic-sessions)", file=sys.stderr)
+                return 2
+            requests = heavy_tailed_trace(
+                n, args.seed, nt_max=args.nt_max, dtype=args.dtype,
+                deadline_s=args.deadline_s,
+            )
+        else:
+            requests = synthetic_trace(
+                n, args.seed, nt_max=args.nt_max, dtype=args.dtype,
+                sessions=args.synthetic_sessions,
+                deadline_s=args.deadline_s,
+            )
     if any(r.dtype == "f64" for r in requests):
         # x64 follows the TRACE, not just the synthetic --dtype knob: a
         # recorded f64 request served at canonicalized f32 would
@@ -215,6 +281,10 @@ def main(argv=None) -> int:
     cfg_kw = {}
     if args.pipeline_depth is not None:
         cfg_kw["pipeline_depth"] = args.pipeline_depth
+    if args.segments is not None:
+        cfg_kw["segments"] = args.segments
+    if args.ladder:
+        cfg_kw["ladder"] = True
     svc = SimulationService(config=ServeConfig(
         max_width=args.max_width,
         occupancy_floor=args.occupancy_floor,
@@ -276,6 +346,16 @@ def main(argv=None) -> int:
             f"(assemble {pipe['assemble_s']:.3f}s / dispatch "
             f"{pipe['dispatch_s']:.3f}s / fetch {pipe['fetch_s']:.3f}s "
             f"/ resolve {pipe['resolve_s']:.3f}s)"
+        )
+    cont = report.continuous
+    if cont:
+        log0(
+            f"  continuous segments={cont['segments']} "
+            f"batches={cont['batches']} "
+            f"segments_run={cont['segments_run']} "
+            f"swaps_in={cont['swaps_in']} "
+            f"swaps_out={cont['swaps_out']} "
+            f"occupancy={cont['occupancy']:.3f}"
         )
     for key, st in sorted(report.bins.items()):
         log0(

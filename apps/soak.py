@@ -241,6 +241,64 @@ class Soak:
                 )
         return {"counters": counters[2], "bubble": pipe["bubble"]}
 
+    def ep_swap(self):
+        """The continuous-batching swap drill (docs/SERVING.md
+        "Continuous batching"): a same-class backlog deeper than the
+        batch width runs through the step-segmented drain, so resolved
+        lanes swap out at segment boundaries and queued tenants swap
+        into their slots — and the swapped-in poison lane (lane-nan on
+        every attempt) exhausts its retry budget mid-trace. The
+        exactly-one-terminal invariant must hold across the swap churn,
+        and every surviving co-batched tenant stays bitwise-equal to
+        its standalone batch-synchronous twin."""
+        import numpy as np
+
+        def trace(tag):
+            # One bin class: nts 4/3 share the 4-step bucket, so the
+            # 2-step segments see both mid-flight freezes and
+            # finishers whose slots the backlog refills.
+            return [
+                _req(f"{tag}-{i:02d}", shape=SHAPE_A,
+                     nt=4 if i % 2 == 0 else 3,
+                     ic_scale=1.0 + 0.02 * i)
+                for i in range(6)
+            ]
+
+        svc = self._service(max_width=2, segments=2)
+        tickets = [svc.queue.submit(r) for r in trace("swap")]
+        _drive(svc)
+        cont = svc._continuous
+        assert cont["batches"] >= 1, cont
+        assert cont["swaps_in"] >= 1, (
+            f"segmented drain never swapped a lane in: {cont}"
+        )
+        # The poisoned swap-in (ordinal 3) burned its whole retry
+        # budget; everyone else reached done — exactly one terminal
+        # state each, certified by _bank's accounting assert.
+        bad = tickets[2]
+        assert bad.state == "quarantined", (bad.state, bad.error)
+        for t in tickets:
+            if t is not bad:
+                assert t.state == "done", (
+                    t.request.request_id, t.state, t.error
+                )
+        c = self._bank(svc, "swap")
+        assert c["completed"] == 5 and c["quarantined"] == 1, c
+        # Bitwise pin: each survivor against a solo batch-synchronous
+        # run (the injected lane-nan clause is exhausted by now).
+        twin = self._service(max_width=1)
+        twin_tickets = [twin.queue.submit(r) for r in trace("swap")]
+        _drive(twin)
+        for i, (t, ref) in enumerate(zip(tickets, twin_tickets)):
+            if t is bad:
+                continue
+            for a, b in zip(t.result(timeout=5), ref.result(timeout=5)):
+                assert np.array_equal(np.asarray(a), np.asarray(b)), (
+                    f"request {i}: swapped lane != standalone twin"
+                )
+        return {"counters": c, "swaps_in": cont["swaps_in"],
+                "segments_run": cont["segments_run"]}
+
     def ep_breaker(self):
         """The circuit-breaker arc: three consecutive injected batch
         errors open SHAPE_A's class (its pending requests reject fast
@@ -548,6 +606,11 @@ class Soak:
              "slow-batch=0.05@step=2,times=2;"
              "slow-batch=0.05@step=4,times=2",
              self.ep_pipeline),
+            # times=3: the swapped-in poison lane burns its full retry
+            # budget (attempt + 2 retries), then the clause is spent so
+            # the bitwise twin runs clean.
+            ("swap", "in-process", "lane-nan@request=3,times=3",
+             self.ep_swap),
             # breaker/storage install their own specs (multiple phases).
             ("breaker", "in-process", None, self.ep_breaker),
             ("storage", "in-process", None, self.ep_storage),

@@ -435,12 +435,14 @@ def _write_bench_record(rows: dict, rate_rows: dict | None = None) -> None:
 def _run_serve_drain_rung(n_requests: int = 16, nt_base: int = 2_000,
                           shapes=((64, 64), (96, 96))) -> dict:
     """The serving drain rung (ISSUE 15, docs/SERVING.md "The
-    pipeline"): the SAME synthetic trace through both drain modes —
-    serial (depth 1) vs double-buffered (depth 2) — on warmed program
-    caches; returns {label: aggregate requests/s}, the drain-overlap
-    pair `_write_bench_record` banks. time.monotonic interval
-    arithmetic by design (the per-batch device walls ride the serve.*
-    telemetry spans)."""
+    pipeline"): the SAME synthetic trace through three drain modes —
+    serial (depth 1), double-buffered (depth 2), and continuous
+    (depth 2, 4 step segments per batch with boundary lane swap,
+    docs/SERVING.md "Continuous batching") — on warmed program caches;
+    returns {label: aggregate requests/s}, the drain rungs
+    `_write_bench_record` banks. time.monotonic interval arithmetic by
+    design (the per-batch device walls ride the serve.* telemetry
+    spans)."""
     import time as _time
 
     from rocm_mpi_tpu.serving.queue import Request as _Request
@@ -462,14 +464,16 @@ def _run_serve_drain_rung(n_requests: int = 16, nt_base: int = 2_000,
             for i in range(n_requests)
         ]
 
-    for depth, mode in ((1, "serial"), (2, "pipelined")):
+    for depth, mode, segments in (
+        (1, "serial", 1), (2, "pipelined", 1), (2, "continuous", 4),
+    ):
         svc = _SimulationService(config=_ServeConfig(
-            max_width=4, pipeline_depth=depth,
+            max_width=4, pipeline_depth=depth, segments=segments,
         ))
         # Warm pass: every program class compiles here, so the
         # measured pass is the steady state the service actually runs.
-        svc.run_trace(_drain_trace(f"warm{depth}"))
-        trace = _drain_trace(f"meas{depth}")
+        svc.run_trace(_drain_trace(f"warm{mode}"))
+        trace = _drain_trace(f"meas{mode}")
         for r in trace:
             svc.queue.submit(r)
         t0 = _time.monotonic()
@@ -477,10 +481,13 @@ def _run_serve_drain_rung(n_requests: int = 16, nt_base: int = 2_000,
         wall = _time.monotonic() - t0
         rate = rep.served / wall if wall > 0 else 0.0
         pipe = svc.pipeline_stats()
+        cont = rep.continuous
         print(
             f"{'serve drain ' + mode:34s} {rep.served:3d} req "
             f"in {wall:8.3f} s  {rate:8.2f} req/s  "
-            f"bubble={pipe['bubble']:.2f}",
+            f"bubble={pipe['bubble']:.2f}"
+            + (f"  occ={cont['occupancy']:.2f} "
+               f"swaps={cont['swaps_in']}" if cont else ""),
             file=sys.stderr,
         )
         serve_rows[f"serve drain {mode}"] = rate

@@ -743,6 +743,95 @@ class HeatDiffusion:
 
         return advance, bgrid
 
+    def batched_ladder_advance_fn(
+        self,
+        batch: int | None = None,
+        bgrid=None,
+        batch_dims: int = 1,
+        devices=None,
+    ):
+        """(jitted `advance(Tb, Cp, hold, dt_lam, inv_d2, lane_steps, n) ->
+        Tb`, bgrid) — the LADDER edition of the batched advance
+        (docs/SERVING.md "Continuous batching"): this model's shape is
+        the ladder RUNG, and each lane may embed a smaller original
+        domain at the origin corner. Geometry rides traced per-lane
+        operands instead of trace constants, so ONE compiled program
+        serves every original shape on the rung:
+
+          * `hold` — (batch, *space) bool, True on a lane's held cells:
+            its original domain's global Dirichlet ring AND every cell
+            outside the embedded domain (pad cells freeze bitwise at
+            their initial value, exactly like a finished lane's steps);
+          * `dt_lam` — (batch,) per-lane dt·λ; `inv_d2` — a TUPLE of
+            ndim (batch,) per-axis reciprocal spacing² operands — dt·λ
+            multiplied in the compute dtype, each reciprocal rounded
+            exactly as XLA folds the standalone divide-by-constant
+            (ops.diffusion.step_fused_padded_geom has the ulp
+            rationale).
+
+        Because the held ring separates each embedded interior from the
+        padding, interior cells read only original-domain values — every
+        lane is bitwise-equal to its standalone run ('shard' variant,
+        lossless 'f32' wire; the service gates eligibility). Donates Tb.
+        """
+        if bgrid is None:
+            if batch is None:
+                raise ValueError("pass batch= or a prebuilt bgrid=")
+            bgrid = self.make_batched_grid(batch, batch_dims, devices)
+        step = self.batched_ladder_step_fn(bgrid)
+        shape1 = (-1,) + (1,) * bgrid.space.ndim
+
+        @functools.partial(jax.jit, donate_argnums=0)
+        def advance(Tb, Cp, hold, dt_lam, inv_d2, lane_steps, n):
+            def body(i, T):
+                new = step(T, Cp, hold, dt_lam, *inv_d2)
+                active = (i < lane_steps).reshape(shape1)
+                return jnp.where(active, new, T)
+
+            return lax.fori_loop(0, n, body, Tb)
+
+        return advance, bgrid
+
+    def batched_ladder_step_fn(self, bgrid):
+        """The UNJITTED per-step program of `batched_ladder_advance_fn` —
+        `step(Tb, Cp, hold, dt_lam, *inv_d2) -> Tb`, the shard_map'd
+        body the advance's fori_loop repeats. Exposed separately so the
+        traffic audit can price ONE ladder step (the HLO byte model
+        reads the entry computation only; a loop body would be
+        invisible to it).
+
+        inv_d2 rides as ndim SEPARATE per-lane scalar operands, not one
+        indexed (batch, ndim) vector: inside the fori_loop body XLA
+        fuses the gathered-vector form differently from the standalone's
+        folded constants and drifts a ulp — per-axis scalar operands
+        compile to the identical multiplies
+        (ops.diffusion.step_fused_padded_geom has the full story).
+        """
+        from rocm_mpi_tpu.ops.diffusion import step_fused_padded_geom
+        from rocm_mpi_tpu.parallel.halo import exchange_halo_batched
+
+        wire_mode = self.config.wire_mode
+        ndim = bgrid.space.ndim
+
+        def lane_local(Tb_l, Cl, Hb_l, dtlam_l, *invd2_l):
+            Tp = exchange_halo_batched(Tb_l, bgrid, wire_mode=wire_mode)
+
+            def lane(Tl, Tpl, Hl, a, *gs):
+                new = step_fused_padded_geom(Tpl, Cl, a, gs)
+                return jnp.where(Hl, Tl, new)
+
+            return jax.vmap(lane)(Tb_l, Tp, Hb_l, dtlam_l, *invd2_l)
+
+        return shard_map(
+            lane_local,
+            mesh=bgrid.mesh,
+            in_specs=(bgrid.spec, bgrid.aux_spec, bgrid.spec,
+                      bgrid.batch_spec)
+            + (bgrid.batch_spec,) * ndim,
+            out_specs=bgrid.spec,
+            check_vma=False,
+        )
+
     def batched_deep_advance_fn(
         self,
         batch: int | None = None,

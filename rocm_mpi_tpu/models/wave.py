@@ -415,6 +415,72 @@ class AcousticWave:
 
         return advance, bgrid
 
+    def batched_ladder_advance_fn(
+        self,
+        batch: int | None = None,
+        bgrid=None,
+        batch_dims: int = 1,
+        devices=None,
+    ):
+        """(jitted `advance(Ub, Upb, C2, hold, dt2, inv_d2, lane_steps,
+        n) -> (Ub, Upb)`, bgrid) — the wave edition of the LADDER
+        batched advance (HeatDiffusion.batched_ladder_advance_fn has the
+        full contract): per-lane `hold` masks (original Dirichlet ring +
+        out-of-domain padding), per-lane `dt2` = dt² (batch,) and
+        `inv_d2` = a TUPLE of ndim per-axis (batch,) 1/spacing²
+        operands, precomputed host-side in f64 from each lane's
+        ORIGINAL-shape config (ops.wave_kernels.wave_step_padded_geom;
+        per-axis scalars, not an indexed vector — the diffusion
+        edition's fori-fusion ulp note applies here too). Both leapfrog
+        carries freeze together under `hold` exactly as under
+        `lane_steps`. Donates (Ub, Upb)."""
+        from rocm_mpi_tpu.ops.wave_kernels import wave_step_padded_geom
+        from rocm_mpi_tpu.parallel.halo import exchange_halo_batched
+
+        if bgrid is None:
+            if batch is None:
+                raise ValueError("pass batch= or a prebuilt bgrid=")
+            bgrid = self.make_batched_grid(batch, batch_dims, devices)
+        cfg = self.config
+        ndim = bgrid.space.ndim
+        shape1 = (-1,) + (1,) * ndim
+
+        def lane_local(Ub_l, Upb_l, C2l, Hb_l, dt2_l, *invd2_l):
+            pad = exchange_halo_batched(Ub_l, bgrid,
+                                        wire_mode=cfg.wire_mode)
+
+            def lane(Ul, Upl, padl, Hl, a, *gs):
+                new = wave_step_padded_geom(padl, Upl, C2l, a, gs)
+                return jnp.where(Hl, Ul, new)
+
+            return jax.vmap(lane)(Ub_l, Upb_l, pad, Hb_l, dt2_l,
+                                  *invd2_l)
+
+        inner = shard_map(
+            lane_local,
+            mesh=bgrid.mesh,
+            in_specs=(bgrid.spec, bgrid.spec, bgrid.aux_spec,
+                      bgrid.spec, bgrid.batch_spec)
+            + (bgrid.batch_spec,) * ndim,
+            out_specs=bgrid.spec,
+            check_vma=False,
+        )
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def advance(Ub, Upb, C2, hold, dt2, inv_d2, lane_steps, n):
+            def body(i, s):
+                U, Up = s
+                newU = inner(U, Up, C2, hold, dt2, *inv_d2)
+                active = (i < lane_steps).reshape(shape1)
+                return (
+                    jnp.where(active, newU, U),
+                    jnp.where(active, U, Up),
+                )
+
+            return lax.fori_loop(0, n, body, (Ub, Upb))
+
+        return advance, bgrid
+
     def advance_fn(self, variant: str = "perf"):
         """jitted (U, Uprev, C2, n) -> (U after n steps, U after n-1)."""
         step, prep = self._step(variant)

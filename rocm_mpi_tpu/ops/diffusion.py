@@ -84,6 +84,44 @@ def step_fused_padded(Tp, Cp, lam, dt, spacing):
     return Tp[core] + dt * lam / Cp * lap
 
 
+def step_fused_padded_geom(Tp, Cp, dt_lam, inv_d2):
+    """`step_fused_padded` with the geometry PRECOMPUTED as operands:
+    `dt_lam` = dt·λ (host-multiplied in the compute dtype, exactly the
+    trace-time constant fold above) and `inv_d2` = per-axis 1/spacing²
+    as the CORRECTLY-ROUNDED reciprocal of the in-dtype spacing². This
+    is the ladder lane kernel: a laddered batch carries per-lane dt·λ
+    and 1/spacing², so one compiled program serves lanes whose ORIGINAL
+    shapes — hence dt and spacing — differ, bitwise-equal to each
+    lane's standalone run.
+
+    The reciprocal MULTIPLY (not a divide) is load-bearing for that
+    bitwise pin: XLA strength-reduces `x / const` into `x * (1/const)`
+    with the reciprocal rounded once, but a division by a traced
+    OPERAND stays a true divide — same algebra, different rounding. A
+    multiply, by contrast, is the identical instruction whether the
+    scalar arrives folded or as an operand, so the host precomputes
+    exactly the reciprocal XLA would have folded (serving adapters'
+    ladder_geom: f32(1 / f64(f32(s·s)))) and both paths agree to the
+    bit. Computing dt·λ or the reciprocal traced instead would also
+    drift a ulp from the f64-then-cast standalone constants.
+
+    `inv_d2` is a TUPLE of per-axis scalars, not an indexed (ndim,)
+    vector: a vector gather inside a fori_loop body fuses differently
+    from the folded-constant form (measured: 1-ulp drift on CPU) while
+    separate scalar operands compile to the identical multiplies —
+    models' batched_ladder_advance_fn threads them as distinct
+    shard_map/vmap operands for exactly this reason.
+    """
+    ndim = Cp.ndim
+    core = tuple(slice(1, -1) for _ in range(ndim))
+    lap = jnp.zeros_like(Cp)
+    for ax in range(ndim):
+        hi = tuple(slice(2, None) if a == ax else slice(1, -1) for a in range(ndim))
+        lo = tuple(slice(None, -2) if a == ax else slice(1, -1) for a in range(ndim))
+        lap = lap + (Tp[hi] - 2.0 * Tp[core] + Tp[lo]) * inv_d2[ax]
+    return Tp[core] + dt_lam / Cp * lap
+
+
 def step_cm_padded(Tp, Cm, spacing):
     """Candidate fused update under the Cm contract (pure jnp): `Tp` is
     the width-1-padded block, `Cm` the PREPARED masked coefficient —

@@ -50,6 +50,21 @@ def wave_step_padded(Up, Uprev, C2, dt, spacing):
     )
 
 
+def wave_step_padded_geom(Up, Uprev, C2, dt2, inv_d2):
+    """`wave_step_padded` with the geometry PRECOMPUTED as operands:
+    `dt2` = dt² and `inv_d2` = per-axis 1/spacing², both computed on the
+    HOST in f64 then cast — the same rounding the python-float path
+    above hits at its weak-typed casts. The ladder lane kernel: a
+    laddered batch carries per-lane dt²/inv-spacing² so one compiled
+    program serves lanes of different original shapes bitwise-equal to
+    their standalone runs (ops.diffusion.step_fused_padded_geom has the
+    ulp rationale)."""
+    core = tuple(slice(1, -1) for _ in range(C2.ndim))
+    return 2.0 * Up[core] - Uprev + dt2 * C2 * _lap_from_padded(
+        Up, inv_d2
+    )
+
+
 def _wave_kernel_whole(Up_ref, Uprev_ref, C2_ref, out_ref, *, dt2, inv_d2):
     Up, Uprev, C2 = _upcast_for_compute(Up_ref[:], Uprev_ref[:], C2_ref[:])
     core = tuple(slice(1, -1) for _ in range(Up.ndim))

@@ -541,6 +541,58 @@ def audit_batched(local: int = DEFAULT_LOCAL, dims=(2, 1),
         cost_analysis_bytes=raw, budget=hide_tolerance,
     ))
 
+    # The ladder row (docs/SERVING.md "Continuous batching"): the
+    # rung-shaped ladder program carrying lanes whose ORIGINAL domains
+    # sit 2 cells shy of the rung per axis — the padding class the
+    # shape-padding ladder deliberately admits to consolidate program
+    # classes. Audited against the ORIGINAL domains' live-cell ideal,
+    # so the ratio prices the padded cells the rung ships on top of the
+    # batched program's slack; the budget is batch_tolerance × (1 +
+    # padded_flops_tolerance) — a rung whose embedding inflates FLOPs
+    # past the committed tolerance fails here, the same split rule
+    # serving/bins.ladder_shape enforces at admission. The row runs the
+    # f32 program class because that is the only one the service admits
+    # to the ladder (lossless f32 wire is an eligibility rule). Measured
+    # 2.04 on the gate geometry (126×62 → 128×64 rung, 4.9% cell
+    # inflation) vs the 3.0 budget.
+    pf_tol = serving.get("padded_flops_tolerance")
+    if pf_tol is not None:
+        from rocm_mpi_tpu.serving.bins import ladder_shape
+
+        orig = tuple(s - 2 for s in cfg.global_shape)
+        rung = ladder_shape(orig, tolerance=float(pf_tol))
+        cfg_l = dataclasses.replace(cfg, global_shape=rung, dtype="f32")
+        model_l = HeatDiffusion(cfg_l)
+        ty = cfg_l.jax_dtype
+        item_l = jax.numpy.dtype(ty).itemsize
+        bgrid = model_l.make_batched_grid(batch, batch_dims=1)
+        step = jax.jit(model_l.batched_ladder_step_fn(bgrid),
+                       donate_argnums=0)
+        Tb = jax.device_put(
+            np.zeros((batch,) + rung, ty), bgrid.sharding)
+        Cb = jax.device_put(np.ones(rung, ty), bgrid.aux_sharding)
+        hold = jax.device_put(
+            np.zeros((batch,) + rung, bool), bgrid.sharding)
+        dtlam = jax.device_put(np.ones(batch, ty), bgrid.batch_sharding)
+        invd2 = tuple(
+            jax.device_put(np.ones(batch, ty), bgrid.batch_sharding)
+            for _ in range(len(rung)))
+        measured, wire, raw = _modeled_bytes(
+            step, Tb, Cb, hold, dtlam, *invd2)
+        orig_local = tuple(o // d for o, d in zip(orig, dims))
+        rows.append(TrafficRow(
+            variant=f"ladder{batch}", steps=1,
+            measured_bytes=measured,
+            ideal_bytes=ideal_batched_step_bytes(
+                orig_local, item_l, batch),
+            wire_bytes=wire,
+            wire_ideal=batch * exchange_nbytes(
+                model_l.grid.local_shape, item_l, 1),
+            cost_analysis_bytes=raw,
+            budget=(None if tolerance is None
+                    else float(tolerance) * (1.0 + float(pf_tol))),
+        ))
+
     if include_batch_fixture:
         # The doctored row: a 4-wide program with ONE live lane — the
         # machine executes 4 lanes of bytes for 1 lane of work. Audited

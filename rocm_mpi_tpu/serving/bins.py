@@ -42,6 +42,15 @@ BIN_MANIFEST_VERSION = 1
 
 DEFAULT_MAX_WIDTH = 8
 DEFAULT_OCCUPANCY_FLOOR = 0.5
+# The shape-padding ladder (docs/SERVING.md "Continuous batching"):
+# rung quantum = pow2_floor(n) / LADDER_QUANTUM_FRACTION per axis (min
+# LADDER_MIN_QUANTUM cells), so rungs get coarser as shapes grow — the
+# space edition of steps_bucket's pow2 coarsening, but with a bounded
+# per-axis inflation of at most one quantum. The committed FLOPs bound
+# lives in perf/budgets.json "serving"/"padded_flops_tolerance".
+LADDER_QUANTUM_FRACTION = 4
+LADDER_MIN_QUANTUM = 4
+DEFAULT_LADDER_TOLERANCE = 0.25
 
 
 def steps_bucket(nt: int) -> int:
@@ -100,10 +109,14 @@ class BinKey:
         )
 
 
-def bin_key(req: Request) -> BinKey:
+def bin_key(req: Request,
+            ladder_tolerance: float | None = None) -> BinKey:
     """The request's bin: every trace-identity field, physics sorted so
-    spelling order can't split a bin."""
-    return BinKey(
+    spelling order can't split a bin. With `ladder_tolerance` set, the
+    shape field is laddered up a rung (`ladder_shape`) so near-rung
+    shape classes MERGE into one program class — the caller (the
+    service) decides eligibility; this stays the pure shape mapper."""
+    key = BinKey(
         workload=req.workload,
         shape=tuple(req.global_shape),
         dtype=req.dtype,
@@ -112,6 +125,57 @@ def bin_key(req: Request) -> BinKey:
         wire_mode=req.wire_mode,
         steps_bucket=steps_bucket(req.nt),
     )
+    if ladder_tolerance is not None:
+        padded = ladder_shape(key.shape, ladder_tolerance)
+        if padded != key.shape:
+            key = dataclasses.replace(key, shape=padded)
+    return key
+
+
+def ladder_rung(n: int) -> int:
+    """The smallest ladder rung >= n: the next multiple of the rung
+    quantum `max(LADDER_MIN_QUANTUM, pow2_floor(n) //
+    LADDER_QUANTUM_FRACTION)`. Like `steps_bucket`, rungs coarsen with
+    size, but the per-axis inflation is bounded by ONE quantum (at most
+    ~1/LADDER_QUANTUM_FRACTION of the axis), so the FLOPs cost of a
+    merge stays small enough for the tolerance gate to accept most of
+    the traffic it consolidates."""
+    if n < 1:
+        raise ValueError(f"axis size must be >= 1, got {n}")
+    q = max(LADDER_MIN_QUANTUM, pow2_floor(n) // LADDER_QUANTUM_FRACTION)
+    return ((n + q - 1) // q) * q
+
+
+def ladder_inflation(shape, padded) -> float:
+    """Fractional padded-FLOPs cost of serving `shape` embedded in
+    `padded`: cells(padded)/cells(shape) - 1 (a per-step stencil's work
+    is proportional to cells)."""
+    orig = 1
+    pad = 1
+    for a, b in zip(shape, padded):
+        orig *= int(a)
+        pad *= int(b)
+    return pad / orig - 1.0
+
+
+def ladder_shape(shape, tolerance: float = DEFAULT_LADDER_TOLERANCE,
+                 ) -> tuple[int, ...]:
+    """Pad every space axis up to its ladder rung — IF the total
+    padded-FLOPs inflation stays within `tolerance`; otherwise return
+    the shape unchanged (the bin keeps its exact shape class: the
+    split-instead-of-pad rule, the shape edition of the occupancy
+    floor's split). Deterministic — every controller maps a shape to
+    the same rung."""
+    if tolerance < 0.0:
+        raise ValueError(
+            f"padded_flops_tolerance must be >= 0, got {tolerance}"
+        )
+    padded = tuple(ladder_rung(int(n)) for n in shape)
+    if padded == tuple(int(n) for n in shape):
+        return tuple(int(n) for n in shape)
+    if ladder_inflation(shape, padded) > tolerance:
+        return tuple(int(n) for n in shape)
+    return padded
 
 
 def pow2_width(n: int, max_width: int) -> int:
@@ -183,6 +247,14 @@ class BinStats:
     useful_steps: int = 0
     machine_steps: int = 0
     splits: int = 0
+    # Continuous-drain extras (docs/SERVING.md "Continuous batching"):
+    # lanes swapped in at segment boundaries, segments executed, and the
+    # ladder's cell accounting — cells are steps-weighted so a short
+    # laddered lane can't dominate the waste of a long exact one.
+    swaps_in: int = 0
+    segments: int = 0
+    cells_useful: int = 0
+    cells_machine: int = 0
 
     @property
     def occupancy(self) -> float:
@@ -196,8 +268,26 @@ class BinStats:
             return 0.0
         return 1.0 - self.useful_steps / self.machine_steps
 
+    @property
+    def ladder_waste(self) -> float:
+        """1 − useful/machine CELLS (steps-weighted): the fraction of
+        executed stencil work spent on ladder shape padding. Distinct
+        from `padding_waste`, which counts idle-lane and frozen-tail
+        STEP padding — a bin can have ladder waste with zero width
+        waste and vice versa."""
+        if not self.cells_machine:
+            return 0.0
+        return 1.0 - self.cells_useful / self.cells_machine
+
+    def _note_cells(self, lane_nts, lane_cells) -> None:
+        for nt, (orig_cells, padded_cells) in zip(lane_nts, lane_cells):
+            self.cells_useful += int(orig_cells) * int(nt)
+            self.cells_machine += int(padded_cells) * int(nt)
+
     def note_batch(self, width: int, lane_nts: list[int],
-                   executed_steps: int, split: bool = False) -> None:
+                   executed_steps: int, split: bool = False,
+                   lane_cells: list[tuple[int, int]] | None = None,
+                   ) -> None:
         self.batches += 1
         self.widths = tuple(sorted(set(self.widths) | {width}))
         self.lanes += width
@@ -207,6 +297,33 @@ class BinStats:
         self.machine_steps += width * executed_steps
         if split:
             self.splits += 1
+        if lane_cells is not None:
+            self._note_cells(lane_nts, lane_cells)
+
+    def note_continuous(self, width: int, lane_nts: list[int],
+                        executed_steps: int, swaps_in: int,
+                        segments: int, split: bool = False,
+                        lane_cells: list[tuple[int, int]] | None = None,
+                        ) -> None:
+        """Accounting for one segmented (continuous) batch: `lane_nts`
+        lists every tenant that rode the batch — possibly MORE than
+        `width`, since slots are re-seated at segment boundaries — so
+        slot occupancy caps `live_lanes` at the compiled width (the
+        manifest bounds occupancy to [0, 1]); the machine denominator
+        is still width x executed machine steps."""
+        self.batches += 1
+        self.widths = tuple(sorted(set(self.widths) | {width}))
+        self.lanes += width
+        self.live_lanes += min(len(lane_nts), width)
+        self.requests += len(lane_nts)
+        self.useful_steps += sum(lane_nts)
+        self.machine_steps += width * executed_steps
+        self.swaps_in += int(swaps_in)
+        self.segments += int(segments)
+        if split:
+            self.splits += 1
+        if lane_cells is not None:
+            self._note_cells(lane_nts, lane_cells)
 
 
 def manifest_doc(stats: dict, programs: list[str],
@@ -219,7 +336,7 @@ def manifest_doc(stats: dict, programs: list[str],
     steady-state contract."""
     rows = []
     for key, st in sorted(stats.items(), key=lambda kv: kv[0]):
-        rows.append({
+        row = {
             "key": key.key_str() if isinstance(key, BinKey) else str(key),
             "requests": st.requests,
             "batches": st.batches,
@@ -227,7 +344,13 @@ def manifest_doc(stats: dict, programs: list[str],
             "occupancy": round(st.occupancy, 4),
             "padding_waste": round(st.padding_waste, 4),
             "splits": st.splits,
-        })
+        }
+        if st.swaps_in or st.segments:
+            row["swaps_in"] = st.swaps_in
+            row["segments"] = st.segments
+        if st.cells_machine:
+            row["ladder_waste"] = round(st.ladder_waste, 4)
+        rows.append(row)
     doc = {
         "schema": BIN_MANIFEST_SCHEMA,
         "v": BIN_MANIFEST_VERSION,
@@ -278,6 +401,20 @@ def validate_manifest_doc(doc: dict) -> list[str]:
             if not isinstance(v, (int, float)) or isinstance(v, bool) \
                     or not 0.0 <= v <= 1.0:
                 problems.append(f"bins[{i}].{field} outside [0, 1]")
+        # Continuous/ladder row extras are optional (archived manifests
+        # predate them) but must be well-formed when present.
+        for field in ("swaps_in", "segments"):
+            v = row.get(field)
+            if v is not None and (
+                not isinstance(v, int) or isinstance(v, bool) or v < 0
+            ):
+                problems.append(f"bins[{i}].{field} not a count")
+        lw = row.get("ladder_waste")
+        if lw is not None and (
+            not isinstance(lw, (int, float)) or isinstance(lw, bool)
+            or not 0.0 <= lw <= 1.0
+        ):
+            problems.append(f"bins[{i}].ladder_waste outside [0, 1]")
     progs = doc.get("programs")
     if not isinstance(progs, list) or not all(
         isinstance(p, str) for p in progs
@@ -321,6 +458,36 @@ def validate_manifest_doc(doc: dict) -> list[str]:
                         f"pipeline.{field} {v!r} not a non-negative "
                         "wall"
                     )
+    cont = doc.get("continuous")
+    if cont is not None:
+        # The continuous-drain block (docs/SERVING.md "Continuous
+        # batching"): segment count knob, executed segments, the swap
+        # counters, and the step-weighted occupancy the regress gate
+        # floors — a doctored occupancy outside [0, 1] or a zero
+        # segments knob must fail the schema check.
+        if not isinstance(cont, dict):
+            problems.append("'continuous' block is not an object")
+        else:
+            segs = cont.get("segments")
+            if not isinstance(segs, int) or isinstance(segs, bool) \
+                    or segs < 1:
+                problems.append(
+                    f"continuous.segments {segs!r} not >= 1"
+                )
+            for field in ("batches", "segments_run", "swaps_in",
+                          "swaps_out"):
+                v = cont.get(field)
+                if not isinstance(v, int) or isinstance(v, bool) \
+                        or v < 0:
+                    problems.append(
+                        f"continuous.{field} {v!r} not a count"
+                    )
+            occ = cont.get("occupancy")
+            if not isinstance(occ, (int, float)) \
+                    or isinstance(occ, bool) or not 0.0 <= occ <= 1.0:
+                problems.append(
+                    f"continuous.occupancy {occ!r} outside [0, 1]"
+                )
     queue = doc.get("queue")
     if queue is not None:
         if not isinstance(queue, dict):

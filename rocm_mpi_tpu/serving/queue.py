@@ -579,6 +579,50 @@ class RequestQueue:
             t._mark("running")
         return popped
 
+    def pop_matching(self, pred, max_n: int | None = None,
+                     ) -> list[Ticket]:
+        """Pop up to `max_n` eligible pending tickets whose REQUEST
+        satisfies `pred` — the continuous drain's swap-in feed
+        (docs/SERVING.md "Continuous batching"): at a segment boundary
+        the service pulls queued requests of the batch's own program
+        class into freed lanes, leaving everything else parked in
+        place. Same SLO semantics as `pop_pending` (deadline expiry and
+        retry backoff land here, skipped with `wall_slo` off), same
+        order pin (requeued front first, submission order), and popped
+        tickets are marked running."""
+        now = time.monotonic()
+        expired: list[Ticket] = []
+        popped: list[Ticket] = []
+        with self._lock:
+            self._front.sort(key=lambda t: t.ordinal)
+            budget = (len(self._front) + len(self._pending)) \
+                if max_n is None else int(max_n)
+            for lst in (self._front, self._pending):
+                keep: list[Ticket] = []
+                for t in lst:
+                    d = t.request.deadline_s
+                    if self.wall_slo and d is not None \
+                            and now - t.submitted_mono >= d:
+                        expired.append(t)
+                    elif len(popped) < budget and pred(t.request) and (
+                        not self.wall_slo or t.not_before <= now
+                    ):
+                        popped.append(t)
+                    else:
+                        keep.append(t)
+                lst[:] = keep
+            self.expired += len(expired)
+            self._expired_log.extend(expired)
+        for t in expired:
+            t._terminal_fail(
+                "expired",
+                f"deadline-exceeded: pending {t.age_s(now):.2f}s > "
+                f"deadline_s {t.request.deadline_s}",
+            )
+        for t in popped:
+            t._mark("running")
+        return popped
+
     def take_expired(self) -> list[Ticket]:
         """Drain the newly-expired tickets (the service emits their
         telemetry events and flight counters from here)."""

@@ -129,12 +129,34 @@ class ServeConfig:
     # hazard class; tests/analysis_fixtures/gl02_serving_pos.py). Used
     # by drills to inject deterministic host-stage latency.
     stage_hooks: dict | None = None
+    # Continuous batching (docs/SERVING.md "Continuous batching"):
+    # segments > 1 executes each batch as K fixed-size step segments of
+    # ONE compiled program (segment length = steps_bucket // segments),
+    # swapping resolved lanes out and queued same-class requests in at
+    # segment boundaries — no recompile, every lane bitwise-equal to
+    # its standalone run. 1 (the default) is the legacy
+    # batch-synchronous drain. Single-controller only (swap-in decisions
+    # read the local queue mid-drain); multi-controller services fall
+    # back to batch-synchronous.
+    segments: int = 1
+    # The shape-padding ladder: pad eligible requests' space dims up a
+    # rung (bins.ladder_shape) so near-rung shape classes share ONE
+    # compiled program, within the committed padded-FLOPs tolerance
+    # (None -> budgets "serving"/"padded_flops_tolerance" row).
+    ladder: bool = False
+    ladder_tolerance: float | None = None
 
     def resolved_floor(self) -> float:
         if self.occupancy_floor is not None:
             return float(self.occupancy_floor)
         row = load_serving_budgets().get("occupancy_floor")
         return float(row) if row else _bins.DEFAULT_OCCUPANCY_FLOOR
+
+    def resolved_ladder_tolerance(self) -> float:
+        if self.ladder_tolerance is not None:
+            return float(self.ladder_tolerance)
+        row = load_serving_budgets().get("padded_flops_tolerance")
+        return float(row) if row else _bins.DEFAULT_LADDER_TOLERANCE
 
 
 @dataclasses.dataclass
@@ -153,6 +175,7 @@ class ServeReport:
     compiles: dict = dataclasses.field(default_factory=dict)
     elastic: list = dataclasses.field(default_factory=list)
     pipeline: dict = dataclasses.field(default_factory=dict)
+    continuous: dict = dataclasses.field(default_factory=dict)
 
     @property
     def n_bins(self) -> int:
@@ -163,21 +186,24 @@ class ServeReport:
         return len(self.programs)
 
     def manifest_doc(self, queue_counters=None) -> dict:
+        extra = {
+            "served": self.served,
+            "failed": self.failed,
+            "requeued": self.requeued,
+            "rejected": self.rejected,
+            "expired": self.expired,
+            "quarantined": self.quarantined,
+            "preempted": self.preempted,
+            "elastic": list(self.elastic),
+            "compiles": dict(self.compiles),
+            "pipeline": dict(self.pipeline),
+        }
+        if self.continuous:
+            extra["continuous"] = dict(self.continuous)
         return _bins.manifest_doc(
             self.bins, list(self.programs),
             queue_counters=queue_counters,
-            extra={
-                "served": self.served,
-                "failed": self.failed,
-                "requeued": self.requeued,
-                "rejected": self.rejected,
-                "expired": self.expired,
-                "quarantined": self.quarantined,
-                "preempted": self.preempted,
-                "elastic": list(self.elastic),
-                "compiles": dict(self.compiles),
-                "pipeline": dict(self.pipeline),
-            },
+            extra=extra,
         )
 
 
@@ -224,12 +250,17 @@ class _Program:
     device-side lane initializer (scales → batched leaves) the
     multi-controller path uses instead."""
 
-    def __init__(self, advance, bgrid, aux, base_dev, adapter):
+    def __init__(self, advance, bgrid, aux, base_dev, adapter,
+                 ladder: bool = False):
         self.advance = advance
         self.bgrid = bgrid
         self.aux = aux  # device aux operand(s), lane-shared
         self.base_dev = tuple(base_dev)
         self.adapter = adapter
+        # Ladder program: the advance takes per-lane geometry operands
+        # (hold mask, dt terms, spacing terms) so lanes of different
+        # ORIGINAL shapes share this one compiled class.
+        self.ladder = bool(ladder)
         self._base_np = None
         self._init = None
         self._finite = None
@@ -312,6 +343,12 @@ class _Adapter:
     session-checkpoint pytree is exactly `leaves`)."""
 
     name: str = ""
+    # Ladder support (docs/SERVING.md "Continuous batching"): a
+    # workload opts in by providing build_ladder/run_ladder/
+    # ladder_state_leaves/ladder_geom. SWE stays out — its face-mask
+    # aux is geometry-DEPENDENT (per-axis staggered masks derived from
+    # the exact domain), so embedded lanes cannot share one aux.
+    supports_ladder: bool = False
 
     def make_config(self, key: BinKey, space_dims):
         raise NotImplementedError
@@ -326,6 +363,30 @@ class _Adapter:
     def run(self, prog: _Program, leaves_dev, lane_steps_dev, n):
         """-> tuple of advanced state leaves (device)."""
         raise NotImplementedError
+
+    def build_ladder(self, model, width, batch_dims):
+        """-> (ladder advance, bgrid, aux_device, base_leaves) for the
+        RUNG-shaped model (per-lane geometry rides operands)."""
+        raise NotImplementedError(f"{self.name} has no ladder support")
+
+    def run_ladder(self, prog: _Program, leaves_dev, hold_dev, a_dev,
+                   g_dev, lane_steps_dev, n):
+        """-> advanced state leaves; `hold_dev` the per-lane hold mask,
+        `a_dev` the per-lane dt term, `g_dev` the per-lane per-axis
+        spacing term (workload-specific; `ladder_geom`)."""
+        raise NotImplementedError(f"{self.name} has no ladder support")
+
+    def ladder_state_leaves(self, model):
+        """Unscaled standard-IC STATE leaves (numpy) of the
+        original-shape model — the per-original-shape IC the service
+        embeds into rung-shaped lanes."""
+        raise NotImplementedError(f"{self.name} has no ladder support")
+
+    def ladder_geom(self, cfg):
+        """(dt_term, per-axis spacing terms) for one lane, computed
+        host-side with exactly the roundings the standalone python-float
+        path produces (ops.diffusion.step_fused_padded_geom)."""
+        raise NotImplementedError(f"{self.name} has no ladder support")
 
 
 class _DiffusionAdapter(_Adapter):
@@ -363,6 +424,50 @@ class _DiffusionAdapter(_Adapter):
         )
         return (out,)
 
+    supports_ladder = True
+
+    def build_ladder(self, model, width, batch_dims):
+        bgrid = model.make_batched_grid(width, batch_dims)
+        advance, _ = model.batched_ladder_advance_fn(bgrid=bgrid)
+        T0, Cp = model.init_state()
+        aux = (_reshard(Cp, bgrid.aux_sharding),)
+        return advance, bgrid, aux, (T0,)
+
+    def run_ladder(self, prog, leaves_dev, hold_dev, a_dev, g_dev,
+                   lane_steps_dev, n):
+        out = prog.advance(
+            leaves_dev[0], prog.aux[0], hold_dev, a_dev, g_dev,
+            lane_steps_dev, n,
+        )
+        return (out,)
+
+    def ladder_state_leaves(self, model):
+        import numpy as np
+
+        T0, _Cp = model.init_state()
+        return (np.asarray(T0),)
+
+    def ladder_geom(self, cfg):
+        # The exact roundings of the python-float standalone path: dt
+        # is cast to the compute dtype BEFORE the λ multiply
+        # (models.diffusion._make_batched_step does jax_dtype(dt), and
+        # dt·λ is then an in-dtype multiply); spacing² is squared in
+        # f64 and weak-cast at the divide
+        # (ops.diffusion.step_fused_padded) — and XLA folds that
+        # divide-by-constant into a multiply by the correctly-rounded
+        # reciprocal, so the geom operand is EXACTLY that reciprocal
+        # (step_fused_padded_geom's bitwise contract).
+        import numpy as np
+
+        ty = np.dtype(cfg.jax_dtype)
+        dtv = ty.type(cfg.dt)
+        a = ty.type(dtv * ty.type(cfg.lam))
+        g = tuple(
+            ty.type(1.0 / float(ty.type(float(s) * float(s))))
+            for s in cfg.spacing
+        )
+        return a, g
+
 
 class _WaveAdapter(_Adapter):
     name = "wave"
@@ -398,6 +503,43 @@ class _WaveAdapter(_Adapter):
             leaves_dev[0], leaves_dev[1], prog.aux[0], lane_steps_dev, n
         )
         return (U, Up)
+
+    supports_ladder = True
+
+    def build_ladder(self, model, width, batch_dims):
+        bgrid = model.make_batched_grid(width, batch_dims)
+        advance, _ = model.batched_ladder_advance_fn(bgrid=bgrid)
+        U0, Up0, C2 = model.init_state()
+        aux = (_reshard(C2, bgrid.aux_sharding),)
+        return advance, bgrid, aux, (U0, Up0)
+
+    def run_ladder(self, prog, leaves_dev, hold_dev, a_dev, g_dev,
+                   lane_steps_dev, n):
+        U, Up = prog.advance(
+            leaves_dev[0], leaves_dev[1], prog.aux[0], hold_dev,
+            a_dev, g_dev, lane_steps_dev, n,
+        )
+        return (U, Up)
+
+    def ladder_state_leaves(self, model):
+        import numpy as np
+
+        U0, Up0, _C2 = model.init_state()
+        return (np.asarray(U0), np.asarray(Up0))
+
+    def ladder_geom(self, cfg):
+        # dt² in the compute dtype (the standalone path casts dt first,
+        # then squares in-trace); 1/spacing² in f64 then cast
+        # (ops.wave_kernels.wave_step_padded).
+        import numpy as np
+
+        ty = np.dtype(cfg.jax_dtype)
+        dtv = ty.type(cfg.dt)
+        a = ty.type(dtv * dtv)
+        g = tuple(
+            ty.type(1.0 / (float(s) * float(s))) for s in cfg.spacing
+        )
+        return a, g
 
 
 class _SWEAdapter(_Adapter):
@@ -540,6 +682,18 @@ class SimulationService:
         self._batch_dims = int(self.config.batch_dims)
         self._models: dict = {}
         self._programs: dict[str, _Program] = {}
+        self._ladder_tol = self.config.resolved_ladder_tolerance()
+        self._ladder_bases: dict = {}  # per-original-shape IC leaves
+        # Continuous-drain lifetime accounting (the manifest
+        # `continuous` block): executed segmented batches/segments, the
+        # swap counters, and the step-weighted occupancy numerator/
+        # denominator the per-drain serve.occupancy gauge is cut from.
+        self._continuous = {
+            "batches": 0, "segments_run": 0, "swaps_in": 0,
+            "swaps_out": 0, "occ_num": 0, "occ_den": 0,
+        }
+        self._drain_swaps = 0        # per-drain swap-ins (gauge)
+        self._drain_occ = [0, 0]     # per-drain occupancy num/den
         self._stats: dict[BinKey, BinStats] = {}
         self._breakers: dict[BinKey, _Breaker] = {}
         self._elastic: list[dict] = []
@@ -611,11 +765,14 @@ class SimulationService:
             self._models[mkey] = model
         return model
 
-    def program_key(self, key: BinKey, width: int) -> str:
-        return f"{key.key_str()}|w{width}|bd{self._batch_dims}"
+    def program_key(self, key: BinKey, width: int,
+                    ladder: bool = False) -> str:
+        base = f"{key.key_str()}|w{width}|bd{self._batch_dims}"
+        return base + "|ladder" if ladder else base
 
-    def _program_for(self, key: BinKey, width: int) -> _Program:
-        pkey = self.program_key(key, width)
+    def _program_for(self, key: BinKey, width: int,
+                     ladder: bool = False) -> _Program:
+        pkey = self.program_key(key, width, ladder)
         prog = self._programs.get(pkey)
         if prog is None:
             from rocm_mpi_tpu import telemetry
@@ -634,12 +791,102 @@ class SimulationService:
             bd = _bins.pow2_floor(min(width, self._batch_dims))
             with telemetry.span("serve.compile", phase="serve",
                                 bin=key.key_str(), width=width):
-                advance, bgrid, aux, base = adapter.build(
-                    model, width, bd, variant=key.variant
-                )
-            prog = _Program(advance, bgrid, aux, base, adapter)
+                if ladder:
+                    advance, bgrid, aux, base = adapter.build_ladder(
+                        model, width, bd
+                    )
+                else:
+                    advance, bgrid, aux, base = adapter.build(
+                        model, width, bd, variant=key.variant
+                    )
+            prog = _Program(advance, bgrid, aux, base, adapter,
+                            ladder=ladder)
             self._programs[pkey] = prog
         return prog
+
+    # ---- the shape-padding ladder (docs/SERVING.md) ---------------------
+
+    def _ladder_eligible(self, req: Request) -> bool:
+        """May this request ride a ladder program? Workloads with
+        geometry-independent aux ('diffusion', 'wave' — SWE's face
+        masks are domain-derived), the 'shard' variant (the one whose
+        batched advance has a ladder twin), the lossless 'f32' wire
+        (lossy codecs quantize at shard boundaries, which MOVE under
+        padding), no sessions (checkpoints are exact-shape), and
+        single-controller (the per-lane host embedding path)."""
+        return (
+            bool(self.config.ladder)
+            and _ADAPTERS[req.workload].supports_ladder
+            and req.variant == "shard"
+            and req.wire_mode == "f32"
+            and not req.session
+            and not req.resume
+            and not self._is_multi()
+        )
+
+    def _group_key(self, req: Request) -> tuple[BinKey, bool]:
+        """(bin key, rides-the-ladder) — the drain's grouping key. An
+        eligible request's shape field is laddered up a rung, so
+        near-rung shape classes MERGE; ladder and non-ladder traffic of
+        the same BinKey stay separate groups (different compiled
+        programs)."""
+        if self._ladder_eligible(req):
+            return (
+                _bins.bin_key(req, ladder_tolerance=self._ladder_tol),
+                True,
+            )
+        return _bins.bin_key(req), False
+
+    def _ladder_base_np(self, key: BinKey, orig_shape: tuple):
+        """Unscaled standard-IC state leaves (numpy) at `orig_shape` —
+        built from the ORIGINAL-shape model, cached per shape class.
+        The first request of a new original shape compiles that
+        model's IC initializer: a legitimate NEW-class compile (the
+        window opens exactly like _program_for's), documented under
+        "what still recompiles"."""
+        okey = dataclasses.replace(key, shape=tuple(orig_shape))
+        ckey = (okey.workload, okey.shape, okey.dtype, okey.physics,
+                okey.wire_mode)
+        base = self._ladder_bases.get(ckey)
+        if base is None:
+            from rocm_mpi_tpu import telemetry
+            from rocm_mpi_tpu.telemetry import compiles
+
+            compiles.unmark_steady()
+            self._compiled_this_drain = True
+            adapter = _ADAPTERS[okey.workload]
+            model = self._model_for(okey)
+            with telemetry.span("serve.compile", phase="serve",
+                                bin=okey.key_str(), width=0):
+                base = adapter.ladder_state_leaves(model)
+            self._ladder_bases[ckey] = base
+        return base
+
+    def _ladder_lane(self, req: Request, key: BinKey, prog: _Program):
+        """(embedded leaves, hold mask, dt term, spacing terms) for one
+        laddered lane: the original-shape IC (×ic_scale) embedded at
+        the origin corner of a rung-shaped zero block, the hold mask
+        True on the original domain's Dirichlet ring AND everywhere
+        outside it, and the lane's host-precomputed geometry
+        (adapter.ladder_geom). The held ring separates the embedded
+        interior from the padding, so the lane is bitwise-equal to its
+        standalone run."""
+        import numpy as np
+
+        orig = tuple(int(n) for n in req.global_shape)
+        base = self._ladder_base_np(key, orig)
+        okey = dataclasses.replace(key, shape=orig)
+        ocfg = self._model_for(okey).config
+        a, g = prog.adapter.ladder_geom(ocfg)
+        region = tuple(slice(0, n) for n in orig)
+        leaves = []
+        for b, z in zip(base, prog.base_np):
+            e = np.zeros_like(z)
+            e[region] = b * req.ic_scale
+            leaves.append(e)
+        hold = np.ones(prog.base_np[0].shape, dtype=bool)
+        hold[tuple(slice(1, n - 1) for n in orig)] = False
+        return tuple(leaves), hold, a, g
 
     # ---- lane assembly --------------------------------------------------
 
@@ -1077,6 +1324,384 @@ class SimulationService:
         self._stage_hook("resolve", key=key.key_str(), width=width,
                          seq=fl.seq, live=len(live))
 
+    def _run_segmented_batch(self, key: BinKey, tickets: list[Ticket],
+                             width: int, ladder: bool) -> int:
+        """The continuous drain's batch executor (docs/SERVING.md
+        "Continuous batching"): ONE compiled program of `width` lanes
+        executes the whole ticket group as fixed-size step segments
+        (`steps_bucket // segments` steps each). Between segments where
+        no lane finishes, the output chains straight back in ON DEVICE
+        — no host fetch, no bubble; the boundary plan is host-side
+        arithmetic on the remaining-step counts. At a boundary where
+        lanes DO finish, one blocking fetch resolves them (same
+        finiteness/retry/session semantics as _resolve_batch), their
+        slots re-seat from the group's backlog and then the queue's
+        matching arrivals (queue.pop_matching), and the batch
+        continues. Every lane — first-seated or swapped in — is
+        bitwise-equal to its standalone run: the compiled advance
+        freezes a lane at its own `lane_steps`, so K chained segments
+        of the one program ARE the lane's single long run (the PR-9
+        run_segmented discipline folded inside the program). No
+        recompile at any boundary; `compiles.steady_state` stays 0.
+        Single-controller only (drain_once gates). Returns the
+        completed-ticket count."""
+        import jax
+        import numpy as np
+
+        from rocm_mpi_tpu import telemetry
+        from rocm_mpi_tpu.resilience import faults
+        from rocm_mpi_tpu.telemetry import flight
+
+        # The batch-granular fault contract — same sites, same ordering
+        # as _prepare_batch: one seq/progress bump per segmented batch
+        # (segments are sub-batch machinery, not scheduler units).
+        self._batch_seq += 1
+        seq = self._batch_seq
+        faults.fault_point("serve-batch", step=seq)
+        clause = faults.serving_fault("batch-error", step=seq)
+        if clause is not None:
+            raise RuntimeError(f"injected batch-error (batch {seq})")
+        flight.progress(step_inc=1)
+        slow = faults.serving_fault("slow-batch", step=seq)
+        if slow is not None:
+            time.sleep(slow.delay_s)
+
+        prog = self._program_for(key, width, ladder=ladder)
+        bgrid = prog.bgrid
+        seg_len = max(
+            1, key.steps_bucket // max(1, int(self.config.segments))
+        )
+        fetch = self.config.fetch_results
+        if fetch is None:
+            fetch = True  # single-controller by construction
+        gk = (key, ladder)
+        kstr = key.key_str()
+
+        backlog = list(tickets)
+        lane_t: list = [None] * width
+        starts = [0] * width
+        remaining = np.zeros(width, dtype=np.int64)
+        lanes_np: list = [None] * width  # host leaves per seated slot
+        zero = tuple(np.zeros_like(l) for l in prog.base_np)
+        cdtype = prog.base_np_dtype
+        ndim = len(key.shape)
+        hold_rows = [np.ones(zero[0].shape, dtype=bool)
+                     for _ in range(width)]
+        a_rows = np.zeros(width, dtype=cdtype)
+        g_rows = np.ones((width, ndim), dtype=cdtype)
+        padded_cells = 1
+        for nn in key.shape:
+            padded_cells *= int(nn)
+
+        done = 0
+        swaps_in = 0
+        swaps_out = 0
+        segs_run = 0
+        executed = 0  # machine steps (the occupancy denominator rides
+        occ_num = 0   # width × this; the numerator is per-lane useful)
+        tenant_nts: list[int] = []
+        tenant_cells: list[tuple[int, int]] = []
+
+        def seat(j: int, t: Ticket) -> bool:
+            """Host-assemble ticket t into slot j; route its failure
+            (same ValueError-terminal / transient-retry split as
+            _prepare_batch's lane loop) and report success."""
+            try:
+                if ladder:
+                    leaves, hold, a, g = self._ladder_lane(
+                        t.request, key, prog
+                    )
+                    start = 0
+                else:
+                    start = (
+                        self._resume_step(t.request, prog)
+                        if t.request.resume else 0
+                    )
+                    leaves, _ = self._lane_start_state(
+                        t.request, prog, start
+                    )
+            except ValueError as e:
+                self._fail_ticket(t, str(e))
+                return False
+            except Exception as e:  # noqa: BLE001 — tenant isolation
+                self._retry_or_quarantine(t, str(e))
+                return False
+            if faults.serving_fault("lane-nan", request=t.ordinal) \
+                    is not None:
+                leaves = tuple(l * float("nan") for l in leaves)
+            t.start_step = start
+            lane_t[j] = t
+            starts[j] = start
+            remaining[j] = t.request.nt - start
+            lanes_np[j] = leaves
+            if ladder:
+                hold_rows[j] = hold
+                a_rows[j] = a
+                g_rows[j] = np.asarray(g, dtype=cdtype)
+            return True
+
+        def fill(allow_queue: bool) -> int:
+            """Seat every free slot from the backlog, then (daemon
+            arrivals) from same-class queued tickets. Swap eligibility
+            IS the group key: same compiled program, same ladder
+            routing."""
+            n_seated = 0
+            for j in range(width):
+                if lane_t[j] is not None:
+                    continue
+                while lane_t[j] is None and backlog:
+                    seat(j, backlog.pop(0))
+                while lane_t[j] is None and allow_queue:
+                    pulled = self.queue.pop_matching(
+                        lambda r: self._group_key(r) == gk, max_n=1
+                    )
+                    if not pulled:
+                        break
+                    flight.progress(serve_submitted=1)
+                    # Join the batch's ticket roster so a batch-level
+                    # failure (_batch_failed) covers swap-ins too.
+                    tickets.append(pulled[0])
+                    seat(j, pulled[0])
+                if lane_t[j] is not None:
+                    n_seated += 1
+            return n_seated
+
+        def to_device():
+            rows = [
+                lanes_np[j] if lanes_np[j] is not None else zero
+                for j in range(width)
+            ]
+            leaves = tuple(
+                _to_global(
+                    np.stack([rows[j][leaf] for j in range(width)]),
+                    bgrid.sharding,
+                )
+                for leaf in range(prog.n_leaves)
+            )
+            if not ladder:
+                return leaves, ()
+            # inv_d2 uploads as ndim separate per-axis (width,) arrays
+            # — the models' per-axis scalar-operand contract (the
+            # fori-fusion ulp note in step_fused_padded_geom).
+            geom = (
+                _to_global(np.stack(hold_rows), bgrid.sharding),
+                _to_global(np.asarray(a_rows), bgrid.batch_sharding),
+                tuple(
+                    _to_global(
+                        np.ascontiguousarray(g_rows[:, ax]),
+                        bgrid.batch_sharding,
+                    )
+                    for ax in range(ndim)
+                ),
+            )
+            return leaves, geom
+
+        t0 = self._now()
+        with telemetry.span("serve.assemble", phase="serve",
+                            bin=kstr, width=width):
+            fill(allow_queue=False)
+        self._pipe["assemble_s"] += self._now() - t0
+        self._stage_hook(
+            "assemble", key=kstr, width=width, seq=seq,
+            live=sum(1 for t in lane_t if t is not None),
+        )
+
+        leaves_dev = None
+        geom_dev = ()
+        anchors: list = []
+        preempted = False
+        while any(t is not None for t in lane_t):
+            live_j = [j for j in range(width) if lane_t[j] is not None]
+            n_seg = int(min(
+                seg_len, max(int(remaining[j]) for j in live_j)
+            ))
+            n_seg = max(1, n_seg)
+            t0 = self._now()
+            new_flight = leaves_dev is None
+            if new_flight:
+                # One busy-mark per CHAIN (upload .. blocking fetch),
+                # not per segment: chained segments are one continuous
+                # flight, and _note_dispatched/_note_fetched must pair
+                # 1:1 or _inflight_n wedges and the bubble gauge dies.
+                # Marked BEFORE the dispatch span: the pipelined
+                # classic drain preps batch N+1 under batch N's open
+                # window, so its upload wall lands inside busy time.
+                # Segmented chains run serially — marking after the
+                # dispatch span would charge every post-swap upload
+                # as bubble, work the classic path hides for free.
+                self._note_dispatched()
+            with telemetry.span(
+                "serve.dispatch", phase="serve", bin=kstr,
+                width=width, live=len(live_j), steps=n_seg,
+            ):
+                if leaves_dev is None:
+                    leaves_dev, geom_dev = to_device()
+                steps_np = np.clip(remaining, 0, n_seg).astype(np.int32)
+                steps_dev = _to_global(steps_np, bgrid.batch_sharding)
+                if ladder:
+                    out = tuple(prog.adapter.run_ladder(
+                        prog, leaves_dev, *geom_dev, steps_dev, n_seg
+                    ))
+                else:
+                    out = tuple(prog.adapter.run(
+                        prog, leaves_dev, steps_dev, n_seg
+                    ))
+                # Donated-input deletion anchors (_InFlight.anchors has
+                # the hazard): the chained inputs ride here until the
+                # next blocking fetch, when deletion is free.
+                anchors.append((leaves_dev, steps_dev))
+            self._pipe["dispatch_s"] += self._now() - t0
+            self._stage_hook("dispatch", key=kstr, width=width,
+                             seq=seq, live=len(live_j))
+            segs_run += 1
+            executed += n_seg
+            occ_num += sum(
+                min(int(remaining[j]), n_seg) for j in live_j
+            )
+            # The boundary plan is HOST arithmetic — no fetch needed to
+            # know who finished: remaining-step counts are deterministic.
+            finishing = [
+                j for j in live_j if int(remaining[j]) <= n_seg
+            ]
+            for j in live_j:
+                remaining[j] = max(0, int(remaining[j]) - n_seg)
+            if not finishing:
+                # Pure chain: the advance's output feeds the next
+                # segment ON DEVICE. Zero host sync, zero bubble.
+                leaves_dev = out
+                continue
+
+            t0 = self._now()
+            with telemetry.span("serve.fetch", phase="serve",
+                                bin=kstr, width=width):
+                jax.block_until_ready(out)
+                host = tuple(np.asarray(leaf) for leaf in out)
+            anchors.clear()
+            self._pipe["fetch_s"] += self._now() - t0
+            self._note_fetched()
+            self._stage_hook("fetch", key=kstr, width=width, seq=seq,
+                             live=len(live_j))
+
+            t0 = self._now()
+            done_here = 0
+            with telemetry.span("serve.resolve", phase="serve",
+                                bin=kstr, width=width,
+                                live=len(finishing)):
+                for j in finishing:
+                    t = lane_t[j]
+                    nt_run = int(t.request.nt - starts[j])
+                    tenant_nts.append(nt_run)
+                    if ladder:
+                        orig_cells = 1
+                        for nn in t.request.global_shape:
+                            orig_cells *= int(nn)
+                        tenant_cells.append((orig_cells, padded_cells))
+                    finite = all(
+                        bool(np.isfinite(leaf[j]).all())
+                        for leaf in host
+                    )
+                    if not finite:
+                        telemetry.record_event(
+                            "serve.lane.nan",
+                            request_id=t.request.request_id,
+                            bin=kstr, width=width, lane=j,
+                        )
+                        self._retry_or_quarantine(
+                            t, "non-finite state (NaN/Inf) in lane"
+                        )
+                        lane_t[j] = None
+                        lanes_np[j] = None
+                        continue
+                    try:
+                        lane = tuple(leaf[j] for leaf in host)
+                        if ladder:
+                            region = tuple(
+                                slice(0, nn)
+                                for nn in t.request.global_shape
+                            )
+                            lane = tuple(l[region] for l in lane)
+                        if t.request.session:
+                            self._save_session(t, lane, prog)
+                    except ValueError as e:
+                        self._fail_ticket(t, str(e))
+                        lane_t[j] = None
+                        lanes_np[j] = None
+                        continue
+                    except Exception as e:  # noqa: BLE001
+                        self._retry_or_quarantine(t, str(e))
+                        lane_t[j] = None
+                        lanes_np[j] = None
+                        continue
+                    t.steps_run = nt_run
+                    t._resolve(lane if fetch else None)
+                    done_here += 1
+                    latency = t.age_s()
+                    telemetry.record_event(
+                        "serve.request.done",
+                        request_id=t.request.request_id,
+                        bin=kstr, width=width, steps=nt_run,
+                        start=starts[j],
+                        latency_s=round(latency, 6),
+                        deadline_miss=bool(
+                            t.request.deadline_s is not None
+                            and latency > t.request.deadline_s
+                        ),
+                    )
+                    lane_t[j] = None
+                    lanes_np[j] = None
+                self.queue.note_completed(done_here)
+                flight.progress(serve_completed=done_here)
+                done += done_here
+                # Surviving lanes cross the boundary through an exact
+                # host round trip (fetch + re-upload is bitwise).
+                for j in live_j:
+                    if lane_t[j] is not None:
+                        lanes_np[j] = tuple(leaf[j] for leaf in host)
+                # A preemption notice stops SWAP-INS at this boundary
+                # (the batch-boundary analog of the rc-75 contract);
+                # already-seated lanes run to completion.
+                if self._preempt_requested():
+                    preempted = True
+                if not preempted:
+                    k = fill(allow_queue=True)
+                    swaps_in += k
+                if any(t is not None for t in lane_t):
+                    swaps_out += len(finishing)
+            self._pipe["resolve_s"] += self._now() - t0
+            self._stage_hook("resolve", key=kstr, width=width,
+                             seq=seq, live=len(finishing))
+            leaves_dev = None  # re-assemble from host rows next round
+            geom_dev = ()
+
+        if backlog:
+            # Preemption (or a breaker-sized seat drought) left group
+            # tickets unseated: park them back at the queue's front —
+            # the same undispatched-work requeue the classic drain does
+            # at its batch boundary.
+            self.queue.requeue(backlog)
+            flight.progress(serve_requeued=len(backlog))
+
+        st = self._stats.get(key)
+        if st is None:
+            st = self._stats[key] = BinStats(key=key)
+        st.note_continuous(
+            width, tenant_nts, executed, swaps_in, segs_run,
+            lane_cells=tenant_cells if ladder else None,
+        )
+        self._pipe["batches"] += 1
+        c = self._continuous
+        c["batches"] += 1
+        c["segments_run"] += segs_run
+        c["swaps_in"] += swaps_in
+        c["swaps_out"] += swaps_out
+        c["occ_num"] += occ_num
+        c["occ_den"] += width * executed
+        self._drain_swaps += swaps_in
+        self._drain_occ[0] += occ_num
+        self._drain_occ[1] += width * executed
+        self._sync_admission_counters()
+        return done
+
     def _batch_failed(self, key: BinKey, batch_ts: list[Ticket],
                       width: int, e: Exception) -> None:
         """The batch-level failure chokepoint (tenant isolation): a
@@ -1278,25 +1903,37 @@ class SimulationService:
         self._idle_drains = 0
         flight.progress(serve_submitted=len(tickets))
         self._compiled_this_drain = False
+        self._drain_swaps = 0
+        self._drain_occ = [0, 0]
 
-        groups: dict[BinKey, list[Ticket]] = {}
+        # Groups are keyed (BinKey, ladder): the ladder bool separates
+        # the padded-program route from the exact route so a ladder-
+        # ineligible request (session, lossy wire, multi) sharing the
+        # BinKey never collides with the laddered program class.
+        groups: dict[tuple[BinKey, bool], list[Ticket]] = {}
         bad: list[tuple[Ticket, str]] = []
         for t in tickets:
             try:
-                groups.setdefault(_bins.bin_key(t.request), []).append(t)
+                groups.setdefault(self._group_key(t.request),
+                                  []).append(t)
             except ValueError as e:
                 bad.append((t, str(e)))
         for t, msg in bad:
             self._fail_ticket(t, msg)
 
         served = 0
-        pending: list[tuple[BinKey, list[Ticket], int, bool]] = []
-        for key in sorted(groups):
-            ts = groups[key]
+        # (key, tickets, width, split, ladder, segmented)
+        pending: list[tuple] = []
+        multi = self._is_multi()
+        for gk in sorted(groups, key=lambda g: (g[0], g[1])):
+            key, ladder = gk
+            ts = groups[gk]
             # The circuit breaker's admission gate: an OPEN class
             # rejects fast with circuit-open (one failing shape class
             # must not starve every other tenant's throughput); a
             # cooled-down class re-admits exactly ONE half-open probe.
+            # Breakers stay keyed by BinKey: the failure domain is the
+            # shape class, however it is routed.
             br = self._breakers.get(key)
             if br is None:
                 br = self._breakers[key] = _Breaker()
@@ -1313,14 +1950,26 @@ class SimulationService:
                 ts = ts[:admit]
             if not ts:
                 continue
+            segmented = (
+                (int(self.config.segments) > 1 or ladder) and not multi
+            )
             widths = _bins.plan_batches(
                 len(ts), self.config.max_width, self._floor
             )
             canonical = widths[0]
+            if segmented:
+                # The continuous drain runs the WHOLE group as one
+                # segmented batch of the canonical width: overflow
+                # tickets are the swap-in backlog, not separate
+                # (possibly split) batches.
+                pending.append((key, ts, canonical, False, ladder,
+                                True))
+                continue
             i = 0
             for w in widths:
                 take = min(w, len(ts) - i)
-                pending.append((key, ts[i:i + take], w, w != canonical))
+                pending.append((key, ts[i:i + take], w,
+                                w != canonical, ladder, False))
                 i += take
 
         # The drain pipeline (docs/SERVING.md "The pipeline"): at
@@ -1354,18 +2003,40 @@ class SimulationService:
             except Exception as e:  # noqa: BLE001 — tenant isolation
                 self._batch_failed(fkey, fts, fw, e)
 
-        for bi, (key, batch_ts, w, split) in enumerate(pending):
+        for bi, (key, batch_ts, w, split, ladder, segmented) \
+                in enumerate(pending):
             if self._preempt_requested():
                 # Undispatched work requeues at the batch boundary (the
                 # rc-75 contract); already-dispatched batches FINISH in
                 # the tail drain below — in-flight lanes always
                 # complete their batch.
                 preempted = True
-                rest = [t for _, ts2, _, _ in pending[bi:] for t in ts2]
+                rest = [
+                    t for entry in pending[bi:] for t in entry[1]
+                ]
                 self.queue.requeue(rest)
                 flight.progress(serve_requeued=len(rest))
                 break
             br = self._breakers[key]
+            if segmented:
+                # The continuous batch IS its own pipeline (device
+                # chaining between boundaries): flush the classic
+                # in-flight batches first — both for the session
+                # read-after-write ordering and so the two executors
+                # never interleave their busy accounting.
+                while inflight:
+                    _finish(inflight.pop(0))
+                try:
+                    served += self._run_segmented_batch(
+                        key, batch_ts, w, ladder
+                    )
+                    if br.note_success():
+                        telemetry.record_event(
+                            "serve.circuit.close", bin=key.key_str(),
+                        )
+                except Exception as e:  # noqa: BLE001 — tenant isolation
+                    self._batch_failed(key, batch_ts, w, e)
+                continue
             if depth == 1:
                 try:
                     self._execute_batch(key, batch_ts, w, split)
@@ -1420,6 +2091,16 @@ class SimulationService:
             self.last_bubble = bubble
             telemetry.gauge("serve.pipeline_depth", float(depth))
             telemetry.gauge("serve.device_bubble", round(bubble, 4))
+        if self._drain_occ[1]:
+            # Per-drain continuous gauges: step-weighted slot occupancy
+            # (live lane-steps / width × machine steps) and the swap-in
+            # count — the two numbers the continuous-vs-batch-sync
+            # regress gate reads.
+            telemetry.gauge(
+                "serve.occupancy",
+                round(self._drain_occ[0] / self._drain_occ[1], 4),
+            )
+            telemetry.gauge("serve.swap", float(self._drain_swaps))
 
         if not preempted and not self._compiled_this_drain \
                 and self._programs:
@@ -1594,6 +2275,19 @@ class SimulationService:
         report.programs = sorted(self._programs)
         report.elastic = list(self._elastic)
         report.pipeline = self.pipeline_stats()
+        c = self._continuous
+        if c["batches"]:
+            report.continuous = {
+                "segments": max(1, int(self.config.segments)),
+                "batches": c["batches"],
+                "segments_run": c["segments_run"],
+                "swaps_in": c["swaps_in"],
+                "swaps_out": c["swaps_out"],
+                "occupancy": (
+                    round(c["occ_num"] / c["occ_den"], 6)
+                    if c["occ_den"] else 0.0
+                ),
+            }
         snap = compiles.snapshot()
         report.compiles = {
             "total": snap["totals"]["backend_compiles"],
@@ -1605,7 +2299,14 @@ class SimulationService:
             if report.bins:
                 telemetry.gauge(
                     "serve.occupancy",
-                    min(st.occupancy for st in report.bins.values()),
+                    # The continuous drain's step-weighted occupancy is
+                    # the truthful lifetime number when it ran — the
+                    # classic min-over-bins slot occupancy otherwise.
+                    report.continuous["occupancy"]
+                    if report.continuous and c["occ_den"]
+                    else min(
+                        st.occupancy for st in report.bins.values()
+                    ),
                 )
                 telemetry.gauge(
                     "serve.padding_waste",

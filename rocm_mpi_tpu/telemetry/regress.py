@@ -302,6 +302,25 @@ def _validate_perf_budgets(doc: dict) -> list[str]:
                 problems.append(
                     f"serving occupancy_floor {floor!r} outside (0, 1]"
                 )
+            ptol = serving.get("padded_flops_tolerance")
+            if ptol is not None and (
+                not isinstance(ptol, (int, float))
+                or isinstance(ptol, bool) or ptol < 0.0
+            ):
+                problems.append(
+                    f"serving padded_flops_tolerance {ptol!r} must be "
+                    ">= 0 (the ladder's padded-FLOPs inflation cap; 0 "
+                    "admits only exact-rung shapes)"
+                )
+            occ = serving.get("occupancy")
+            if occ is not None and (
+                not isinstance(occ, (int, float))
+                or isinstance(occ, bool) or not 0.0 < occ <= 1.0
+            ):
+                problems.append(
+                    f"serving occupancy {occ!r} outside (0, 1] (the "
+                    "continuous drain's step-weighted occupancy floor)"
+                )
     wire = doc.get("wire")
     if wire is None:
         return problems

@@ -1253,7 +1253,8 @@ def test_batched_traffic_audit_within_budget():
     from rocm_mpi_tpu.perf import traffic
 
     rows = traffic.audit_batched(local=16, dims=(2, 1), batch=2)
-    assert [r.variant for r in rows] == ["batched2", "batched-hide2"]
+    assert [r.variant for r in rows] == ["batched2", "batched-hide2",
+                                         "ladder2"]
     for row in rows:
         assert row.wire_bytes == row.wire_ideal, (
             row.variant,

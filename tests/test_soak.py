@@ -175,9 +175,13 @@ def test_bounded_soak_acceptance(tmp_path):
                          out / "quarantine.jsonl"]) == []
 
     names = {ep["name"]: ep for ep in doc["episodes"]}
-    assert set(names) == {"serve-chaos", "pipeline", "breaker",
+    assert set(names) == {"serve-chaos", "pipeline", "swap", "breaker",
                           "storage", "evict", "fleet", "gloo-serve",
                           "gloo-kill"}
+    # the swap episode re-seated lanes at segment boundaries and
+    # quarantined exactly the poisoned swapped-in lane
+    assert names["swap"]["swaps_in"] >= 1, names["swap"]
+    assert names["swap"]["counters"]["quarantined"] == 1, names["swap"]
     # the pipeline episode proved overlap does not reorder accounting
     assert "bubble" in names["pipeline"], names["pipeline"]
     # the fleet episode killed replica 1 mid-traffic and re-routed
