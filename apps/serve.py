@@ -185,6 +185,11 @@ def make_parser():
                    "of ONE compiled program, swapping resolved lanes "
                    "for queued same-class requests at the boundaries "
                    "(default 1 = batch-synchronous)")
+    p.add_argument("--no-request-trace", action="store_true",
+                   help="disable request-scoped tracing (trace contexts, "
+                   "tspan records, per-request latency decomposition — "
+                   "docs/TELEMETRY.md 'Request tracing'); the bench "
+                   "overhead rung's tracing-off arm")
     p.add_argument("--ladder", action="store_true",
                    help="shape-padding ladder: pad eligible lanes up "
                    "to their rung so rung-sharing shapes consolidate "
@@ -285,6 +290,8 @@ def main(argv=None) -> int:
         cfg_kw["segments"] = args.segments
     if args.ladder:
         cfg_kw["ladder"] = True
+    if args.no_request_trace:
+        cfg_kw["trace_requests"] = False
     svc = SimulationService(config=ServeConfig(
         max_width=args.max_width,
         occupancy_floor=args.occupancy_floor,

@@ -431,14 +431,14 @@ class Soak:
         # compile traffic (the installed tap stays).
         compiles.reset()
 
-        def trace():
+        def trace(prefix="fleet"):
             # Three bins over two shapes: wave pacing below guarantees
             # at least one ticket is OPEN on replica 1 at the tick-2
             # kill (bin affinity spreads the three bins one per
             # replica on first route).
             return [
                 _req(
-                    f"fleet-{i:02d}",
+                    f"{prefix}-{i:02d}",
                     shape=SHAPE_A if i % 3 else SHAPE_B,
                     nt=3 + (i % 3),
                     ic_scale=1.0 + 0.015 * i,
@@ -474,8 +474,13 @@ class Soak:
         assert fleet_journal.replay(journal.segments()).counts() \
             == counts, "journal replay is not idempotent"
         # Bitwise twin: the same trace through ONE standalone service.
+        # Distinct twin ids: the twin's done events land in the SAME
+        # rank stream, and the trace-continuity check below pins "one
+        # terminal span per fleet request" — identical ids would read
+        # as duplicate terminals (results only depend on shape/nt/
+        # ic_scale, so renaming changes nothing bitwise).
         twin = self._service(max_width=2)
-        twin_tickets = [twin.queue.submit(r) for r in trace()]
+        twin_tickets = [twin.queue.submit(r) for r in trace("twin")]
         _drive(twin)
         for t, ref in zip(tickets, twin_tickets):
             assert t.state == "done", (t.request.request_id, t.error)
@@ -495,6 +500,51 @@ class Soak:
             assert row["steady_state"] == 0, row
         fleet_journal.write_fleet_report(
             self.out / "fleet-report.json", doc
+        )
+        # Trace continuity across the failover (docs/TELEMETRY.md
+        # "Request tracing"): every ticket's causal timeline must end
+        # in exactly ONE terminal span, the journal-recovered tickets
+        # must show BOTH hops (minted at the front door, hop+1 at
+        # reconcile), and the done event's latency decomposition must
+        # telescope — stages summing to the measured latency — under a
+        # real mid-batch kill, not a unit fixture.
+        from rocm_mpi_tpu.telemetry import aggregate, tracing
+
+        loaded, _ = aggregate.load_rank_streams(self.stream_dirs[0])
+        rerouted_ids = []
+        for t in tickets:
+            rid = t.request.request_id
+            tl = tracing.request_timeline(loaded, rid)
+            assert tl is not None, f"{rid}: no trace in rank streams"
+            assert not tl["warnings"], (rid, tl["warnings"])
+            terms = [r for r in tl["events"]
+                     if r["name"].startswith("serve.request.")
+                     and r["name"].split(".")[-1] in
+                     ("done", "quarantined", "rejected", "expired")]
+            assert len(terms) == 1 and tl["terminal"] == "done", (
+                f"{rid}: expected one terminal done span, got "
+                f"{[(r['name'], r['rank']) for r in terms]}"
+            )
+            decomp = tl["decomposition"]
+            assert decomp is not None \
+                and not tracing.validate_decomposition(decomp), (
+                    rid, decomp,
+                    tracing.validate_decomposition(decomp or {}),
+                )
+            assert abs(sum(decomp.values()) - tl["latency_s"]) < 0.05, (
+                f"{rid}: decomposition {decomp} does not sum to "
+                f"latency {tl['latency_s']}"
+            )
+            if max(tl["hops"], default=0) >= 1:
+                assert tl["hops"] == [0, 1], (rid, tl["hops"])
+                rerouted_ids.append(rid)
+                tracing.write_trace_report(
+                    self.out / f"trace-report-{rid}.json",
+                    tracing.trace_report_doc(tl),
+                )
+        assert len(rerouted_ids) >= 1, (
+            "replica kill produced no two-hop trace "
+            f"(journal rerouted={counts['rerouted']})"
         )
         journal.close()
         merged = router.merged_counters()

@@ -403,12 +403,16 @@ def _next_bench_record_path() -> str:
     return os.path.join(root, f"BENCH_r{n:02d}.json")
 
 
-def _write_bench_record(rows: dict, rate_rows: dict | None = None) -> None:
+def _write_bench_record(rows: dict, rate_rows: dict | None = None,
+                        extra_metrics: dict | None = None) -> None:
     """Bank the suite's rates as a flat metrics baseline (all rates:
     higher is better; `rate_rows` are the serving drain rungs in
-    requests/s rather than Gpts/s). Atomic tmp+rename so a mid-write
-    kill cannot leave a torn record that bricks the schema gate."""
-    if not rows and not rate_rows:
+    requests/s rather than Gpts/s; `extra_metrics` are fully-formed
+    {"value", "direction"} rows for the non-rate rungs — the tracing
+    overhead fraction gates direction "lower"). Atomic tmp+rename so a
+    mid-write kill cannot leave a torn record that bricks the schema
+    gate."""
+    if not rows and not rate_rows and not extra_metrics:
         return
     path = _next_bench_record_path()
     metrics = {
@@ -420,6 +424,7 @@ def _write_bench_record(rows: dict, rate_rows: dict | None = None) -> None:
         metrics[f"suite.{label}.req_s"] = {
             "value": round(v, 4), "direction": "higher",
         }
+    metrics.update(extra_metrics or {})
     doc = {
         "metrics": metrics,
     }
@@ -433,16 +438,17 @@ def _write_bench_record(rows: dict, rate_rows: dict | None = None) -> None:
 
 
 def _run_serve_drain_rung(n_requests: int = 16, nt_base: int = 2_000,
-                          shapes=((64, 64), (96, 96))) -> dict:
+                          shapes=((64, 64), (96, 96))) -> tuple:
     """The serving drain rung (ISSUE 15, docs/SERVING.md "The
     pipeline"): the SAME synthetic trace through three drain modes —
     serial (depth 1), double-buffered (depth 2), and continuous
     (depth 2, 4 step segments per batch with boundary lane swap,
-    docs/SERVING.md "Continuous batching") — on warmed program caches;
-    returns {label: aggregate requests/s}, the drain rungs
-    `_write_bench_record` banks. time.monotonic interval arithmetic by
-    design (the per-batch device walls ride the serve.* telemetry
-    spans)."""
+    docs/SERVING.md "Continuous batching") — on warmed program caches,
+    plus a tracing-off pipelined arm for the request-tracing overhead
+    rung; returns ({label: aggregate requests/s}, extra metric rows),
+    the rungs `_write_bench_record` banks. time.monotonic interval
+    arithmetic by design (the per-batch device walls ride the serve.*
+    telemetry spans)."""
     import time as _time
 
     from rocm_mpi_tpu.serving.queue import Request as _Request
@@ -491,7 +497,35 @@ def _run_serve_drain_rung(n_requests: int = 16, nt_base: int = 2_000,
             file=sys.stderr,
         )
         serve_rows[f"serve drain {mode}"] = rate
-    return serve_rows
+
+    # The tracing-overhead rung (docs/TELEMETRY.md "Request tracing"):
+    # the SAME warmed pipelined drain with request tracing disabled —
+    # the on/off req/s delta is the observability tax. Banked as a
+    # direction-"lower" fraction so a tracing hot path that grows is a
+    # regression even while absolute req/s still looks healthy.
+    svc = _SimulationService(config=_ServeConfig(
+        max_width=4, pipeline_depth=2, trace_requests=False,
+    ))
+    svc.run_trace(_drain_trace("warmuntraced"))
+    for r in _drain_trace("measuntraced"):
+        svc.queue.submit(r)
+    t0 = _time.monotonic()
+    rep = svc.run_trace([])
+    wall = _time.monotonic() - t0
+    untraced = rep.served / wall if wall > 0 else 0.0
+    serve_rows["serve drain untraced"] = untraced
+    traced = serve_rows.get("serve drain pipelined", 0.0)
+    overhead = max(0.0, 1.0 - traced / untraced) if untraced > 0 else 0.0
+    print(
+        f"{'serve drain untraced':34s} {rep.served:3d} req "
+        f"in {wall:8.3f} s  {untraced:8.2f} req/s  "
+        f"trace overhead={overhead:.4f}",
+        file=sys.stderr,
+    )
+    extra = {"suite.serve.trace_overhead": {
+        "value": round(overhead, 4), "direction": "lower",
+    }}
+    return serve_rows, extra
 
 
 def run_suite() -> None:
@@ -674,7 +708,7 @@ def run_suite() -> None:
                        warmup=bcfg.warmup, config=bcfg),
         )
 
-    serve_rows = _run_serve_drain_rung()
+    serve_rows, trace_metrics = _run_serve_drain_rung()
 
     # Bank the autotuner's resolve outcomes (tune.hits / tune.misses run
     # gauges + the per-key tune.resolve annotations) before the record:
@@ -687,7 +721,7 @@ def run_suite() -> None:
     # The trajectory record is written only when the whole ladder ran —
     # a partial (killed) suite prints its rows to stderr but does not
     # bank a record that under-represents the machine.
-    _write_bench_record(suite_rows, serve_rows)
+    _write_bench_record(suite_rows, serve_rows, trace_metrics)
 
 
 # --------------------------------------------------------------------------

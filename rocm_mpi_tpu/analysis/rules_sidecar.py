@@ -45,10 +45,13 @@ from rocm_mpi_tpu.analysis.core import ModuleContext, Rule
 # names; chip_watcher archives them). `quarantine` and `soak-report`
 # joined with the request-plane hardening (docs/SERVING.md "SLOs and
 # admission"; docs/RESILIENCE.md §8); `fleet` covers the ticket
-# journal and the merged fleet report (docs/SERVING.md "The fleet").
+# journal and the merged fleet report (docs/SERVING.md "The fleet");
+# `trace` covers the rmt-trace-report artifact and per-request Chrome
+# exports (docs/TELEMETRY.md "Request tracing").
 _ARTIFACT_NAME_RE = re.compile(
     r"(heartbeat|manifest|postmortem|bundle|elastic|cache|tuning|"
-    r"baseline|findings|summary|quarantine|soak|fleet)[-\w.]*\.jsonl?\b"
+    r"baseline|findings|summary|quarantine|soak|fleet|trace)"
+    r"[-\w.]*\.jsonl?\b"
 )
 
 _SCHEMA_KEYS = {"schema", "kind"}

@@ -38,10 +38,15 @@ import math
 import threading
 import time
 
+from rocm_mpi_tpu.telemetry import tracing as _tracing
+
 REQUEST_SCHEMA = "rmt-serve-request"
 # v2: the optional `deadline_s` TTL joined the schema (v1 records
 # without it stay valid — the field is optional by construction).
-REQUEST_VERSION = 2
+# v3: the optional `trace` context dict joined (telemetry/tracing.py
+# wire shape) so a request's trace survives the journal and a fleet
+# re-route; v1/v2 records without it stay valid.
+REQUEST_VERSION = 3
 
 QUARANTINE_SCHEMA = "rmt-serve-quarantine"
 QUARANTINE_VERSION = 1
@@ -97,6 +102,11 @@ class Request:
     session: str | None = None
     resume: bool = False
     deadline_s: float | None = None
+    # Request-scoped trace context (telemetry/tracing.py wire shape,
+    # v3): None = mint a fresh root at submit; a dict = the request is
+    # continuing an existing trace (a fleet re-route carries the dead
+    # hop's context forward with hop+1).
+    trace: dict | None = None
 
     def __post_init__(self):
         if not self.request_id or not isinstance(self.request_id, str):
@@ -132,6 +142,13 @@ class Request:
                     f"seconds, got {self.deadline_s!r}"
                 )
             object.__setattr__(self, "deadline_s", d)
+        if self.trace is not None:
+            problems = _tracing.validate_wire(self.trace)
+            if problems:
+                raise ValueError(
+                    "bad trace context: " + "; ".join(problems)
+                )
+            object.__setattr__(self, "trace", dict(self.trace))
 
     @property
     def physics_dict(self) -> dict:
@@ -162,6 +179,7 @@ def request_to_record(req: Request) -> dict:
         "session": req.session,
         "resume": bool(req.resume),
         "deadline_s": req.deadline_s,
+        **({"trace": dict(req.trace)} if req.trace is not None else {}),
     }
 
 
@@ -184,6 +202,7 @@ def request_from_record(doc: dict) -> Request:
         session=doc.get("session"),
         resume=bool(doc.get("resume", False)),
         deadline_s=doc.get("deadline_s"),
+        trace=doc.get("trace"),
     )
 
 
@@ -221,6 +240,8 @@ def validate_request_record(doc: dict) -> list[str]:
         or not math.isfinite(ddl) or ddl <= 0
     ):
         problems.append(f"bad deadline_s {ddl!r} (want a positive number)")
+    if doc.get("trace") is not None:
+        problems += _tracing.validate_wire(doc["trace"])
     return problems
 
 
@@ -350,6 +371,41 @@ class Ticket:
         # service still owns the ticket, so result() must keep the
         # submitter waiting — None is the PREEMPTION contract only.
         self._retry_park = False
+        # Request-scoped tracing (telemetry/tracing.py): the context
+        # this ticket runs under (adopted from Request.trace or minted
+        # at submit) and the telescoping latency-decomposition state —
+        # `decomp` accumulates per-stage seconds, `_t_mark` is the last
+        # charged instant, `backoff_pending` is scheduled retry delay
+        # not yet charged (split out of the next queue_wait interval).
+        self.trace: _tracing.TraceContext | None = None
+        self.decomp: dict[str, float] = {}
+        self.backoff_pending = 0.0
+        self._t_mark = self.submitted_mono
+
+    def trace_mark(self, stage: str, now: float) -> None:
+        """Charge the interval since the previous mark to `stage`
+        (telemetry/tracing.py DECOMP_STAGES). The marks telescope —
+        every interval of the ticket's life is charged to exactly one
+        stage — so the stages sum to the terminal latency by
+        construction, across any number of retries. A queue_wait
+        interval is split against scheduled retry backoff first: the
+        backoff window is deliberate delay, not queue pressure."""
+        d = now - self._t_mark
+        if d < 0.0:
+            d = 0.0
+        if stage == "queue_wait" and self.backoff_pending > 0.0:
+            b = min(d, self.backoff_pending)
+            self.decomp["backoff"] = self.decomp.get("backoff", 0.0) + b
+            self.backoff_pending = 0.0
+            d -= b
+        self.decomp[stage] = self.decomp.get(stage, 0.0) + d
+        self._t_mark = now
+
+    def decomp_doc(self) -> dict:
+        """The per-request decomposition block the done event carries
+        (rounded like latency_s; validated by
+        tracing.validate_decomposition)."""
+        return {k: round(v, 6) for k, v in self.decomp.items()}
 
     @property
     def state(self) -> str:
@@ -483,6 +539,12 @@ class RequestQueue:
 
     def submit(self, request: Request) -> Ticket:
         t = Ticket(request)
+        # Adopt the request's wire context (a fleet re-route continues
+        # the dead hop's trace) or mint a fresh root: trace_id IS the
+        # request_id, so a trace needs no id-mapping layer.
+        ctx = _tracing.from_wire(request.trace)
+        t.trace = ctx if ctx is not None \
+            else _tracing.mint(request.request_id)
         with self._lock:
             if self._closed:
                 raise RuntimeError("queue is closed")
@@ -502,6 +564,8 @@ class RequestQueue:
                 self._pending.append(t)
         if error is not None:
             t._terminal_fail("rejected", error)
+        _tracing.emit_tspan("trace.submit", t.trace,
+                            ordinal=t.ordinal, state=t.state)
         return t
 
     def _retry_after_locked(self, depth: int) -> float:

@@ -50,7 +50,10 @@ the clean drain signal an out-of-process replica would exit with).
 
 from __future__ import annotations
 
+import dataclasses
 import time
+
+from rocm_mpi_tpu.telemetry import tracing as _tracing
 
 from rocm_mpi_tpu.serving import bins as _bins
 from rocm_mpi_tpu.serving import journal as _journal
@@ -238,6 +241,16 @@ class FleetRouter:
         a ticket; a fleet-wide saturation reject is a terminally
         `rejected` ticket carrying the merged retry-after hint."""
         rid_req = request.request_id
+        # The fleet front door mints the ROOT trace context (hop 0):
+        # every replica-side span of this request descends from it, and
+        # it rides Request.trace through the journal so a failover
+        # re-route can continue the trace at hop 1.
+        if request.trace is None:
+            request = dataclasses.replace(
+                request,
+                trace=_tracing.to_wire(_tracing.mint(request.request_id)),
+            )
+        ctx = _tracing.from_wire(request.trace)
         bkey = self._bin_of(request)
         self.journal.record_submit(
             rid_req, session=request.session, bin_key=bkey,
@@ -292,6 +305,8 @@ class FleetRouter:
                 self.journal.record_terminal(
                     rid_req, "rejected", replica=None,
                 )
+                _tracing.emit_tspan("trace.route", ctx,
+                                    replica=None, state="rejected")
                 rec = _TicketRec(request, t, -1)
                 rec.journaled = True
                 self._tickets[rid_req] = rec
@@ -299,8 +314,16 @@ class FleetRouter:
             # Spillover deliberately does NOT move the bin affinity:
             # the bin still prefers the replica holding its programs.
             target = spill
+            spilled = True
+        else:
+            spilled = False
         ticket = target.svc.queue.submit(request)
         self.journal.record_route(rid_req, target.id)
+        _tracing.emit_tspan(
+            "trace.route", ctx, replica=target.id,
+            **({"sticky": True} if sticky else {}),
+            **({"spill": True} if spilled else {}),
+        )
         rec = _TicketRec(request, ticket, target.id)
         self._tickets[rid_req] = rec
         if bkey is not None and bkey not in self._affinity:
@@ -370,10 +393,25 @@ class FleetRouter:
                     "fleet exhausted: no healthy replica to re-route "
                     f"{rid_req!r} to"
                 )
+            # A re-route is a new HOP: continue the dead hop's trace
+            # with hop+1 (parent = the dead hop's span) so the merged
+            # timeline shows the failover as one causal chain, and the
+            # new replica's queue adopts the bumped context.
+            ctx = _tracing.from_wire(rec.request.trace)
+            if ctx is None:
+                ctx = _tracing.mint(rid_req)
+            nctx = _tracing.next_hop(ctx)
+            rec.request = dataclasses.replace(
+                rec.request, trace=_tracing.to_wire(nctx)
+            )
             rec.ticket = target.svc.queue.submit(rec.request)
             rec.replica = target.id
             rec.journaled = False
             self.journal.record_route(rid_req, target.id, reroute=True)
+            _tracing.emit_tspan(
+                "trace.route", nctx, replica=target.id, reroute=True,
+                from_replica=int(rid),
+            )
             if rec.request.session:
                 self._sessions[rec.request.session] = target.id
             bkey = self._bin_of(rec.request)

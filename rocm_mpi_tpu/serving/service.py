@@ -145,6 +145,11 @@ class ServeConfig:
     # (None -> budgets "serving"/"padded_flops_tolerance" row).
     ladder: bool = False
     ladder_tolerance: float | None = None
+    # Request-scoped tracing (telemetry/tracing.py): per-ticket latency-
+    # decomposition marks plus tspan records on the rank stream. Off
+    # means zero marks and zero tspans on the serving hot path — the
+    # bench overhead rung's tracing-off arm.
+    trace_requests: bool = True
 
     def resolved_floor(self) -> float:
         if self.occupancy_floor is not None:
@@ -1062,7 +1067,24 @@ class SimulationService:
         if slow is not None:
             time.sleep(slow.delay_s)
 
+        tracing_on = bool(self.config.trace_requests)
+        if tracing_on:
+            from rocm_mpi_tpu.telemetry import tracing as _tracing
+
+            tnow = self._now()
+            for t in tickets:
+                t.trace_mark("queue_wait", tnow)
         prog = self._program_for(key, width)
+        if tracing_on:
+            # Telescoping decomposition marks (tracing.DECOMP_STAGES):
+            # each boundary charges the interval since the previous mark
+            # to ONE stage, so the stages sum exactly to the terminal
+            # latency. "compile" covers program-class acquisition (a hot
+            # cache charges ~0 here); everything until the blocking
+            # fetch lands in "device".
+            tnow = self._now()
+            for t in tickets:
+                t.trace_mark("compile", tnow)
         bgrid = prog.bgrid
         multi = self._is_multi()
 
@@ -1189,6 +1211,27 @@ class SimulationService:
             fetch=fetch, need_host=need_host,
             anchors=(leaves_dev, steps_dev),
         )
+        if tracing_on:
+            # ONE batch-level trace record, not one per lane: the
+            # members roster ({trace_id, lane}) lets the read side
+            # derive every member's device span from this record plus
+            # lane occupancy (telemetry/tracing.py), keeping the stream
+            # O(batches). The roster also feeds the flight recorder: a
+            # wedged rank's heartbeat names the requests stuck in
+            # flight.
+            members = [
+                {"trace_id": t.trace.trace_id, "lane": j,
+                 "span_id": t.trace.span_id, "hop": t.trace.hop}
+                for j, t in enumerate(live) if t.trace is not None
+            ]
+            _tracing.emit_tspan(
+                "trace.batch",
+                next((t.trace for t in live if t.trace is not None),
+                     None),
+                seq=seq, bin=key.key_str(), width=width,
+                members=members,
+            )
+            flight.trace_inflight_add(m["trace_id"] for m in members)
         # Busy-mark LAST, after the stage hook and record construction:
         # a raise between a _note_dispatched and its matching
         # _note_fetched (resolve's finally) would leave _inflight_n
@@ -1216,6 +1259,14 @@ class SimulationService:
         prog, live, starts = fl.prog, fl.live, fl.starts
         lane_steps = fl.lane_steps
         n = int(lane_steps.max())
+        tracing_on = bool(self.config.trace_requests)
+        if tracing_on:
+            # Everything since the compile mark — assembly, upload,
+            # dispatch, the device compute itself — charges to "device":
+            # the interval ends where the drain starts WAITING.
+            tnow = self._now()
+            for t in live:
+                t.trace_mark("device", tnow)
         t0 = self._now()
         try:
             with telemetry.span("serve.fetch", phase="serve",
@@ -1259,6 +1310,16 @@ class SimulationService:
             fl.anchors = ()
             self._pipe["fetch_s"] += self._now() - t0
             self._note_fetched()
+        if tracing_on:
+            tnow = self._now()
+            for t in live:
+                t.trace_mark("fetch", tnow)
+            # Off-device: the heartbeat's in-flight roster drops the
+            # batch here (a fetch that RAISES is dropped by
+            # _batch_failed instead).
+            flight.trace_inflight_drop(
+                t.trace.trace_id for t in live if t.trace is not None
+            )
         self._stage_hook("fetch", key=key.key_str(), width=width,
                          seq=fl.seq, live=len(live))
 
@@ -1298,6 +1359,8 @@ class SimulationService:
                 t.steps_run = int(lane_steps[j])
                 t._resolve(lane if fl.fetch else None)
                 done += 1
+                if tracing_on:
+                    t.trace_mark("resolve", self._now())
                 latency = t.age_s()
                 telemetry.record_event(
                     "serve.request.done",
@@ -1308,6 +1371,10 @@ class SimulationService:
                     deadline_miss=bool(
                         t.request.deadline_s is not None
                         and latency > t.request.deadline_s
+                    ),
+                    **(
+                        {"hop": t.trace.hop, "decomp": t.decomp_doc()}
+                        if tracing_on and t.trace is not None else {}
                     ),
                 )
             self.queue.note_completed(done)
@@ -1366,7 +1433,22 @@ class SimulationService:
         if slow is not None:
             time.sleep(slow.delay_s)
 
+        tracing_on = bool(self.config.trace_requests)
+        if tracing_on:
+            from rocm_mpi_tpu.telemetry import tracing as _tracing
+
+            tnow = self._now()
+            for t in tickets:
+                t.trace_mark("queue_wait", tnow)
         prog = self._program_for(key, width, ladder=ladder)
+        if tracing_on:
+            # Same telescoping boundaries as _prepare_batch; the
+            # continuous drain adds "swap_wait" — backlog tickets charge
+            # their compile->seat wait to it (they wait for a LANE, not
+            # for the queue).
+            tnow = self._now()
+            for t in tickets:
+                t.trace_mark("compile", tnow)
         bgrid = prog.bgrid
         seg_len = max(
             1, key.steps_bucket // max(1, int(self.config.segments))
@@ -1438,6 +1520,11 @@ class SimulationService:
                 hold_rows[j] = hold
                 a_rows[j] = a
                 g_rows[j] = np.asarray(g, dtype=cdtype)
+            if tracing_on:
+                # Seated: the wait-for-a-lane interval ends (first
+                # seats charge ~0; boundary swap-ins charge the
+                # segments they sat out).
+                t.trace_mark("swap_wait", self._now())
             return True
 
         def fill(allow_queue: bool) -> int:
@@ -1458,6 +1545,10 @@ class SimulationService:
                     if not pulled:
                         break
                     flight.progress(serve_submitted=1)
+                    if tracing_on:
+                        # A daemon arrival's queue wait ends at its
+                        # pop, not at the group's drain entry.
+                        pulled[0].trace_mark("queue_wait", self._now())
                     # Join the batch's ticket roster so a batch-level
                     # failure (_batch_failed) covers swap-ins too.
                     tickets.append(pulled[0])
@@ -1496,6 +1587,17 @@ class SimulationService:
             )
             return leaves, geom
 
+        def roster() -> list[dict]:
+            """The seated lanes' trace membership ({trace_id, lane}) —
+            what trace.batch/trace.segment records and the flight
+            recorder's in-flight set are built from."""
+            return [
+                {"trace_id": lane_t[j].trace.trace_id, "lane": j}
+                for j in range(width)
+                if lane_t[j] is not None
+                and lane_t[j].trace is not None
+            ]
+
         t0 = self._now()
         with telemetry.span("serve.assemble", phase="serve",
                             bin=kstr, width=width):
@@ -1505,6 +1607,19 @@ class SimulationService:
             "assemble", key=kstr, width=width, seq=seq,
             live=sum(1 for t in lane_t if t is not None),
         )
+        seated_ids: set = set()
+        if tracing_on:
+            members = roster()
+            _tracing.emit_tspan(
+                "trace.batch",
+                next((lane_t[j].trace for j in range(width)
+                      if lane_t[j] is not None
+                      and lane_t[j].trace is not None), None),
+                seq=seq, bin=kstr, width=width, segmented=True,
+                members=members,
+            )
+            seated_ids = {m["trace_id"] for m in members}
+            flight.trace_inflight_add(seated_ids)
 
         leaves_dev = None
         geom_dev = ()
@@ -1571,6 +1686,13 @@ class SimulationService:
                 leaves_dev = out
                 continue
 
+            if tracing_on:
+                # A finishing lane's whole chain — every segment it
+                # rode, including intermediate boundary round trips —
+                # is device time from ITS seat mark to this wait.
+                tnow = self._now()
+                for j in finishing:
+                    lane_t[j].trace_mark("device", tnow)
             t0 = self._now()
             with telemetry.span("serve.fetch", phase="serve",
                                 bin=kstr, width=width):
@@ -1579,6 +1701,10 @@ class SimulationService:
             anchors.clear()
             self._pipe["fetch_s"] += self._now() - t0
             self._note_fetched()
+            if tracing_on:
+                tnow = self._now()
+                for j in finishing:
+                    lane_t[j].trace_mark("fetch", tnow)
             self._stage_hook("fetch", key=kstr, width=width, seq=seq,
                              live=len(live_j))
 
@@ -1635,6 +1761,8 @@ class SimulationService:
                     t.steps_run = nt_run
                     t._resolve(lane if fetch else None)
                     done_here += 1
+                    if tracing_on:
+                        t.trace_mark("resolve", self._now())
                     latency = t.age_s()
                     telemetry.record_event(
                         "serve.request.done",
@@ -1645,6 +1773,12 @@ class SimulationService:
                         deadline_miss=bool(
                             t.request.deadline_s is not None
                             and latency > t.request.deadline_s
+                        ),
+                        **(
+                            {"hop": t.trace.hop,
+                             "decomp": t.decomp_doc()}
+                            if tracing_on and t.trace is not None
+                            else {}
                         ),
                     )
                     lane_t[j] = None
@@ -1670,6 +1804,24 @@ class SimulationService:
             self._pipe["resolve_s"] += self._now() - t0
             self._stage_hook("resolve", key=kstr, width=width,
                              seq=seq, live=len(finishing))
+            if tracing_on:
+                # The boundary record AFTER the swap: joined lanes
+                # appear in the segment they joined at (the read side
+                # derives their device spans from here), and the
+                # flight recorder's in-flight set moves with the seats.
+                members = roster()
+                _tracing.emit_tspan(
+                    "trace.segment",
+                    next((lane_t[j].trace for j in range(width)
+                          if lane_t[j] is not None
+                          and lane_t[j].trace is not None), None),
+                    seq=seq, seg=segs_run, bin=kstr, width=width,
+                    members=members,
+                )
+                ids_now = {m["trace_id"] for m in members}
+                flight.trace_inflight_drop(seated_ids - ids_now)
+                flight.trace_inflight_add(ids_now - seated_ids)
+                seated_ids = ids_now
             leaves_dev = None  # re-assemble from host rows next round
             geom_dev = ()
 
@@ -1714,10 +1866,17 @@ class SimulationService:
         (transient faults requeue bounded, then quarantine); K
         consecutive failures open the class's circuit breaker."""
         from rocm_mpi_tpu import telemetry
+        from rocm_mpi_tpu.telemetry import flight
 
         telemetry.record_event(
             "serve.batch.error", bin=key.key_str(), width=width,
             error=str(e),
+        )
+        # The failed batch is off the device however it died: the
+        # heartbeat's in-flight trace roster must not carry its
+        # requests forever.
+        flight.trace_inflight_drop(
+            t.trace.trace_id for t in batch_ts if t.trace is not None
         )
         br = self._breakers[key]
         if br.note_failure(self._circuit, self._drains):
@@ -1780,8 +1939,12 @@ class SimulationService:
             t.retries += 1
             self.retries_total += 1
             if self.queue.wall_slo:
-                t.not_before = self._now() \
-                    + self._retry.backoff_s(t.retries)
+                backoff = self._retry.backoff_s(t.retries)
+                t.not_before = self._now() + backoff
+                # The park is charged to "backoff", not "queue_wait":
+                # the next queue_wait mark peels this much off first
+                # (Ticket.trace_mark — the decomposition contract).
+                t.backoff_pending += backoff
             # wake=False: the submitter keeps waiting for the retried
             # batch's real resolution (unlike a preemption park).
             self.queue.requeue([t], wake=False)

@@ -53,10 +53,14 @@ def latencies_from_streams(paths) -> dict:
     streams: every `serve.request.done` event's `latency_s` and
     `deadline_miss`, deduped by request id (in a multi-controller
     service every rank emits the same event — one request is one
-    observation, not one per rank). Torn lines are skipped (live
-    JSONL streams)."""
+    observation, not one per rank). Done events that carry a
+    per-request latency decomposition (`decomp`, `hop` — the PR-20
+    request-tracing fields) are harvested alongside, same dedup. Torn
+    lines are skipped (live JSONL streams)."""
     lat: dict[str, float] = {}
     misses: set[str] = set()
+    decomps: dict[str, dict] = {}
+    hops: dict[str, int] = {}
     for raw in paths:
         path = pathlib.Path(raw)
         if not path.is_file():
@@ -84,7 +88,17 @@ def latencies_from_streams(paths) -> dict:
             lat.setdefault(rid, float(v))
             if doc.get("deadline_miss"):
                 misses.add(rid)
-    return {"latencies": lat, "deadline_missed_done": sorted(misses)}
+            if isinstance(doc.get("decomp"), dict):
+                decomps.setdefault(rid, dict(doc["decomp"]))
+            hop = doc.get("hop")
+            if isinstance(hop, int) and not isinstance(hop, bool):
+                hops.setdefault(rid, hop)
+    return {
+        "latencies": lat,
+        "deadline_missed_done": sorted(misses),
+        "decomps": decomps,
+        "hops": hops,
+    }
 
 
 def slo_block(counters: dict, stream_paths) -> dict:
@@ -99,7 +113,10 @@ def slo_block(counters: dict, stream_paths) -> dict:
     submitted = int(counters.get("submitted", 0))
     expired = int(counters.get("expired", 0))
     misses = expired + late_done
-    return {
+    decomp_block = decomposition_block(
+        facts.get("decomps") or {}, facts.get("hops") or {}
+    )
+    out = {
         "submitted": submitted,
         "done": int(counters.get("completed", 0)),
         "failed": int(counters.get("failed", 0)),
@@ -116,6 +133,45 @@ def slo_block(counters: dict, stream_paths) -> dict:
         "deadline_miss_rate": (
             round(misses / submitted, 6) if submitted else 0.0
         ),
+    }
+    if decomp_block is not None:
+        out["decomposition"] = decomp_block
+    return out
+
+
+def decomposition_block(decomps: dict, hops: dict) -> dict | None:
+    """The tail-latency decomposition aggregate: per-stage mean/p50/p99
+    across every done request that banked a decomposition, plus the
+    hop summary (how many requests re-routed across replicas). None
+    when no request carried one (tracing off, or a legacy stream) —
+    the soak-report schema treats the block as optional for exactly
+    that reason."""
+    from rocm_mpi_tpu.telemetry import tracing as _tracing
+
+    if not decomps:
+        return None
+    stages: dict[str, dict] = {}
+    for stage in _tracing.DECOMP_STAGES:
+        vals = [
+            float(d[stage]) for d in decomps.values()
+            if isinstance(d.get(stage), (int, float))
+        ]
+        if not vals:
+            continue
+        stages[stage] = {
+            "n": len(vals),
+            "mean": round(sum(vals) / len(vals), 6),
+            "p50": round(percentile(vals, 50), 6),
+            "p99": round(percentile(vals, 99), 6),
+        }
+    hop_vals = list(hops.values())
+    return {
+        "n": len(decomps),
+        "stages": stages,
+        "hops": {
+            "max": max(hop_vals) if hop_vals else 0,
+            "rerouted": sum(1 for h in hop_vals if h > 0),
+        },
     }
 
 
@@ -194,6 +250,54 @@ def validate_soak_report(doc: dict) -> list[str]:
         problems.append(
             f"slo.deadline_miss_rate {rate!r} outside [0, 1]"
         )
+    problems += validate_decomposition_block(slo.get("decomposition"))
+    return problems
+
+
+def validate_decomposition_block(block) -> list[str]:
+    """Problem strings for an slo.decomposition aggregate (None is
+    fine — the block is optional: tracing off or legacy streams)."""
+    from rocm_mpi_tpu.telemetry import tracing as _tracing
+
+    if block is None:
+        return []
+    if not isinstance(block, dict):
+        return [f"slo.decomposition {block!r} is not an object"]
+    problems: list[str] = []
+    n = block.get("n")
+    if not isinstance(n, int) or isinstance(n, bool) or n < 1:
+        problems.append(
+            "slo.decomposition.n must be a positive count (an empty "
+            "block should be absent, not empty)"
+        )
+    stages = block.get("stages")
+    if not isinstance(stages, dict):
+        problems.append("slo.decomposition.stages missing")
+    else:
+        for stage, row in stages.items():
+            if stage not in _tracing.DECOMP_STAGES:
+                problems.append(
+                    f"slo.decomposition stage {stage!r} unknown "
+                    f"(known: {list(_tracing.DECOMP_STAGES)})"
+                )
+            if not isinstance(row, dict):
+                problems.append(
+                    f"slo.decomposition.stages.{stage} not an object"
+                )
+                continue
+            for q in ("mean", "p50", "p99"):
+                v = row.get(q)
+                if not isinstance(v, (int, float)) \
+                        or isinstance(v, bool) or v < 0:
+                    problems.append(
+                        f"slo.decomposition.{stage}.{q} {v!r} not a "
+                        "non-negative time"
+                    )
+    hops = block.get("hops")
+    if not isinstance(hops, dict) or not isinstance(
+        hops.get("max"), int
+    ) or not isinstance(hops.get("rerouted"), int):
+        problems.append("slo.decomposition.hops missing max/rerouted")
     return problems
 
 

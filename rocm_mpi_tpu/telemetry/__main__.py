@@ -1,5 +1,5 @@
 """CLI: python -m rocm_mpi_tpu.telemetry
-           {summarize,regress,monitor,export-openmetrics} …
+           {summarize,regress,monitor,export-openmetrics,trace} …
 
     summarize DIR [--json] [--out FILE] [--trace FILE]
                   [--straggler-factor F]
@@ -44,6 +44,16 @@
         keys exactly). Exit 0, 2 when DIR has neither rank streams nor
         heartbeat sidecars.
 
+    trace DIR --request ID [--out FILE] [--chrome FILE]
+        One request's causal timeline across every rank stream under
+        DIR (fleet layouts with replica subdirectories included):
+        hop-indented human lines plus the latency decomposition
+        (docs/TELEMETRY.md "Request tracing"). --out banks the
+        schema-versioned trace report (rmt-trace-report, gated by
+        regress --check-schema); --chrome exports a per-hop Chrome
+        trace for the request. Exit 0, 2 when DIR has no streams or
+        no stream mentions the request.
+
 stdlib-only end to end: the read side of telemetry must run on machines
 that will never import jax (CI, a laptop holding a pod's stream).
 """
@@ -55,7 +65,7 @@ import json
 import pathlib
 import sys
 
-from rocm_mpi_tpu.telemetry import aggregate, health, regress, trace
+from rocm_mpi_tpu.telemetry import aggregate, health, regress, trace, tracing
 
 
 def _cmd_summarize(args) -> int:
@@ -257,6 +267,35 @@ def _cmd_export_openmetrics(args) -> int:
     return 0
 
 
+def _cmd_trace(args) -> int:
+    streams, _ = aggregate.load_rank_streams(args.dir)
+    if not streams:
+        print(
+            f"error: no telemetry-rank*.jsonl under {args.dir} "
+            "(run with --telemetry DIR, or RMT_TELEMETRY_DIR=DIR)",
+            file=sys.stderr,
+        )
+        return 2
+    timeline = tracing.request_timeline(streams, args.request)
+    if timeline is None:
+        print(
+            f"error: no stream under {args.dir} mentions request "
+            f"{args.request!r} (tracing off, or wrong id?)",
+            file=sys.stderr,
+        )
+        return 2
+    print(tracing.format_timeline(timeline))
+    if args.out:
+        doc = tracing.trace_report_doc(timeline)
+        tracing.write_trace_report(args.out, doc)
+        print(f"trace report: {args.out}")
+    if args.chrome:
+        tracing.write_request_chrome(timeline, args.chrome)
+        print(f"per-hop chrome trace: {args.chrome} "
+              "(open at ui.perfetto.dev)")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m rocm_mpi_tpu.telemetry",
@@ -311,6 +350,18 @@ def main(argv=None) -> int:
     p_om.add_argument("--out", default=None, metavar="FILE",
                       help="write the snapshot here instead of stdout")
 
+    p_tr = sub.add_parser(
+        "trace",
+        help="one request's causal timeline + latency decomposition",
+    )
+    p_tr.add_argument("dir", help="directory of telemetry-rank*.jsonl")
+    p_tr.add_argument("--request", required=True, metavar="ID",
+                      help="request id (== trace id) to reconstruct")
+    p_tr.add_argument("--out", default=None, metavar="FILE",
+                      help="bank the rmt-trace-report artifact here")
+    p_tr.add_argument("--chrome", default=None, metavar="FILE",
+                      help="export the per-hop Chrome trace here")
+
     args = parser.parse_args(argv)
     if args.command == "summarize":
         return _cmd_summarize(args)
@@ -320,6 +371,8 @@ def main(argv=None) -> int:
         return _cmd_monitor(args)
     if args.command == "export-openmetrics":
         return _cmd_export_openmetrics(args)
+    if args.command == "trace":
+        return _cmd_trace(args)
     parser.print_usage(sys.stderr)
     return 2
 

@@ -155,6 +155,8 @@ def summarize(streams: dict[int, list[dict]], skipped_lines: int = 0,
     event_counts: dict[str, int] = {}
     traced: dict[str, dict] = {}
     wire_modes: set[str] = set()
+    tspan_counts: dict[str, int] = {}
+    trace_ids: set[str] = set()
     n_records = 0
 
     for rk, recs in sorted(streams.items()):
@@ -211,6 +213,18 @@ def summarize(streams: dict[int, list[dict]], skipped_lines: int = 0,
                 event_counts[rec["name"]] = (
                     event_counts.get(rec["name"], 0) + 1
                 )
+            elif kind == "tspan":
+                # Request-trace transitions (telemetry/tracing.py):
+                # the summary counts them per name and the distinct
+                # traces observed — the cheap "is tracing on, and how
+                # much is it writing" view; the per-request read side
+                # is the `telemetry trace` verb, not the summary.
+                tspan_counts[rec.get("name", "?")] = (
+                    tspan_counts.get(rec.get("name", "?"), 0) + 1
+                )
+                tid = rec.get("trace_id")
+                if isinstance(tid, str):
+                    trace_ids.add(tid)
             elif kind == "trace":
                 traced[rec["name"]] = attrs
                 # The active wire-precision mode(s), annotation-sourced
@@ -287,6 +301,8 @@ def summarize(streams: dict[int, list[dict]], skipped_lines: int = 0,
         "counters": counters,
         "events": event_counts,
         "traced": traced,
+        "tspans": tspan_counts,
+        "trace_requests": len(trace_ids),
         "wire_modes": sorted(wire_modes),
         "stragglers": stragglers,
     }

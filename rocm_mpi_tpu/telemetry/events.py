@@ -12,7 +12,8 @@ Record header (every kind):
 
     {"v": SCHEMA_VERSION,      # event-schema version (v1 = the PR-1
                                #   unversioned RunEvent lines)
-     "kind": "span" | "counter" | "gauge" | "event" | "trace",
+     "kind": "span" | "counter" | "gauge" | "event" | "trace"
+             | "tspan" | "anchor",
      "name": str,              # dotted, phase-prefixed ("halo.exchange")
      "t": float,               # time.time() — comparable ACROSS ranks
      "t_mono": float,          # time.perf_counter() — orders WITHIN a rank
@@ -21,13 +22,18 @@ Record header (every kind):
 Kind-specific fields: spans add `dur_s`/`depth`/`tid`, counters and
 gauges add `value`, events carry the resilience payload
 (attempt/step/wait_s/error), trace annotations carry static metadata
-recorded at trace time (bytes per halo exchange etc. — see spans.annotate).
+recorded at trace time (bytes per halo exchange etc. — see spans.annotate),
+tspans carry a request's trace context (telemetry/tracing.py).
 Everything else rides in `attrs` so the header schema stays closed.
 
 Two timestamps by design: wall time aligns ranks in the merged Chrome
 trace (each process's monotonic origin is arbitrary), while `t_mono`
 gives the tear-free ordering within a rank that the PR-1 events lacked —
-the satellite fix for "events are unordered across ranks".
+the satellite fix for "events are unordered across ranks". The
+"anchor"-kind `clock.anchor` record (one per sink, emitted by
+configure()) binds the two clocks: its header stamps t and t_mono back
+to back, so the fleet merger can map any record's t_mono into
+comparable wall time (telemetry/tracing.py `aligned_wall`).
 
 Configuration (env first, so launcher-spawned ranks need no code):
 
@@ -71,6 +77,7 @@ _DIR: str | None = os.environ.get("RMT_TELEMETRY_DIR") or None
 _RANK: int | None = None
 _RECORDS: list[dict] = []
 _ANNOTATED: set = set()  # (name, sorted attrs) — trace-annotation dedup
+_ANCHORED: set = set()   # (dir, rank) — one clock anchor per sink
 
 # In-process buffer cap for hot kinds (spans/counters/gauges/trace): the
 # JSONL file is the real sink; the buffer exists for tests and
@@ -116,6 +123,23 @@ def configure(enabled: bool | None = None, directory=None,
             _ENABLED = bool(enabled)
         if rank is not None:
             _RANK = int(rank)
+    if _ENABLED and _DIR is not None:
+        _emit_clock_anchor()
+
+
+def _emit_clock_anchor() -> None:
+    """One wall<->monotonic clock anchor per (sink, rank): the record's
+    own header stamps `t` and `t_mono` back to back, and that pair is
+    what the fleet trace merger aligns replica streams with
+    (telemetry/tracing.py). Emitted outside configure()'s lock — emit()
+    takes it. Streams that never pass through configure() (legacy
+    env-only ranks) simply have no anchor; the merger warns on them."""
+    key = (_DIR, rank())
+    with _LOCK:
+        if key in _ANCHORED:
+            return
+        _ANCHORED.add(key)
+    emit("anchor", "clock.anchor", pid=os.getpid())
 
 
 def rank() -> int:
@@ -293,6 +317,7 @@ def clear(kind: str | None = None) -> None:
         if kind is None:
             _RECORDS.clear()
             _ANNOTATED.clear()
+            _ANCHORED.clear()
             _DROPPED = 0
         else:
             _RECORDS[:] = [r for r in _RECORDS if r["kind"] != kind]

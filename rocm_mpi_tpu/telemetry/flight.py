@@ -76,6 +76,12 @@ _FLUSH_INTERVAL_S = DEFAULT_FLUSH_INTERVAL_S
 _LAST_FLUSH_MONO = 0.0
 _STARTED_T = None
 _TRACEBACK_FH = None  # keeps the faulthandler sink open for the process
+# Trace ids of the requests currently riding a dispatched batch on this
+# rank (serving/service.py marks them at dispatch, clears at resolve):
+# a wedged rank's heartbeat then names WHICH requests are stuck in
+# flight, not just that a batch is — the post-mortem's causal handle
+# into the request-trace plane (telemetry/tracing.py).
+_INFLIGHT_TRACES: set = set()
 
 
 def enabled() -> bool:
@@ -170,6 +176,7 @@ def reset() -> None:
     with _LOCK:
         _RING.clear()
         _COUNTERS.clear()
+        _INFLIGHT_TRACES.clear()
         _LAST_PHASE = _LAST_PHASE_NAME = _LAST_PHASE_T = None
         _STARTED_T = None
     events.clear_events()
@@ -282,6 +289,30 @@ def progress(step: int | None = None, step_inc: int | None = None,
     _maybe_flush(force=stepped)
 
 
+def trace_inflight_add(trace_ids) -> None:
+    """Mark request trace ids as riding a dispatched batch. No-op while
+    disabled; no flush of its own (the dispatch path's progress() bump
+    already forces one, and the ids must be in THAT flush)."""
+    if not _ENABLED:
+        return
+    with _LOCK:
+        _INFLIGHT_TRACES.update(str(t) for t in trace_ids)
+
+
+def trace_inflight_drop(trace_ids) -> None:
+    """Clear request trace ids whose batch resolved (or failed)."""
+    if not _ENABLED:
+        return
+    with _LOCK:
+        _INFLIGHT_TRACES.difference_update(str(t) for t in trace_ids)
+
+
+def inflight_traces() -> list[str]:
+    """The currently in-flight request trace ids (sorted; tests)."""
+    with _LOCK:
+        return sorted(_INFLIGHT_TRACES)
+
+
 def snapshot() -> dict:
     """The heartbeat document (also what flush writes)."""
     with _LOCK:
@@ -296,6 +327,7 @@ def snapshot() -> dict:
             "last_phase": _LAST_PHASE,
             "last_phase_name": _LAST_PHASE_NAME,
             "last_phase_t": _LAST_PHASE_T,
+            "inflight_traces": sorted(_INFLIGHT_TRACES),
             "ring": list(_RING),
         }
 

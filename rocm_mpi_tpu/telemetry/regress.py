@@ -184,6 +184,7 @@ def _classify_json(doc: dict) -> str | None:
     from rocm_mpi_tpu.serving.bins import BIN_MANIFEST_SCHEMA
     from rocm_mpi_tpu.serving.journal import FLEET_REPORT_SCHEMA
     from rocm_mpi_tpu.serving.slo import SOAK_SCHEMA
+    from rocm_mpi_tpu.telemetry.tracing import TRACE_REPORT_SCHEMA
 
     named = {
         SUMMARY_SCHEMA: "telemetry summary",
@@ -195,6 +196,7 @@ def _classify_json(doc: dict) -> str | None:
         BIN_MANIFEST_SCHEMA: "serving bin manifest",
         SOAK_SCHEMA: "soak report",
         FLEET_REPORT_SCHEMA: "fleet report",
+        TRACE_REPORT_SCHEMA: "trace report",
     }
     if doc.get("schema") in named:
         return named[doc["schema"]]
@@ -246,6 +248,10 @@ def _validate_classified(doc: dict, kind: str) -> list[str]:
         from rocm_mpi_tpu.serving.journal import validate_fleet_report
 
         return validate_fleet_report(doc)
+    if kind == "trace report":
+        from rocm_mpi_tpu.telemetry.tracing import validate_trace_report
+
+        return validate_trace_report(doc)
     return []
 
 
@@ -377,9 +383,16 @@ def _validate_event_record(doc: dict) -> list[str]:
     it (an int `step`); a `ckpt.degraded` additionally names its reason
     — the field the loss-window audit groups on."""
     name = doc.get("name")
-    if not isinstance(name, str) or not name.startswith(
-        _GUARDED_EVENT_PREFIXES
-    ):
+    if not isinstance(name, str):
+        return []
+    if name == "serve.request.done" and doc.get("decomp") is not None:
+        # The per-request latency decomposition (PR-20 request
+        # tracing): stage keys and non-negative times, validated by
+        # the tracing module's shared stdlib checker.
+        from rocm_mpi_tpu.telemetry.tracing import validate_decomposition
+
+        return validate_decomposition(doc["decomp"])
+    if not name.startswith(_GUARDED_EVENT_PREFIXES):
         return []
     problems = []
     if not isinstance(doc.get("step"), int):

@@ -30,18 +30,24 @@ Events are emitted sorted by ts (metadata first): Perfetto tolerates
 unsorted input, but the post-mortem reader (and the tests) treat the
 file as a timeline and must not have to re-sort it.
 
-Cross-rank alignment uses the records' WALL timestamps (`t`): each
-process's monotonic origin is arbitrary, so `t_mono` orders within a
-rank but cannot place ranks against each other. The trace origin is the
-earliest wall stamp across all ranks; NTP-grade skew between ranks on
-one host (the launcher case) is microseconds — fine for eyeballing halo
-waits. Durations come from `dur_s` (monotonic-derived), so slice widths
+Cross-rank alignment (the PR-20 fix): a stream that carries a
+`clock.anchor` record (telemetry/tracing.py — every `configure()`d rank
+does) is positioned on the anchor-mapped clock, `anchor_t + (t_mono -
+anchor_t_mono)`: tear-free WITHIN the rank (monotonic) and comparable
+ACROSS fleet replicas (one wall read per process, not one per record).
+Anchor-less legacy streams fall back to per-record wall stamps — their
+records may misalign against anchored ranks, so the export WARNS about
+them (`otherData.warnings`) instead of silently interleaving two clock
+disciplines. The trace origin is the earliest aligned stamp across all
+ranks. Durations come from `dur_s` (monotonic-derived), so slice widths
 never inherit wall-clock jumps. stdlib-only, like the whole read side.
 """
 
 from __future__ import annotations
 
 import pathlib
+
+from rocm_mpi_tpu.telemetry import tracing as _tracing
 
 TRACE_REQUIRED_KEYS = ("name", "ph", "ts", "pid")
 
@@ -52,9 +58,29 @@ def to_chrome_trace(streams: dict[int, list[dict]],
     """Build the trace-event document from per-rank record streams
     (aggregate.load_rank_streams shape), optionally merged with health
     sidecars and watchdog verdicts (module docstring)."""
-    all_recs = [r for recs in streams.values() for r in recs]
-    wall_stamps = [r["t"] for r in all_recs if isinstance(r.get("t"),
-                                                          (int, float))]
+    anchors = {rk: _tracing.anchor_of(recs)
+               for rk, recs in streams.items()}
+    warnings: list[str] = []
+    if any(a is not None for a in anchors.values()):
+        for rk in sorted(streams):
+            if anchors[rk] is None and streams[rk]:
+                warnings.append(
+                    f"rank {rk} stream has no clock.anchor record "
+                    "(legacy): its events are placed by per-record "
+                    "wall stamps and may misalign against anchored "
+                    "ranks"
+                )
+    elif len(streams) > 1:
+        warnings.append(
+            "no stream carries a clock.anchor record: cross-rank "
+            "alignment falls back to per-record wall stamps"
+        )
+    wall_stamps = [
+        w
+        for rk, recs in streams.items()
+        for w in (_tracing.aligned_wall(r, anchors[rk]) for r in recs)
+        if w is not None
+    ]
     for doc in (heartbeats or {}).values():
         if isinstance(doc.get("t"), (int, float)):
             wall_stamps.append(doc["t"])
@@ -79,8 +105,10 @@ def to_chrome_trace(streams: dict[int, list[dict]],
         })
         for rec in streams[rk]:
             kind = rec.get("kind")
-            t = rec.get("t")
-            if not isinstance(t, (int, float)):
+            if kind == _tracing.ANCHOR_KIND:
+                continue  # alignment machinery, not a timeline event
+            t = _tracing.aligned_wall(rec, anchors.get(rk))
+            if t is None:
                 continue
             ts = (t - origin) * 1e6
             attrs = rec.get("attrs") or {}
@@ -113,6 +141,25 @@ def to_chrome_trace(streams: dict[int, list[dict]],
                     "args": {
                         k: v for k, v in rec.items()
                         if k in ("attempt", "step", "wait_s", "error")
+                    },
+                })
+            elif kind == _tracing.TRACE_KIND:
+                # Request-trace transitions (telemetry/tracing.py):
+                # instants carrying the trace context, so a request's
+                # path is searchable by trace_id in the merged view.
+                events.append({
+                    "name": rec.get("name", "?"),
+                    "ph": "i",
+                    "s": "p",
+                    "ts": ts,
+                    "pid": rk,
+                    "tid": rec.get("tid", 0),
+                    "args": {
+                        k: v for k, v in rec.items()
+                        if k in ("trace_id", "span_id", "parent_id",
+                                 "hop", "seq", "seg", "bin", "width",
+                                 "replica", "reroute", "members")
+                        and v is not None
                     },
                 })
             elif kind == "trace":
@@ -156,10 +203,13 @@ def to_chrome_trace(streams: dict[int, list[dict]],
             },
         })
     events.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0)))
+    other: dict = {"source": "rocm_mpi_tpu.telemetry"}
+    if warnings:
+        other["warnings"] = warnings
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",
-        "otherData": {"source": "rocm_mpi_tpu.telemetry"},
+        "otherData": other,
     }
 
 

@@ -107,6 +107,14 @@ health_records+=(
   docs/telemetry_r*/fleet-journal*.jsonl
   docs/telemetry_r*/fleet-report*.json
 )
+# Request-tracing artifacts (docs/TELEMETRY.md "Request tracing"): the
+# per-request rmt-trace-report documents `telemetry trace --out` (and
+# the fleet/soak drills) bank. A drifted report writer bricks the
+# tail-latency triage the next time anyone decomposes a slow request.
+health_records+=(
+  output/*/trace-report*.json
+  docs/telemetry_r*/trace-report*.json
+)
 # The graftlint artifacts: the findings document stage 1 just banked
 # (plus any chip_watcher-archived copies) and the committed baseline.
 # A drifted reporter or a hand-mangled baseline must fail HERE, not

@@ -195,6 +195,19 @@ def test_bounded_soak_acceptance(tmp_path):
     assert check_schema([out / "fleet-report.json",
                          out / "fleet-journal.jsonl"]) == []
 
+    # request tracing composed with the failover: the fleet episode
+    # banked a schema-valid two-hop trace report for the rerouted
+    # request, and the SLO block decomposes tail latency per stage
+    # (docs/TELEMETRY.md "Request tracing")
+    trace_reports = sorted(out.glob("trace-report-*.json"))
+    assert trace_reports, "fleet episode banked no trace report"
+    assert check_schema(trace_reports) == []
+    tr = json.loads(trace_reports[0].read_text())
+    assert tr["hops"] == [0, 1] and tr["terminal"] == "done", tr
+    dec = doc["slo"]["decomposition"]
+    assert dec["n"] >= 8 and dec["hops"]["rerouted"] >= 1, dec
+    assert {"queue_wait", "device"} <= set(dec["stages"]), dec
+
     # the SLO block is populated from REAL telemetry
     assert doc["slo"]["latency_s"]["n"] >= 8
     assert doc["slo"]["latency_s"]["p50"] > 0

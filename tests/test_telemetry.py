@@ -120,9 +120,16 @@ def test_record_event_is_versioned_and_monotonic():
 def test_events_flow_into_rank_stream_when_enabled(tmp_path):
     events.configure(directory=tmp_path, rank=1)
     metrics.record_event("restored", step=16)
-    line = json.loads(
-        (tmp_path / "telemetry-rank1.jsonl").read_text().splitlines()[0]
-    )
+    lines = [
+        json.loads(ln) for ln in
+        (tmp_path / "telemetry-rank1.jsonl").read_text().splitlines()
+    ]
+    # configure() leads the stream with the wall<->monotonic clock
+    # anchor (the PR-20 cross-replica alignment contract)...
+    assert lines[0]["kind"] == "anchor"
+    assert lines[0]["name"] == "clock.anchor"
+    # ...and the event lands right behind it.
+    line = lines[1]
     assert line["kind"] == "event" and line["name"] == "restored"
     assert line["step"] == 16 and line["v"] == 2
 

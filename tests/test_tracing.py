@@ -1,0 +1,383 @@
+"""Request-scoped distributed tracing (docs/TELEMETRY.md "Request
+tracing"): context wire round-trips and the v3 request schema, clock
+anchors + cross-replica alignment (with the legacy-stream warning),
+the telescoping latency decomposition summing to the done latency on
+both the classic and the segmented drain, the `telemetry trace` CLI
+verb + rmt-trace-report schema gate, the flight-recorder in-flight
+roster, the SLO decomposition aggregate, and the tracing-off switch
+(the bench overhead rung's second arm)."""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+import pytest
+
+from rocm_mpi_tpu.serving.queue import (
+    REQUEST_VERSION,
+    Request,
+    request_from_record,
+    request_to_record,
+    validate_request_record,
+)
+from rocm_mpi_tpu.telemetry import (
+    aggregate,
+    events,
+    flight,
+    regress,
+    tracing,
+)
+from rocm_mpi_tpu.telemetry.__main__ import main as cli_main
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _isolated_telemetry(monkeypatch):
+    """Telemetry and the flight recorder start disabled and empty
+    (the test_telemetry/test_health convention)."""
+    monkeypatch.setattr(events, "_ENABLED", False)
+    monkeypatch.setattr(events, "_DIR", None)
+    monkeypatch.setattr(events, "_RANK", None)
+    events.clear()
+    monkeypatch.setattr(flight, "_ENABLED", False)
+    flight.reset()
+    yield
+    events.clear()
+    flight.disable()
+    flight.reset()
+
+
+def _req(rid, nt=4, shape=(16, 16), **kw):
+    return Request(request_id=rid, workload="diffusion",
+                   global_shape=shape, dtype="f32", nt=nt, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Contexts and the v3 request schema
+# ---------------------------------------------------------------------------
+
+
+def test_context_mint_child_hop_and_wire_roundtrip():
+    root = tracing.mint("req-1")
+    assert root.trace_id == "req-1" and root.hop == 0
+    assert root.parent_id is None
+
+    c = tracing.child(root)
+    assert c.parent_id == root.span_id and c.hop == 0
+    assert c.span_id != root.span_id
+
+    h = tracing.next_hop(c)
+    assert h.hop == 1 and h.parent_id == c.span_id
+    assert h.trace_id == "req-1", "trace_id IS the request_id, always"
+
+    wire = tracing.to_wire(h)
+    assert tracing.validate_wire(wire) == []
+    back = tracing.from_wire(wire)
+    assert back == h
+    assert tracing.to_wire(None) is None
+    assert tracing.from_wire(None) is None
+    assert tracing.from_wire({"trace_id": "x"}) is None  # no span_id
+
+
+def test_validate_wire_names_each_problem():
+    bad = {"trace_id": "", "span_id": 3, "hop": -1, "parent_id": 7}
+    problems = tracing.validate_wire(bad)
+    assert len(problems) == 4, problems
+    assert tracing.validate_wire("nope") != []
+
+
+def test_request_record_v3_trace_roundtrip():
+    assert REQUEST_VERSION == 3
+    ctx = tracing.mint("rt-1")
+    r = _req("rt-1", trace=tracing.to_wire(ctx))
+    doc = request_to_record(r)
+    assert doc["v"] == REQUEST_VERSION
+    assert validate_request_record(doc) == []
+    back = request_from_record(doc)
+    assert back.trace == tracing.to_wire(ctx)
+
+    # trace-less requests (and legacy v2 records) stay valid — the
+    # field is optional, not a flag day
+    plain = request_to_record(_req("rt-2"))
+    assert "trace" not in plain
+    assert request_from_record(plain).trace is None
+
+    doc_bad = dict(doc, trace={"trace_id": 1})
+    assert validate_request_record(doc_bad) != []
+
+
+# ---------------------------------------------------------------------------
+# Clock anchors and alignment
+# ---------------------------------------------------------------------------
+
+
+def test_configure_emits_one_anchor_first(tmp_path):
+    events.configure(directory=tmp_path, rank=1)
+    events.record_event("x.y", step=1)
+    events.configure(directory=tmp_path, rank=1)  # idempotent
+    lines = [json.loads(s) for s in
+             (tmp_path / "telemetry-rank1.jsonl").read_text()
+             .splitlines()]
+    anchors = [r for r in lines if r["kind"] == tracing.ANCHOR_KIND]
+    assert len(anchors) == 1 and lines[0] is not None
+    assert lines[0]["kind"] == tracing.ANCHOR_KIND
+    assert lines[0]["name"] == tracing.ANCHOR_NAME
+    assert tracing.anchor_of(lines) == (
+        lines[0]["t"], lines[0]["t_mono"]
+    )
+
+
+def test_aligned_wall_maps_monotonic_through_the_anchor():
+    anchor = (1000.0, 10.0)
+    rec = {"t": 5555.5, "t_mono": 12.5}
+    assert tracing.aligned_wall(rec, anchor) == pytest.approx(1002.5)
+    # legacy: no anchor -> the record's own wall stamp
+    assert tracing.aligned_wall(rec, None) == pytest.approx(5555.5)
+    assert tracing.aligned_wall({"name": "x"}, None) is None
+
+
+def test_request_timeline_aligns_ranks_and_warns_on_legacy():
+    # rank 0: anchored, wall clock skewed far from rank 1's; rank 1:
+    # legacy (no anchor). The timeline must order rank 0's rows on the
+    # anchor-mapped clock and name rank 1's stream in a warning.
+    streams = {
+        0: [
+            {"kind": "anchor", "name": "clock.anchor",
+             "t": 1000.0, "t_mono": 10.0},
+            {"kind": "tspan", "name": "trace.submit",
+             "trace_id": "q-1", "span_id": "s0.1", "hop": 0,
+             "t": 999999.0, "t_mono": 11.0},
+        ],
+        1: [
+            {"kind": "event", "name": "serve.request.done",
+             "request_id": "q-1", "latency_s": 0.5, "hop": 0,
+             "decomp": {"queue_wait": 0.1, "device": 0.4},
+             "t": 1003.0, "t_mono": 77.0},
+        ],
+    }
+    tl = tracing.request_timeline(streams, "q-1")
+    assert tl is not None
+    assert [r["name"] for r in tl["events"]] \
+        == ["trace.submit", "serve.request.done"]
+    assert tl["events"][0]["t"] == pytest.approx(1001.0), \
+        "anchored rank must use anchor_t + (t_mono - anchor_t_mono)"
+    assert tl["terminal"] == "done" and tl["hops"] == [0]
+    assert tl["latency_s"] == pytest.approx(0.5)
+    assert len(tl["warnings"]) == 1 and "rank 1" in tl["warnings"][0]
+    assert tracing.request_timeline(streams, "nobody") is None
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: drains decompose latency (classic and segmented)
+# ---------------------------------------------------------------------------
+
+
+def _timelines_after(svc, reqs, tmp_dir):
+    tickets = [svc.queue.submit(r) for r in reqs]
+    svc._drain_all()
+    streams, _ = aggregate.load_rank_streams(tmp_dir)
+    out = {}
+    for t in tickets:
+        rid = t.request.request_id
+        out[rid] = tracing.request_timeline(streams, rid)
+    return tickets, streams, out
+
+
+def test_classic_drain_decomposition_sums_to_latency(tmp_path):
+    from rocm_mpi_tpu.serving.service import (
+        ServeConfig,
+        SimulationService,
+    )
+
+    events.configure(directory=tmp_path, rank=0)
+    svc = SimulationService(config=ServeConfig(max_width=2))
+    reqs = [_req(f"cl-{i}", nt=3 + i % 2, ic_scale=1.0 + 0.01 * i)
+            for i in range(4)]
+    _, streams, timelines = _timelines_after(svc, reqs, tmp_path)
+
+    for rid, tl in timelines.items():
+        assert tl is not None and tl["terminal"] == "done", rid
+        assert tl["hops"] == [0]
+        assert not tl["warnings"], tl["warnings"]
+        decomp = tl["decomposition"]
+        assert decomp is not None
+        assert tracing.validate_decomposition(decomp) == []
+        assert set(decomp) <= set(tracing.DECOMP_STAGES)
+        # the telescoping contract: stages sum to the done latency
+        assert sum(decomp.values()) \
+            == pytest.approx(tl["latency_s"], abs=0.02), (rid, decomp)
+        names = [r["name"] for r in tl["events"]]
+        assert "trace.submit" in names and "trace.batch" in names
+
+    # the batch roster makes every member findable without per-lane
+    # tspans: O(batches) stream growth is the design point
+    recs = streams[0]
+    batch_recs = [r for r in recs if r.get("name") == "trace.batch"]
+    assert batch_recs
+    rostered = {m["trace_id"] for r in batch_recs
+                for m in r.get("members", ())}
+    assert rostered == {r.request_id for r in reqs}
+
+
+def test_segmented_drain_decomposition_and_segment_roster(tmp_path):
+    from rocm_mpi_tpu.serving.service import (
+        ServeConfig,
+        SimulationService,
+    )
+
+    events.configure(directory=tmp_path, rank=0)
+    svc = SimulationService(config=ServeConfig(
+        max_width=2, segments=2,
+    ))
+    # 3 same-class requests through 2 lanes: the third swaps into a
+    # freed lane at a segment boundary and must inherit the segment
+    # roster it joined at
+    reqs = [_req(f"sg-{i}", nt=4, ic_scale=1.0 + 0.01 * i)
+            for i in range(3)]
+    _, streams, timelines = _timelines_after(svc, reqs, tmp_path)
+
+    for rid, tl in timelines.items():
+        assert tl is not None and tl["terminal"] == "done", rid
+        decomp = tl["decomposition"]
+        assert decomp is not None
+        assert tracing.validate_decomposition(decomp) == []
+        assert sum(decomp.values()) \
+            == pytest.approx(tl["latency_s"], abs=0.02), (rid, decomp)
+
+    seg_recs = [r for r in streams[0]
+                if r.get("name") == "trace.segment"]
+    assert seg_recs, "segmented drain must emit boundary tspans"
+    rostered = {m["trace_id"] for r in seg_recs
+                for m in r.get("members", ())}
+    assert "sg-2" in rostered, "the swapped-in lane joins the roster"
+
+
+def test_tracing_off_is_silent_and_decomp_free(tmp_path):
+    from rocm_mpi_tpu.serving.service import (
+        ServeConfig,
+        SimulationService,
+    )
+
+    events.configure(directory=tmp_path, rank=0)
+    svc = SimulationService(config=ServeConfig(
+        max_width=2, trace_requests=False,
+    ))
+    tickets = [svc.queue.submit(_req(f"off-{i}")) for i in range(2)]
+    svc._drain_all()
+    assert all(t.state == "done" for t in tickets)
+    recs, _ = aggregate.load_rank_streams(tmp_path)
+    stream = recs[0]
+    done = [r for r in stream if r.get("name") == "serve.request.done"]
+    assert done and all("decomp" not in r and "hop" not in r
+                        for r in done)
+    batchy = [r for r in stream if r.get("kind") == tracing.TRACE_KIND
+              and r.get("name") in ("trace.batch", "trace.segment")]
+    assert batchy == [], "the drain hot path must emit no batch tspans"
+
+
+# ---------------------------------------------------------------------------
+# CLI verb + report schema gate
+# ---------------------------------------------------------------------------
+
+
+def test_trace_cli_report_and_chrome(tmp_path, capsys):
+    from rocm_mpi_tpu.serving.service import (
+        ServeConfig,
+        SimulationService,
+    )
+
+    tdir = tmp_path / "telemetry"
+    events.configure(directory=tdir, rank=0)
+    svc = SimulationService(config=ServeConfig(max_width=2))
+    _timelines_after(svc, [_req("cli-0"), _req("cli-1")], tdir)
+
+    report = tmp_path / "trace-report-cli-0.json"
+    chrome = tmp_path / "trace-cli-0.json"
+    rc = cli_main(["trace", str(tdir), "--request", "cli-0",
+                   "--out", str(report), "--chrome", str(chrome)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "trace cli-0" in out and "serve.request.done" in out
+
+    doc = json.loads(report.read_text())
+    assert tracing.validate_trace_report(doc) == []
+    # the regress schema gate classifies and validates the artifact
+    assert regress.check_schema([report]) == []
+
+    cdoc = json.loads(chrome.read_text())
+    assert cdoc["traceEvents"], "chrome export must carry events"
+
+    # unknown request: exit 2 (missing-input contract, not a crash)
+    assert cli_main(["trace", str(tdir), "--request", "ghost"]) == 2
+    assert cli_main(["trace", str(tmp_path / "void"),
+                     "--request", "x"]) == 2
+
+
+def test_regress_gates_done_event_decomp(tmp_path):
+    # a done event with a corrupt decomposition must fail the stream
+    # schema check (the PR-20 guarded-event extension)
+    stream = tmp_path / "telemetry-rank0.jsonl"
+    good = {"v": 2, "kind": "event", "name": "serve.request.done",
+            "t": 1.0, "t_mono": 1.0, "rank": 0, "request_id": "a",
+            "latency_s": 0.1, "decomp": {"queue_wait": 0.1}}
+    bad = dict(good, decomp={"not_a_stage": 0.1})
+    stream.write_text(json.dumps(good) + "\n")
+    assert regress.check_schema([stream]) == []
+    stream.write_text(json.dumps(bad) + "\n")
+    assert regress.check_schema([stream]) != []
+
+
+# ---------------------------------------------------------------------------
+# Flight-recorder roster, SLO aggregate, summary counters
+# ---------------------------------------------------------------------------
+
+
+def test_flight_snapshot_carries_inflight_traces(tmp_path):
+    flight.enable(directory=tmp_path, rank=0)
+    flight.trace_inflight_add(["r-2", "r-1"])
+    snap = flight.snapshot()
+    assert snap["inflight_traces"] == ["r-1", "r-2"]
+    flight.trace_inflight_drop(["r-1", "ghost"])
+    assert flight.inflight_traces() == ["r-2"]
+    flight.flush()
+    side = json.loads(
+        (tmp_path / "heartbeat-rank0.json").read_text()
+    )
+    assert side["inflight_traces"] == ["r-2"]
+    flight.reset()
+    assert flight.inflight_traces() == []
+
+
+def test_slo_decomposition_block_aggregates_and_validates():
+    from rocm_mpi_tpu.serving import slo
+
+    decomps = {
+        "a": {"queue_wait": 0.1, "device": 0.4},
+        "b": {"queue_wait": 0.3, "device": 0.2, "backoff": 0.05},
+    }
+    block = slo.decomposition_block(decomps, {"a": 0, "b": 1})
+    assert block["n"] == 2
+    assert block["stages"]["queue_wait"]["n"] == 2
+    assert block["stages"]["queue_wait"]["mean"] \
+        == pytest.approx(0.2)
+    assert block["hops"] == {"max": 1, "rerouted": 1}
+    assert slo.validate_decomposition_block(block) == []
+    assert slo.validate_decomposition_block(None) == []
+    assert slo.decomposition_block({}, {}) is None
+    assert slo.validate_decomposition_block(
+        {"n": 2, "stages": {"bogus": {"mean": 1, "p50": 1, "p99": 1}},
+         "hops": {"max": 0, "rerouted": 0}}
+    ) != []
+
+
+def test_summarize_counts_tspans_and_traced_requests(tmp_path):
+    events.configure(directory=tmp_path, rank=0)
+    for i in range(3):
+        tracing.emit_tspan("trace.submit", tracing.mint(f"s-{i}"))
+    tracing.emit_tspan("trace.route", tracing.mint("s-0"))
+    streams, _ = aggregate.load_rank_streams(tmp_path)
+    summary = aggregate.summarize(streams)
+    assert summary["tspans"] == {"trace.submit": 3, "trace.route": 1}
+    assert summary["trace_requests"] == 3
